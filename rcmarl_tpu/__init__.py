@@ -1,0 +1,21 @@
+"""rcmarl_tpu — TPU-native resilient consensus multi-agent RL.
+
+A from-scratch JAX/XLA framework with the capabilities of the RPBCAC
+reference implementation (mfigura/Resilient-consensus-based-MARL):
+decentralized actor-critic training of N networked agents reaching
+Byzantine-resilient consensus on critic and team-reward estimates via
+clip-and-average (trimmed-mean) aggregation and projection-based updates
+over a directed communication graph, with first-class injection of
+greedy / faulty / malicious adversaries and an H-trimming defense.
+
+Design (see SURVEY.md §7): all agents' parameters are stacked along a
+leading agent axis; heterogeneous agent behavior is expressed through
+static role partitions and masked updates so every phase — rollout,
+local TD fits, neighbor exchange, sort/clip/mean consensus, projection,
+actor updates — runs as vmapped/jitted XLA programs. Independent
+training seeds are vmapped/sharded across TPU cores.
+"""
+
+__version__ = "0.1.0"
+
+from rcmarl_tpu.config import Config, Roles, circulant_in_nodes  # noqa: F401

@@ -1,0 +1,184 @@
+"""Experiment configuration.
+
+Replaces the reference's argparse-only flag system (reference
+``main.py:25-44``) with a structured, hashable dataclass whose topology
+(``in_nodes``) and per-agent role labels are first-class values instead of
+unoverridable argparse defaults (SURVEY.md §5 "Config / flag system").
+
+The config is static with respect to JAX tracing: everything here is a
+Python scalar/tuple, so it can be closed over by jitted functions without
+triggering retraces, and role composition is resolved at trace time
+(compute only the update branches for roles actually present).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Tuple
+
+
+class Roles:
+    """Integer role codes for the four agent behaviors (reference
+    ``main.py:88-104`` dispatches on the same four labels)."""
+
+    COOPERATIVE = 0
+    GREEDY = 1
+    FAULTY = 2
+    MALICIOUS = 3
+
+    BY_NAME = {
+        "Cooperative": COOPERATIVE,
+        "Greedy": GREEDY,
+        "Faulty": FAULTY,
+        "Malicious": MALICIOUS,
+    }
+    NAMES = {v: k for k, v in BY_NAME.items()}
+
+
+def circulant_in_nodes(n_agents: int, degree: int) -> Tuple[Tuple[int, ...], ...]:
+    """Directed circulant communication graph with self first.
+
+    Generalizes the reference default
+    ``[[0,1,2,3],[1,2,3,4],[2,3,4,0],[3,4,0,1],[4,0,1,2]]``
+    (reference ``main.py:28``): agent i receives from
+    ``(i, i+1, ..., i+degree-1) mod n``. ``degree`` counts the agent
+    itself, matching the reference convention that the agent's own index
+    appears first in its in-neighborhood.
+    """
+    if not 1 <= degree <= n_agents:
+        raise ValueError(f"degree must be in [1, {n_agents}], got {degree}")
+    return tuple(
+        tuple((i + k) % n_agents for k in range(degree)) for i in range(n_agents)
+    )
+
+
+def full_in_nodes(n_agents: int) -> Tuple[Tuple[int, ...], ...]:
+    """Fully-connected graph, self first (BASELINE.json config 3)."""
+    return tuple(
+        (i,) + tuple(j for j in range(n_agents) if j != i) for i in range(n_agents)
+    )
+
+
+@dataclass(frozen=True)
+class Config:
+    """Hyperparameters; defaults mirror reference ``main.py:25-44``.
+
+    Note the reference's published runs (BASELINE.md) override
+    ``slow_lr=0.002`` and ``n_episodes=4000`` (per phase); the code
+    defaults here match the reference snapshot's code defaults.
+    """
+
+    # --- topology / cast ---
+    n_agents: int = 5
+    agent_roles: Tuple[int, ...] = (Roles.COOPERATIVE,) * 5
+    in_nodes: Tuple[Tuple[int, ...], ...] = circulant_in_nodes(5, 4)
+    # --- spaces ---
+    n_actions: int = 5
+    n_states: int = 2
+    nrow: int = 5
+    ncol: int = 5
+    # --- schedule ---
+    n_episodes: int = 7000
+    max_ep_len: int = 20
+    n_ep_fixed: int = 50
+    n_epochs: int = 10
+    # --- optimization ---
+    slow_lr: float = 0.01
+    fast_lr: float = 0.01
+    batch_size: int = 200  # adversarial actor minibatch (reference adversarial_CAC_agents.py:41)
+    buffer_size: int = 2000
+    gamma: float = 0.9
+    # --- resilience ---
+    H: int = 0
+    common_reward: bool = False
+    # --- exploration (reference hardcodes mu=0.1: resilient_CAC_agents.py:208) ---
+    eps_explore: float = 0.1
+    # --- model ---
+    hidden: Tuple[int, ...] = (20, 20)
+    leaky_alpha: float = 0.1
+    # --- env behavior ---
+    collision_physics: bool = False  # opt-in *intended* collision semantics
+    scaling: bool = True
+    randomize_state: bool = True
+    # --- adversary fit schedule (reference adversarial_CAC_agents.py:133,150,163,239,251) ---
+    adv_fit_epochs: int = 10
+    adv_fit_batch: int = 32
+    # --- cooperative local fit (reference resilient_CAC_agents.py:118,136) ---
+    coop_fit_steps: int = 5
+    seed: int = 300
+
+    def __post_init__(self):
+        if len(self.agent_roles) != self.n_agents:
+            raise ValueError("agent_roles length must equal n_agents")
+        if len(self.in_nodes) != self.n_agents:
+            raise ValueError("in_nodes length must equal n_agents")
+        degs = {len(nbrs) for nbrs in self.in_nodes}
+        if len(degs) != 1:
+            raise ValueError(
+                "all agents must currently have the same in-degree "
+                f"(got degrees {sorted(degs)})"
+            )
+        for i, nbrs in enumerate(self.in_nodes):
+            if nbrs[0] != i:
+                raise ValueError(
+                    f"in_nodes[{i}] must list the agent itself first "
+                    "(reference convention, main.py:28)"
+                )
+        n_in = len(self.in_nodes[0])
+        if not 0 <= 2 * self.H <= n_in - 1:
+            raise ValueError(
+                f"H={self.H} too large for in-degree {n_in}: need 2H <= n_in-1"
+            )
+
+    # ---- derived (static) quantities ----
+
+    @property
+    def n_in(self) -> int:
+        return len(self.in_nodes[0])
+
+    @property
+    def obs_dim(self) -> int:
+        """Flattened global-state input dim of actor/critic (N * n_states)."""
+        return self.n_agents * self.n_states
+
+    @property
+    def sa_dim(self) -> int:
+        """Flattened state-action input dim of the team-reward net."""
+        return self.n_agents * (self.n_states + 1)
+
+    @property
+    def buffer_capacity(self) -> int:
+        """Steady-state sample count seen by an update block: kept buffer
+        plus one fresh block (reference train_agents.py:86,158-163)."""
+        return self.buffer_size + self.n_ep_fixed * self.max_ep_len
+
+    @property
+    def block_steps(self) -> int:
+        """Env steps collected between update blocks."""
+        return self.n_ep_fixed * self.max_ep_len
+
+    @property
+    def coop_mask(self) -> Tuple[bool, ...]:
+        return tuple(r == Roles.COOPERATIVE for r in self.agent_roles)
+
+    @property
+    def n_coop(self) -> int:
+        return sum(self.coop_mask)
+
+    @property
+    def n_adv(self) -> int:
+        return self.n_agents - self.n_coop
+
+    def has_role(self, role: int) -> bool:
+        return role in self.agent_roles
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+    @classmethod
+    def from_labels(cls, labels, **kw) -> "Config":
+        """Build from reference-style string labels, e.g.
+        ``['Cooperative']*4 + ['Malicious']``."""
+        roles = tuple(Roles.BY_NAME[l] for l in labels)
+        return cls(agent_roles=roles, n_agents=len(roles), **kw)

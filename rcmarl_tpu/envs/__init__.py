@@ -1,0 +1,7 @@
+from rcmarl_tpu.envs.grid_world import (  # noqa: F401
+    GridWorld,
+    env_reset,
+    env_step,
+    scale_state,
+    scale_reward,
+)

@@ -1,0 +1,147 @@
+"""Pure-functional multi-agent grid-world (cooperative navigation).
+
+TPU-native rebuild of the reference environment
+(``environments/grid_world.py:5-75``): instead of a stateful ``gym.Env``
+mutated one agent at a time in a Python loop, the environment is a pair of
+pure functions ``env_reset`` / ``env_step`` over integer position arrays,
+vectorized across agents (and trivially vmappable over batch/seed axes) so
+whole episodes run inside one ``lax.scan`` on device.
+
+Behavioral contract (SURVEY.md §7 trap 1): the reference's collision branch
+is dead code — ``dist_to_agents = min_j ||state_j - state_node||_1``
+includes the agent itself (``grid_world.py:56``) so it is always 0 and the
+``dist_to_agents > 0`` branch never fires. The *observed* reward, which we
+replicate by default, is::
+
+    reward[i] = 0                          if at goal AND action == stay
+              = -(L1 dist BEFORE move) - 1 otherwise
+
+with moves always applied, clipped to the grid (``grid_world.py:52-64``).
+The docstring-*intended* collision physics is available behind the opt-in
+``collision_physics`` flag (see ``_step_collision``).
+
+Scaling (``grid_world.py:30-35,66-72``): states are standardized with the
+mean/std of ``arange(nrow)`` / ``arange(ncol)``; rewards are divided by 5
+(a constant, not grid-dependent).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Action table: stay, left, right, down, up (reference grid_world.py:27).
+MOVES = np.array([[0, 0], [-1, 0], [1, 0], [0, -1], [0, 1]], dtype=np.int32)
+REWARD_SCALE = 5.0  # reference grid_world.py:71
+
+
+class GridWorld(NamedTuple):
+    """Static environment description (closed over by jitted code)."""
+
+    nrow: int = 5
+    ncol: int = 5
+    n_agents: int = 5
+    scaling: bool = True
+    collision_physics: bool = False
+
+    @property
+    def mean_state(self) -> np.ndarray:
+        # reference grid_world.py:31-33
+        x, y = np.arange(self.nrow), np.arange(self.ncol)
+        return np.array([np.mean(x), np.mean(y)], dtype=np.float32)
+
+    @property
+    def std_state(self) -> np.ndarray:
+        x, y = np.arange(self.nrow), np.arange(self.ncol)
+        return np.array([np.std(x), np.std(y)], dtype=np.float32)
+
+
+def env_reset(env: GridWorld, key: jax.Array) -> jnp.ndarray:
+    """Randomized reset: integer positions ~ U{0..nrow-1}x{0..ncol-1}
+    (reference grid_world.py:39-40). Returns (n_agents, 2) int32."""
+    return jax.random.randint(
+        key,
+        (env.n_agents, 2),
+        jnp.array([0, 0]),
+        jnp.array([env.nrow, env.ncol]),
+        dtype=jnp.int32,
+    )
+
+
+def _step_observed(
+    env: GridWorld, pos: jnp.ndarray, desired: jnp.ndarray, actions: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The reference's *observed* dynamics (dead collision branch elided).
+
+    reference grid_world.py:52-64 with the always-false
+    ``dist_to_agents > 0`` branch removed.
+    """
+    move = jnp.asarray(MOVES)[actions]  # (N, 2)
+    dist_before = jnp.sum(jnp.abs(pos - desired), axis=1)  # (N,)
+    # Per-axis clip. NOTE: the reference clips BOTH coordinates by nrow-1
+    # (grid_world.py:55) — identical on its square default grid; we use the
+    # evidently-intended per-axis bound for non-square grids.
+    npos = jnp.clip(pos + move, 0, jnp.array([env.nrow - 1, env.ncol - 1]))
+    at_goal_stay = (dist_before == 0) & (actions == 0)
+    reward = jnp.where(at_goal_stay, 0.0, -(dist_before.astype(jnp.float32)) - 1.0)
+    return npos, reward
+
+
+def _step_collision(
+    env: GridWorld, pos: jnp.ndarray, desired: jnp.ndarray, actions: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Opt-in *intended* semantics per the reference docstring
+    (grid_world.py:8-9): an agent landing on a cell occupied by any OTHER
+    agent (after simultaneous moves) gets the dense ``-dist_next`` shaping
+    reward replaced by the stay penalty; all agents still move (moves are
+    clipped to the grid)."""
+    move = jnp.asarray(MOVES)[actions]
+    dist_before = jnp.sum(jnp.abs(pos - desired), axis=1)
+    npos = jnp.clip(pos + move, 0, jnp.array([env.nrow - 1, env.ncol - 1]))
+    dist_next = jnp.sum(jnp.abs(npos - desired), axis=1)
+    # pairwise L1 distances after the move, self excluded
+    pair = jnp.sum(jnp.abs(npos[:, None, :] - npos[None, :, :]), axis=-1)
+    pair = pair + jnp.eye(env.n_agents, dtype=pair.dtype) * 10**6
+    alone = jnp.min(pair, axis=1) > 0
+    at_goal_stay = (dist_before == 0) & (actions == 0)
+    reward = jnp.where(
+        alone,
+        -dist_next.astype(jnp.float32),
+        jnp.where(at_goal_stay, 0.0, -(dist_before.astype(jnp.float32)) - 1.0),
+    )
+    return npos, reward
+
+
+def env_step(
+    env: GridWorld, pos: jnp.ndarray, desired: jnp.ndarray, actions: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One synchronous step for all agents.
+
+    Args:
+      pos: (n_agents, 2) int32 unscaled positions.
+      desired: (n_agents, 2) int32 goal positions.
+      actions: (n_agents,) int32 in [0, 5).
+
+    Returns:
+      (new_pos, reward) with reward UNscaled (scaling is applied by
+      ``scale_reward``, mirroring reference ``get_data``).
+    """
+    if env.collision_physics:
+        return _step_collision(env, pos, desired, actions)
+    return _step_observed(env, pos, desired, actions)
+
+
+def scale_state(env: GridWorld, pos: jnp.ndarray) -> jnp.ndarray:
+    """(pos - mean)/std per axis (reference grid_world.py:70)."""
+    if not env.scaling:
+        return pos.astype(jnp.float32)
+    return (pos.astype(jnp.float32) - env.mean_state) / env.std_state
+
+
+def scale_reward(env: GridWorld, reward: jnp.ndarray) -> jnp.ndarray:
+    """reward / 5 — applied unconditionally in the reference's ``get_data``
+    regardless of the ``scaling`` flag (grid_world.py:71)."""
+    return reward / REWARD_SCALE

@@ -1,0 +1,17 @@
+"""Test harness: force JAX onto a virtual 8-device CPU platform.
+
+This is the idiomatic way to test pjit/shard_map/mesh code without real
+TPU slices (SURVEY.md §4). Must run before jax is imported anywhere.
+"""
+
+import os
+
+# Force, don't setdefault: the machine environment presets
+# JAX_PLATFORMS=axon (the real-TPU tunnel) and tests must be
+# deterministic on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
