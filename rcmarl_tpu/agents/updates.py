@@ -1,0 +1,317 @@
+"""Per-role update rules as pure functions over stacked parameters.
+
+This module is the algorithmic heart: the TPU-native twin of the
+reference's four agent classes (``agents/resilient_CAC_agents.py`` and
+``agents/adversarial_CAC_agents.py``), re-expressed as pure functions of
+``(stacked params, batch, masks)`` so a whole heterogeneous network of
+agents updates inside one jitted XLA program (SURVEY.md §7 "Design
+stance"). Object-per-agent method dispatch becomes compute-per-role +
+masked select; role composition is STATIC (from Config), so absent roles
+cost nothing at trace time.
+
+Phase structure per update block (reference ``train_agents.py:100-153``):
+
+  for epoch in range(n_epochs):
+    I)  local critic/TR fits, ALL agents -> "messages" (transmitted
+        weights); cooperative agents RESTORE their own nets
+        (resilient_CAC_agents.py:120,138) — the local step produces the
+        message, not a state change.
+    II) resilient consensus, cooperative agents only:
+        a) gather neighbor messages over in_nodes,
+        b) hidden-layer clip-mean consensus -> new trunk,
+        c) projection: evaluate every neighbor's HEAD on the agent's own
+           (just-aggregated) trunk features, clip-mean over neighbors,
+        d) normalized team update of the head toward the aggregate.
+  III) actor updates, once per block: cooperative = one weighted
+       train_on_batch step; adversaries = 5 shuffled minibatch Adam steps
+       (fit(batch_size=200, epochs=1), adversarial_CAC_agents.py:41).
+
+All batch tensors live in fixed-capacity buffers with validity masks so
+shapes stay static under jit (see ops/losses.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from rcmarl_tpu.config import Config, Roles
+from rcmarl_tpu.models.mlp import (
+    MLPParams,
+    actor_probs,
+    head_forward,
+    mlp_forward,
+    trunk_forward,
+)
+from rcmarl_tpu.ops.aggregation import resilient_aggregate, resilient_aggregate_tree
+from rcmarl_tpu.ops.fit import fit_full_batch, fit_minibatch
+from rcmarl_tpu.ops.losses import weighted_mse, weighted_sparse_ce
+from rcmarl_tpu.ops.optim import AdamState, adam_update
+
+
+class AgentParams(NamedTuple):
+    """All agents' learnable state, every leaf with leading agent axis N.
+
+    ``critic_local`` is the malicious agent's PRIVATE critic
+    (adversarial_CAC_agents.py:99): trained on its own reward and used for
+    its actor updates, while ``critic`` holds the compromised critic it
+    transmits. For non-malicious agents ``critic_local`` is an unused
+    mirror (kept dense for vmap-ability; tiny at these model sizes).
+    """
+
+    actor: MLPParams
+    critic: MLPParams
+    tr: MLPParams
+    critic_local: MLPParams
+    actor_opt: AdamState
+
+
+class Batch(NamedTuple):
+    """Fixed-capacity update batch (the replay window).
+
+    s/ns: (B, N, n_states) scaled states; a: (B, N, 1) float actions;
+    r: (B, N, 1) scaled rewards; mask: (B,) validity.
+    """
+
+    s: jnp.ndarray
+    ns: jnp.ndarray
+    a: jnp.ndarray
+    r: jnp.ndarray
+    mask: jnp.ndarray
+
+    @property
+    def sa(self) -> jnp.ndarray:
+        """concat(s, a) on the feature axis (train_agents.py:93)."""
+        return jnp.concatenate([self.s, self.a], axis=-1)
+
+
+# --------------------------------------------------------------------------
+# Phase I: local fits
+# --------------------------------------------------------------------------
+
+
+def coop_local_critic_fit(
+    critic: MLPParams, s, ns, r, mask, cfg: Config
+) -> MLPParams:
+    """Cooperative local critic fit -> transmitted message
+    (resilient_CAC_agents.py:103-122): TD target computed ONCE with
+    current weights, then ``coop_fit_steps`` full-batch SGD steps; the
+    caller keeps the agent's own critic unchanged (restore semantics)."""
+    target = r + cfg.gamma * mlp_forward(critic, ns)
+    target = jax.lax.stop_gradient(target)
+
+    def loss(p):
+        return weighted_mse(mlp_forward(p, s), target, mask=mask)
+
+    msg, _ = fit_full_batch(critic, loss, cfg.coop_fit_steps, cfg.fast_lr)
+    return msg
+
+
+def coop_local_tr_fit(tr: MLPParams, sa, r, mask, cfg: Config) -> MLPParams:
+    """Cooperative local team-reward fit (resilient_CAC_agents.py:124-140):
+    same 5-step full-batch SGD, target = local reward (no bootstrap)."""
+
+    def loss(p):
+        return weighted_mse(mlp_forward(p, sa), r, mask=mask)
+
+    msg, _ = fit_full_batch(tr, loss, cfg.coop_fit_steps, cfg.fast_lr)
+    return msg
+
+
+def adv_critic_fit(
+    key, critic: MLPParams, s, ns, r_target, mask, cfg: Config
+) -> MLPParams:
+    """Adversary critic fit (greedy local / malicious local+compromised):
+    TD target with pre-fit weights, then fit(epochs=10, batch_size=32)
+    shuffled minibatch SGD (adversarial_CAC_agents.py:131-133,146-151,
+    237-239). The update PERSISTS (no restore)."""
+    target = r_target + cfg.gamma * mlp_forward(critic, ns)
+    target = jax.lax.stop_gradient(target)
+
+    def batch_loss(p, idx, bval):
+        return weighted_mse(mlp_forward(p, s[idx]), target[idx], mask=bval)
+
+    out, _, _ = fit_minibatch(
+        key,
+        critic,
+        batch_loss,
+        capacity=s.shape[0],
+        mask=mask,
+        epochs=cfg.adv_fit_epochs,
+        batch_size=cfg.adv_fit_batch,
+        lr=cfg.fast_lr,
+    )
+    return out
+
+
+def adv_tr_fit(key, tr: MLPParams, sa, r_target, mask, cfg: Config) -> MLPParams:
+    """Adversary team-reward fit: fit(epochs=10, batch_size=32) toward the
+    (possibly compromised) reward (adversarial_CAC_agents.py:154-165,
+    243-253)."""
+
+    def batch_loss(p, idx, bval):
+        return weighted_mse(mlp_forward(p, sa[idx]), r_target[idx], mask=bval)
+
+    out, _, _ = fit_minibatch(
+        key,
+        tr,
+        batch_loss,
+        capacity=sa.shape[0],
+        mask=mask,
+        epochs=cfg.adv_fit_epochs,
+        batch_size=cfg.adv_fit_batch,
+        lr=cfg.fast_lr,
+    )
+    return out
+
+
+# --------------------------------------------------------------------------
+# Phase II: resilient consensus (cooperative agents)
+# --------------------------------------------------------------------------
+
+
+def consensus_update_one(
+    own: MLPParams,
+    nbr_msgs: MLPParams,
+    x: jnp.ndarray,
+    mask: jnp.ndarray,
+    cfg: Config,
+) -> MLPParams:
+    """Full Phase-II update for ONE cooperative agent's critic or TR net.
+
+    Args:
+      own: the agent's current net (pre-consensus; its head is the
+        pre-phase-I head thanks to restore semantics).
+      nbr_msgs: gathered neighbor messages, leaves (n_in, ...), own
+        message at index 0 (in_nodes convention).
+      x: (B, ...) the net's input batch (s for critic, sa for TR).
+
+    Steps b-d of reference train_agents.py:125-145:
+      b) hidden consensus (resilient_CAC_agents.py:142-166): clip-mean
+         each trunk array over neighbors; write trunk only.
+      c) projection (resilient_CAC_agents.py:168-206): evaluate each
+         neighbor's head on the agent's NEW trunk features over the whole
+         batch; clip-mean over neighbors -> per-sample targets.
+      d) team update (resilient_CAC_agents.py:60-84): one SGD step of the
+         head (trunk frozen) toward the aggregated targets with weights
+         1/(2*fast_lr*(||phi||^2+1)) — the paper's normalized projected
+         update; with Keras MSE + SUM_OVER_BATCH_SIZE the fast_lr cancels.
+    """
+    n_trunk = len(own) - 1
+    # b) hidden-layer consensus over trunk arrays
+    trunk_agg = resilient_aggregate_tree(
+        tuple(nbr_msgs[i] for i in range(n_trunk)), cfg.H
+    )
+    new_params: MLPParams = tuple(trunk_agg) + (own[-1],)
+    # c) projection: phi with aggregated trunk, all neighbor heads at once
+    phi = trunk_forward(new_params, x, cfg.leaky_alpha)  # (B, h)
+    W_nbr, b_nbr = nbr_msgs[-1]  # (n_in, h, 1), (n_in, 1)
+    vals = (
+        jnp.einsum(
+            "bh,nho->nbo", phi, W_nbr, precision=jax.lax.Precision.HIGHEST
+        )
+        + b_nbr[:, None, :]
+    )  # (n_in, B, 1)
+    agg = resilient_aggregate(vals, cfg.H)  # (B, 1)
+    agg = jax.lax.stop_gradient(agg)
+    # d) normalized team update of the head only
+    phi_sg = jax.lax.stop_gradient(phi)
+    phi_norm = jnp.sum(phi_sg**2, axis=1) + 1.0  # (B,)
+    weights = 1.0 / (2.0 * cfg.fast_lr * phi_norm)
+
+    def head_loss(head_params):
+        pred = head_forward(head_params, phi_sg)
+        return weighted_mse(pred, agg, sample_weight=weights, mask=mask)
+
+    g = jax.grad(head_loss)(new_params[-1])
+    new_head = jax.tree.map(lambda p, gg: p - cfg.fast_lr * gg, new_params[-1], g)
+    return tuple(trunk_agg) + (new_head,)
+
+
+# --------------------------------------------------------------------------
+# Phase III: actor updates
+# --------------------------------------------------------------------------
+
+
+def coop_actor_update(
+    actor: MLPParams,
+    opt: AdamState,
+    critic: MLPParams,
+    tr: MLPParams,
+    s,
+    ns,
+    sa,
+    a_own,
+    cfg: Config,
+) -> Tuple[MLPParams, AdamState]:
+    """Cooperative actor step (resilient_CAC_agents.py:86-101): sample
+    weights = team TD error r_bar(sa) + gamma*V(ns) - V(s) (own TR/critic,
+    post-consensus), ONE full-batch Adam step of weighted sparse CE over
+    the fresh on-policy window (always fully valid)."""
+    delta = (
+        mlp_forward(tr, sa) + cfg.gamma * mlp_forward(critic, ns) - mlp_forward(critic, s)
+    )
+    delta = jax.lax.stop_gradient(delta[:, 0])  # (B,)
+
+    def loss(p):
+        return weighted_sparse_ce(actor_probs(p, s, cfg.leaky_alpha), a_own, delta)
+
+    g = jax.grad(loss)(actor)
+    return adam_update(actor, g, opt, cfg.slow_lr)
+
+
+def adv_actor_update(
+    key,
+    actor: MLPParams,
+    opt: AdamState,
+    critic: MLPParams,
+    s,
+    ns,
+    r_own,
+    a_own,
+    cfg: Config,
+) -> Tuple[MLPParams, AdamState]:
+    """Adversary actor step (adversarial_CAC_agents.py:28-43,102-119,
+    211-226): sample weights = LOCAL TD error from own reward and own
+    critic (malicious: its private local critic), then
+    fit(batch_size=200, epochs=1) = shuffled minibatch Adam steps."""
+    delta = r_own + cfg.gamma * mlp_forward(critic, ns) - mlp_forward(critic, s)
+    delta = jax.lax.stop_gradient(delta[:, 0])  # (B,)
+    B = s.shape[0]
+    mask = jnp.ones((B,), jnp.float32)
+
+    def batch_loss(p, idx, bval):
+        return weighted_sparse_ce(
+            actor_probs(p, s[idx], cfg.leaky_alpha), a_own[idx], delta[idx], mask=bval
+        )
+
+    new_actor, new_opt, _ = fit_minibatch(
+        key,
+        actor,
+        batch_loss,
+        capacity=B,
+        mask=mask,
+        epochs=1,
+        batch_size=cfg.batch_size,
+        opt_state=opt,
+        opt_update=lambda p, g, s_: adam_update(p, g, s_, cfg.slow_lr),
+    )
+    return new_actor, new_opt
+
+
+# --------------------------------------------------------------------------
+# Role-masked select helpers
+# --------------------------------------------------------------------------
+
+
+def select_tree(pred_per_agent: jnp.ndarray, if_true, if_false):
+    """Per-agent masked select over stacked pytrees: leaves (N, ...)."""
+
+    def sel(a, b):
+        shape = (-1,) + (1,) * (a.ndim - 1)
+        return jnp.where(pred_per_agent.reshape(shape), a, b)
+
+    return jax.tree.map(sel, if_true, if_false)

@@ -1,0 +1,126 @@
+"""Stacked-agent MLP actor/critic/team-reward networks.
+
+TPU-native rebuild of the reference's per-agent Keras ``Sequential`` models
+(reference ``main.py:56-82``): instead of N independent Keras objects, a
+model family is ONE pytree whose leaves carry a leading agent axis, so all
+N forward/backward passes run as a single vmapped XLA program (SURVEY.md §7
+"Design stance").
+
+Architecture (parity with reference ``main.py:60-82``):
+  input -> flatten -> Dense(h1, LeakyReLU alpha=0.1) -> ... -> Dense(out)
+with the actor adding a softmax head. The parameter pytree is a tuple of
+``(W, b)`` layer pairs; the split ``trunk = layers[:-1]`` / ``head =
+layers[-1]`` mirrors the reference's ``critic_features`` sub-model cut at
+``layers[-2].output`` (``resilient_CAC_agents.py:39-40``) — load-bearing
+for consensus, which treats hidden layers and the output layer differently.
+
+Initialization matches Keras defaults (SURVEY.md §7 contract 5): Glorot
+uniform kernels, zero biases.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# An MLP's parameters: ((W1, b1), (W2, b2), ..., (Wk, bk)).
+MLPParams = Tuple[Tuple[jnp.ndarray, jnp.ndarray], ...]
+
+# This JAX build's default matmul precision is bf16-class even on CPU
+# (~1e-3 relative error). The reference is pure fp32; curve parity and the
+# golden tests require true fp32 dots. These models are tiny (20-wide), so
+# HIGHEST costs nothing — revisit only for the 256-wide BASELINE config.
+PRECISION = jax.lax.Precision.HIGHEST
+
+
+def dot(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """fp32-precision matmul used for every model contraction."""
+    return jnp.matmul(a, b, precision=PRECISION)
+
+
+def glorot_uniform(key: jax.Array, fan_in: int, fan_out: int) -> jnp.ndarray:
+    """Keras default kernel init: U(-l, l), l = sqrt(6/(fan_in+fan_out))."""
+    limit = jnp.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(
+        key, (fan_in, fan_out), minval=-limit, maxval=limit, dtype=jnp.float32
+    )
+
+
+def init_mlp(
+    key: jax.Array, in_dim: int, hidden: Sequence[int], out_dim: int
+) -> MLPParams:
+    """Initialize one MLP: Glorot-uniform kernels, zero biases."""
+    dims = [in_dim, *hidden, out_dim]
+    keys = jax.random.split(key, len(dims) - 1)
+    return tuple(
+        (glorot_uniform(k, d_in, d_out), jnp.zeros((d_out,), jnp.float32))
+        for k, d_in, d_out in zip(keys, dims[:-1], dims[1:])
+    )
+
+
+def init_stacked_mlp(
+    key: jax.Array, n_agents: int, in_dim: int, hidden: Sequence[int], out_dim: int
+) -> MLPParams:
+    """Initialize N independent MLPs stacked on a leading agent axis
+    (each agent draws its own init, as the reference builds N separate
+    Keras models in a loop, ``main.py:59``)."""
+    keys = jax.random.split(key, n_agents)
+    return jax.vmap(lambda k: init_mlp(k, in_dim, hidden, out_dim))(keys)
+
+
+def leaky_relu(x: jnp.ndarray, alpha: float = 0.1) -> jnp.ndarray:
+    """LeakyReLU with the reference's alpha=0.1 (``main.py:63``)."""
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def trunk(params: MLPParams) -> MLPParams:
+    """Hidden-layer parameters — the consensus 'hidden' block."""
+    return params[:-1]
+
+
+def head(params: MLPParams) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Output-layer parameters — the consensus 'estimate' block."""
+    return params[-1]
+
+
+def flatten_input(x: jnp.ndarray) -> jnp.ndarray:
+    """Flatten all but the leading batch axis (Keras Flatten layer)."""
+    return x.reshape(x.shape[0], -1)
+
+
+def trunk_forward(params: MLPParams, x: jnp.ndarray, alpha: float = 0.1) -> jnp.ndarray:
+    """Features phi(x) after the last hidden layer (the reference's
+    ``critic_features`` / ``TR_features`` sub-models).
+
+    Args:
+      params: single-agent MLP pytree (no agent axis).
+      x: (batch, ...) input; flattened internally.
+    """
+    h = flatten_input(x)
+    for W, b in params[:-1]:
+        h = leaky_relu(dot(h, W) + b, alpha)
+    return h
+
+
+def head_forward(
+    head_params: Tuple[jnp.ndarray, jnp.ndarray], phi: jnp.ndarray
+) -> jnp.ndarray:
+    W, b = head_params
+    return dot(phi, W) + b
+
+
+def mlp_forward(params: MLPParams, x: jnp.ndarray, alpha: float = 0.1) -> jnp.ndarray:
+    """Full forward pass -> (batch, out_dim) linear output."""
+    return head_forward(params[-1], trunk_forward(params, x, alpha))
+
+
+def actor_probs(params: MLPParams, x: jnp.ndarray, alpha: float = 0.1) -> jnp.ndarray:
+    """Softmax policy probabilities (reference actor, ``main.py:65``)."""
+    return jax.nn.softmax(mlp_forward(params, x, alpha), axis=-1)
+
+
+def agent_slice(params: MLPParams, i) -> MLPParams:
+    """Select agent i's parameters from a stacked pytree."""
+    return jax.tree.map(lambda a: a[i], params)

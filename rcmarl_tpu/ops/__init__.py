@@ -1,0 +1,16 @@
+from rcmarl_tpu.ops.aggregation import (  # noqa: F401
+    resilient_aggregate,
+    resilient_aggregate_tree,
+)
+from rcmarl_tpu.ops.fit import (  # noqa: F401
+    fit_full_batch,
+    fit_minibatch,
+    valid_first_shuffle,
+)
+from rcmarl_tpu.ops.losses import weighted_mse, weighted_sparse_ce  # noqa: F401
+from rcmarl_tpu.ops.optim import (  # noqa: F401
+    AdamState,
+    adam_init,
+    adam_update,
+    sgd_update,
+)

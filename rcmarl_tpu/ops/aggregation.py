@@ -1,0 +1,58 @@
+"""Resilient (trimmed clip-and-average) aggregation — the hot kernel.
+
+Rebuild of the reference's ``_resilient_aggregation``
+(``resilient_CAC_agents.py:42-58``), the single function used for BOTH
+per-parameter hidden-layer consensus and per-sample projected-estimate
+consensus (SURVEY.md §3.4). Semantics, with own value at neighbor index 0:
+
+    sorted = sort(values, axis=0)
+    lower  = min(sorted[H], own)
+    upper  = max(sorted[n_in - H - 1], own)
+    out    = mean(clip(values, lower, upper), axis=0)
+
+Values are *clipped into* [lower, upper], not discarded — a clipped mean
+(~trimmed mean) guaranteed to keep the agent's own value inside the
+bounds. H=0 degenerates to the plain mean.
+
+TPU shape: one fused ``sort -> clip -> mean`` over a small leading
+neighbor axis, batched over everything else (all parameters of a whole
+pytree in one call; all samples of a projection batch in another), and
+vmapped over the agent axis by the consensus layer. XLA lowers the tiny
+fixed-size sort to a vectorized sorting network; no Pallas needed at
+reference scale (SURVEY.md §7 hard part (e)).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def resilient_aggregate(values: jnp.ndarray, H: int) -> jnp.ndarray:
+    """Clip-and-average over the leading neighbor axis.
+
+    Args:
+      values: (n_in, ...) stacked neighbor values, own value at index 0.
+      H: max number of adversaries tolerated in the neighborhood (static).
+
+    Returns:
+      (...) aggregated values.
+    """
+    n_in = values.shape[0]
+    if not 0 <= 2 * H <= n_in - 1:
+        raise ValueError(f"H={H} invalid for n_in={n_in}: need 0 <= 2H <= n_in-1")
+    own = values[0]
+    if H == 0:
+        # sort/clip are the identity w.r.t. the mean when H == 0
+        return jnp.mean(values, axis=0)
+    sorted_vals = jnp.sort(values, axis=0)
+    lower = jnp.minimum(sorted_vals[H], own)
+    upper = jnp.maximum(sorted_vals[n_in - H - 1], own)
+    return jnp.mean(jnp.clip(values, lower, upper), axis=0)
+
+
+def resilient_aggregate_tree(tree, H: int):
+    """Apply :func:`resilient_aggregate` to every leaf of a pytree whose
+    leaves carry a leading neighbor axis (e.g. a gathered parameter
+    pytree with leaves (n_in, ...))."""
+    return jax.tree.map(lambda v: resilient_aggregate(v, H), tree)

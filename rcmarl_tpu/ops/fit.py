@@ -1,0 +1,155 @@
+"""Keras ``model.fit`` semantics as jittable scan programs.
+
+The reference relies on three distinct Keras fit shapes (SURVEY.md §7
+contract 3):
+
+1. Cooperative local critic/TR fits: ``fit(batch_size=FULL, epochs=5)`` —
+   5 full-batch gradient steps against a FIXED pre-computed target
+   (``resilient_CAC_agents.py:118,136``) -> :func:`fit_full_batch`.
+2. Adversary critic/TR fits: ``fit(epochs=10, batch_size=32)`` — shuffled
+   mini-batch SGD incl. a partial final batch
+   (``adversarial_CAC_agents.py:133,150,163,239,251``) -> :func:`fit_minibatch`.
+3. Adversary actor fits: ``fit(batch_size=200, epochs=1)`` with Adam
+   (``adversarial_CAC_agents.py:41,116,224``) -> :func:`fit_minibatch`
+   with an Adam step function.
+
+All run under ``jit`` with STATIC shapes over fixed-capacity buffers with
+validity masks. The key trick for exact Keras semantics: each epoch draws
+a "valid-first shuffle" — a permutation that places all valid rows first
+in uniform random order, padding rows last — so sequential batch slicing
+visits exactly the batches Keras would visit (including the same-sized
+partial final batch), and trailing all-padding batches contribute zero
+gradient. Keras's SUM_OVER_BATCH_SIZE division by the per-batch sample
+count is reproduced by dividing by the batch's valid count.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from rcmarl_tpu.ops.optim import sgd_update
+
+
+def fit_full_batch(
+    params,
+    loss_fn: Callable[[object], jnp.ndarray],
+    n_steps: int,
+    lr: float,
+):
+    """``n_steps`` full-batch SGD steps on a fixed objective.
+
+    ``loss_fn`` closes over data, target, and mask; the target must be
+    pre-computed by the caller (the reference computes the TD target once,
+    BEFORE the 5-step fit, ``resilient_CAC_agents.py:114-118``).
+
+    Returns (final_params, first_step_loss) — the reference logs
+    ``history['loss'][0]`` (``resilient_CAC_agents.py:122``).
+    """
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def step(p, _):
+        loss, g = grad_fn(p)
+        return sgd_update(p, g, lr), loss
+
+    final, losses = jax.lax.scan(step, params, None, length=n_steps)
+    return final, losses[0]
+
+
+def valid_first_shuffle(
+    key: jax.Array, mask: jnp.ndarray, n_batches: int, batch_size: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-epoch shuffled batch index plan.
+
+    Args:
+      key: PRNG key for this epoch's shuffle.
+      mask: (capacity,) float/bool validity of each buffer row.
+      n_batches/batch_size: static batch plan; n_batches * batch_size >=
+        capacity (indices beyond capacity are padding).
+
+    Returns:
+      (idx, batch_valid): idx (n_batches, batch_size) int32 row indices;
+      batch_valid (n_batches, batch_size) float32, 1.0 where the slot holds
+      a real (valid) sample. Valid rows occupy a uniformly-shuffled prefix,
+      exactly like Keras's shuffle over the dense array.
+    """
+    cap = mask.shape[0]
+    pad = n_batches * batch_size - cap
+    scores = jax.random.uniform(key, (cap,)) + (1.0 - mask.astype(jnp.float32)) * 2.0
+    order = jnp.argsort(scores).astype(jnp.int32)  # valid rows first, shuffled
+    slot_valid = (jnp.arange(n_batches * batch_size) < jnp.sum(mask)).astype(
+        jnp.float32
+    )
+    order_padded = jnp.concatenate([order, jnp.zeros((pad,), jnp.int32)])
+    return (
+        order_padded.reshape(n_batches, batch_size),
+        slot_valid.reshape(n_batches, batch_size),
+    )
+
+
+def fit_minibatch(
+    key: jax.Array,
+    params,
+    batch_loss_fn: Callable[[object, jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    capacity: int,
+    mask: jnp.ndarray,
+    epochs: int,
+    batch_size: int,
+    lr: float = 0.0,
+    opt_state=None,
+    opt_update: Optional[Callable] = None,
+):
+    """Shuffled mini-batch fit with Keras epoch/batch structure.
+
+    Args:
+      batch_loss_fn(params, idx, batch_valid) -> scalar loss for the rows
+        ``idx`` (gathering data it closes over), dividing by the batch's
+        valid count (use ops.losses with ``mask=batch_valid``).
+      epochs/batch_size: Keras fit arguments (static).
+      lr: SGD learning rate, used when ``opt_update`` is None.
+      opt_state/opt_update: optional stateful optimizer (e.g. TF-Adam);
+        ``opt_update(params, grads, state) -> (params, state)``.
+
+    Returns (final_params, final_opt_state, first_epoch_mean_loss) —
+    Keras's ``history['loss'][0]`` is the mean of per-batch losses over the
+    first epoch's real batches.
+    """
+    n_batches = math.ceil(capacity / batch_size)
+    grad_fn = jax.value_and_grad(batch_loss_fn)
+    ekeys = jax.random.split(key, epochs)
+
+    def epoch(carry, ekey):
+        p, ostate = carry
+        idx, bvalid = valid_first_shuffle(ekey, mask, n_batches, batch_size)
+
+        def mb(carry, xs):
+            p, ostate = carry
+            bidx, bval = xs
+            loss, g = grad_fn(p, bidx, bval)
+            nonempty = jnp.sum(bval) > 0
+            if opt_update is None:
+                newp = sgd_update(p, g, lr)
+                newstate = ostate
+            else:
+                newp, newstate = opt_update(p, g, ostate)
+            # Keras never runs an empty batch: skip update AND optimizer
+            # state advance for all-padding batches.
+            p = jax.tree.map(lambda a, b: jnp.where(nonempty, b, a), p, newp)
+            if opt_update is not None:
+                ostate = jax.tree.map(
+                    lambda a, b: jnp.where(nonempty, b, a), ostate, newstate
+                )
+            else:
+                ostate = newstate
+            return (p, ostate), (loss, jnp.sum(bval))
+
+        (p, ostate), (losses, counts) = jax.lax.scan(mb, (p, ostate), (idx, bvalid))
+        # Keras's epoch loss is the sample-count-weighted mean of batch losses
+        mean_loss = jnp.sum(losses * counts) / jnp.maximum(jnp.sum(counts), 1.0)
+        return (p, ostate), mean_loss
+
+    (params, opt_state), epoch_losses = jax.lax.scan(epoch, (params, opt_state), ekeys)
+    return params, opt_state, epoch_losses[0]

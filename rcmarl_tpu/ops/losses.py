@@ -1,0 +1,80 @@
+"""Losses with Keras reduction semantics, extended with validity masks.
+
+Keras losses reduce with SUM_OVER_BATCH_SIZE: per-sample losses (already
+averaged over output dims for MSE) are multiplied by optional sample
+weights, summed, and divided by the NUMBER OF SAMPLES — not by the weight
+sum (SURVEY.md §7 contracts 3/5). The reference always fits on fully-valid
+batches; our buffers are fixed-capacity with a validity mask (so jitted
+update blocks keep static shapes while the reference's buffer grows
+1000 -> 2000 -> 3000 over the first three update blocks,
+``train_agents.py:158-163``). Masked rows contribute zero to the sum and
+are excluded from the sample count, which reproduces Keras numbers exactly
+on the valid prefix.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+# Keras clips probabilities to [eps, 1-eps] before log in categorical
+# cross-entropy (keras.backend.epsilon() == 1e-7).
+KERAS_EPSILON = 1e-7
+
+
+def _masked_mean(per_sample: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+    if mask is None:
+        return jnp.mean(per_sample)
+    n = jnp.maximum(jnp.sum(mask), 1.0)
+    # where() not multiply: garbage in masked rows must not poison the sum
+    return jnp.sum(jnp.where(mask > 0, per_sample, 0.0)) / n
+
+
+def weighted_mse(
+    pred: jnp.ndarray,
+    target: jnp.ndarray,
+    sample_weight: Optional[jnp.ndarray] = None,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """keras.losses.MeanSquaredError with sample weights and validity mask.
+
+    pred/target: (B, out); sample_weight/mask: (B,) or None.
+    """
+    diff = pred - target
+    if mask is not None:
+        # sanitize BEFORE squaring: a plain where() on the loss would still
+        # propagate NaN/inf from masked rows through the gradient
+        diff = jnp.where(mask[:, None] > 0, diff, 0.0)
+    per = jnp.mean(diff**2, axis=-1)  # mean over output dims
+    if sample_weight is not None:
+        per = per * sample_weight
+    return _masked_mean(per, mask)
+
+
+def weighted_sparse_ce(
+    probs: jnp.ndarray,
+    labels: jnp.ndarray,
+    sample_weight: Optional[jnp.ndarray] = None,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """keras.losses.SparseCategoricalCrossentropy (from_logits=False) with
+    sample weights — the actor loss (``resilient_CAC_agents.py:38``).
+
+    probs: (B, A) softmax outputs; labels: (B,) int class indices.
+    """
+    if mask is not None:
+        # sanitize masked rows to a uniform distribution so NaN/garbage
+        # cannot reach log() or its gradient
+        probs = jnp.where(
+            mask[:, None] > 0, probs, jnp.ones_like(probs) / probs.shape[-1]
+        )
+    # tf.keras normalizes to a distribution, then clips to [eps, 1-eps]
+    p = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    p = jnp.clip(p, KERAS_EPSILON, 1.0 - KERAS_EPSILON)
+    per = -jnp.log(jnp.take_along_axis(p, labels[:, None].astype(jnp.int32), axis=-1))[
+        :, 0
+    ]
+    if sample_weight is not None:
+        per = per * sample_weight
+    return _masked_mean(per, mask)

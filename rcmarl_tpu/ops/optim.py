@@ -1,0 +1,63 @@
+"""Optimizers with TensorFlow/Keras-exact semantics.
+
+The reference trains actors with ``keras.optimizers.Adam`` and critics /
+team-reward nets with stateless ``keras.optimizers.SGD``
+(``resilient_CAC_agents.py:36-38``). Curve parity hinges on TF's Adam
+formulation (SURVEY.md §7 contract 5), which differs from optax's default:
+
+  TF:    lr_t = lr * sqrt(1 - b2^t) / (1 - b1^t)
+         theta -= lr_t * m_t / (sqrt(v_t) + eps),   eps = 1e-7
+  optax: theta -= lr * m_hat / (sqrt(v_hat) + eps), eps = 1e-8
+
+i.e. TF adds the (unscaled) epsilon AFTER folding the bias correction into
+the step size, and defaults to eps=1e-7. We implement TF's form exactly.
+
+All functions are pure pytree transforms — vmappable over the agent axis.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sgd_update(params, grads, lr: float):
+    """Plain SGD: theta -= lr * g (keras.optimizers.SGD, no momentum)."""
+    return jax.tree.map(lambda p, g: p - lr * g, params, grads)
+
+
+class AdamState(NamedTuple):
+    count: jnp.ndarray  # scalar int32 step counter (t in TF's formula)
+    m: object  # first-moment pytree, same structure as params
+    v: object  # second-moment pytree
+
+
+def adam_init(params) -> AdamState:
+    return AdamState(
+        count=jnp.zeros((), jnp.int32),
+        m=jax.tree.map(jnp.zeros_like, params),
+        v=jax.tree.map(jnp.zeros_like, params),
+    )
+
+
+def adam_update(
+    params,
+    grads,
+    state: AdamState,
+    lr: float,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-7,
+) -> Tuple[object, AdamState]:
+    """One TF-semantics Adam step. Returns (new_params, new_state)."""
+    t = state.count + 1
+    tf_ = t.astype(jnp.float32)
+    lr_t = lr * jnp.sqrt(1.0 - b2**tf_) / (1.0 - b1**tf_)
+    new_m = jax.tree.map(lambda m, g: b1 * m + (1.0 - b1) * g, state.m, grads)
+    new_v = jax.tree.map(lambda v, g: b2 * v + (1.0 - b2) * g * g, state.v, grads)
+    new_params = jax.tree.map(
+        lambda p, m, v: p - lr_t * m / (jnp.sqrt(v) + eps), params, new_m, new_v
+    )
+    return new_params, AdamState(count=t, m=new_m, v=new_v)

@@ -2,16 +2,33 @@
 
 This is the idiomatic way to test pjit/shard_map/mesh code without real
 TPU slices (SURVEY.md §4). Must run before jax is imported anywhere.
+
+Environment gotchas (see .claude/skills/verify/SKILL.md):
+- The machine presets JAX_PLATFORMS=axon (a real-TPU tunnel whose PJRT
+  plugin is registered by a sitecustomize at interpreter start). We must
+  both force JAX_PLATFORMS=cpu AND deregister the axon backend factory:
+  initializing the axon plugin dials the tunnel and can block the whole
+  process if the tunnel is unhealthy — tests must never depend on it.
 """
 
 import os
 
-# Force, don't setdefault: the machine environment presets
-# JAX_PLATFORMS=axon (the real-TPU tunnel) and tests must be
-# deterministic on the virtual CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Belt and suspenders for subprocesses spawned by tests.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+try:  # deregister the axon PJRT plugin installed by sitecustomize
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    # sitecustomize's register() may have snapshotted jax_platforms=axon
+    # before this conftest ran; force it back.
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # pragma: no cover - jax internals moved; env vars still apply
+    pass

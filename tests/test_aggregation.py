@@ -1,0 +1,129 @@
+"""Resilient aggregation kernel: property tests + golden vs the actual
+reference TF implementation (SURVEY.md §4 test strategy)."""
+
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from rcmarl_tpu.ops import resilient_aggregate, resilient_aggregate_tree
+
+
+def _reference_aggregator():
+    """Load the reference RPBCAC agent class and expose its
+    _resilient_aggregation without constructing Keras models."""
+    try:
+        sys.path.insert(0, "/root/reference")
+        from agents.resilient_CAC_agents import RPBCAC_agent  # type: ignore
+
+        def agg(values, H):
+            obj = RPBCAC_agent.__new__(RPBCAC_agent)
+            obj.H = H
+            return np.asarray(obj._resilient_aggregation(values))
+
+        return agg
+    except Exception:
+        return None
+    finally:
+        sys.path.remove("/root/reference")
+
+
+REF_AGG = _reference_aggregator()
+
+
+def test_hand_computed_example():
+    # own=5, neighbors 1, 9, 3; H=1: sorted [1,3,5,9], lower=min(3,5)=3,
+    # upper=max(5,5)=5; clip -> [5,3,5,3]; mean 4.
+    vals = jnp.array([[5.0], [1.0], [9.0], [3.0]])
+    out = resilient_aggregate(vals, H=1)
+    np.testing.assert_allclose(np.asarray(out), [4.0])
+
+
+def test_h0_is_plain_mean():
+    vals = jax.random.normal(jax.random.PRNGKey(0), (4, 7, 3))
+    np.testing.assert_allclose(
+        np.asarray(resilient_aggregate(vals, H=0)),
+        np.asarray(vals.mean(axis=0)),
+        rtol=1e-6,
+    )
+
+
+def test_permutation_invariance_of_nonself_neighbors():
+    key = jax.random.PRNGKey(1)
+    vals = jax.random.normal(key, (5, 11))
+    out = resilient_aggregate(vals, H=2)
+    perm = jnp.concatenate([vals[:1], vals[jnp.array([3, 1, 4, 2])]])
+    out_p = resilient_aggregate(perm, H=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_p), rtol=1e-6)
+
+
+def test_output_bounded_by_own_range():
+    # The aggregate lies within [min(lower, own), max(upper, own)] and,
+    # since own is always inside the clip bounds, within the clip range.
+    key = jax.random.PRNGKey(2)
+    for H in (1, 2):
+        vals = jax.random.normal(key, (6, 50)) * 10
+        out = np.asarray(resilient_aggregate(vals, H=H))
+        v = np.asarray(vals)
+        own = v[0]
+        sv = np.sort(v, axis=0)
+        lower = np.minimum(sv[H], own)
+        upper = np.maximum(sv[-H - 1], own)
+        assert (out >= lower - 1e-6).all() and (out <= upper + 1e-6).all()
+
+
+def test_adversary_cannot_drag_outside_cooperative_range():
+    # With <=H adversaries sending arbitrarily extreme values, the bounds
+    # are set by cooperative values and own value.
+    coop = jnp.array([[1.0], [2.0], [3.0]])
+    for extreme in (1e9, -1e9):
+        vals = jnp.concatenate([coop, jnp.array([[extreme]])])
+        out = float(resilient_aggregate(vals, H=1)[0])
+        assert 1.0 - 1e-6 <= out <= 3.0 + 1e-6
+
+
+def test_invalid_H_raises():
+    vals = jnp.zeros((4, 2))
+    with pytest.raises(ValueError):
+        resilient_aggregate(vals, H=2)  # need 2H <= n_in-1 = 3
+
+
+def test_tree_version_matches_leafwise():
+    key = jax.random.PRNGKey(3)
+    k1, k2 = jax.random.split(key)
+    tree = {"W": jax.random.normal(k1, (4, 3, 5)), "b": jax.random.normal(k2, (4, 5))}
+    out = resilient_aggregate_tree(tree, H=1)
+    np.testing.assert_allclose(
+        np.asarray(out["W"]), np.asarray(resilient_aggregate(tree["W"], 1)), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["b"]), np.asarray(resilient_aggregate(tree["b"], 1)), rtol=1e-6
+    )
+
+
+@pytest.mark.skipif(REF_AGG is None, reason="reference agent not importable")
+def test_golden_vs_reference_tf_implementation():
+    rng = np.random.default_rng(0)
+    for trial in range(10):
+        n_in = int(rng.integers(3, 8))
+        H = int(rng.integers(0, (n_in - 1) // 2 + 1))
+        shape = (n_in,) + tuple(rng.integers(1, 6, size=int(rng.integers(1, 3))))
+        vals = rng.normal(size=shape).astype(np.float32)
+        ref = REF_AGG(vals, H)
+        mine = np.asarray(resilient_aggregate(jnp.asarray(vals), H))
+        np.testing.assert_allclose(mine, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_vmap_over_agents():
+    # Batched over an agent axis: (N, n_in, P)
+    vals = jax.random.normal(jax.random.PRNGKey(4), (6, 5, 13))
+    out = jax.vmap(lambda v: resilient_aggregate(v, H=1))(vals)
+    for i in range(6):
+        np.testing.assert_allclose(
+            np.asarray(out[i]),
+            np.asarray(resilient_aggregate(vals[i], H=1)),
+            rtol=1e-5,
+            atol=1e-6,
+        )
