@@ -1,0 +1,26 @@
+from rcmarl_tpu.training.buffer import (  # noqa: F401
+    ReplayBuffer,
+    buffer_init,
+    buffer_push_block,
+    update_batch,
+)
+from rcmarl_tpu.training.rollout import (  # noqa: F401
+    EpisodeMetrics,
+    rollout_block,
+    rollout_episode,
+    sample_actions,
+)
+from rcmarl_tpu.training.trainer import (  # noqa: F401
+    TrainState,
+    init_train_state,
+    make_env,
+    metrics_to_dataframe,
+    train,
+    train_block,
+    train_scanned,
+)
+from rcmarl_tpu.training.update import (  # noqa: F401
+    init_agent_params,
+    team_average_reward,
+    update_block,
+)

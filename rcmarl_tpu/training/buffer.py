@@ -1,0 +1,95 @@
+"""Fixed-capacity experience replay as an on-device ring buffer.
+
+The reference keeps growing Python lists, FIFO-trimmed to ``buffer_size``
+after each update block (``train_agents.py:36-42,76-80,158-163``), so the
+update batch is 1000 rows after block 0, 2000 after block 1, and 3000 at
+steady state. Growing shapes are hostile to XLA, so here the kept buffer is
+a static ``(buffer_size, ...)`` ring in HBM with a validity count; the
+update batch is the (static-shape) concatenation of the kept ring and the
+fresh block, masked to the valid rows — numerically identical to the
+reference's growing window because every consumer is order-independent
+(full-batch fits, shuffled mini-batch fits, per-row TD targets) and the
+on-policy actor window is passed separately.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from rcmarl_tpu.agents.updates import Batch
+
+
+class ReplayBuffer(NamedTuple):
+    """Ring of transitions, every array (capacity, n_agents, ...)."""
+
+    s: jnp.ndarray  # (C, N, n_states) scaled states
+    ns: jnp.ndarray  # (C, N, n_states)
+    a: jnp.ndarray  # (C, N, 1) float action indices
+    r: jnp.ndarray  # (C, N, 1) scaled rewards
+    ptr: jnp.ndarray  # () int32 next write position
+    count: jnp.ndarray  # () int32 number of valid rows
+
+    @property
+    def capacity(self) -> int:
+        return self.s.shape[0]
+
+    @property
+    def mask(self) -> jnp.ndarray:
+        """(C,) float32 validity. Ring order is irrelevant to consumers."""
+        return (jnp.arange(self.capacity) < self.count).astype(jnp.float32)
+
+
+def buffer_init(capacity: int, n_agents: int, n_states: int) -> ReplayBuffer:
+    return ReplayBuffer(
+        s=jnp.zeros((capacity, n_agents, n_states), jnp.float32),
+        ns=jnp.zeros((capacity, n_agents, n_states), jnp.float32),
+        a=jnp.zeros((capacity, n_agents, 1), jnp.float32),
+        r=jnp.zeros((capacity, n_agents, 1), jnp.float32),
+        ptr=jnp.zeros((), jnp.int32),
+        count=jnp.zeros((), jnp.int32),
+    )
+
+
+def buffer_push_block(buf: ReplayBuffer, fresh: Batch) -> ReplayBuffer:
+    """Insert a block of transitions with wraparound (the post-update FIFO
+    trim of ``train_agents.py:158-163``: once full, each push overwrites the
+    oldest rows)."""
+    block = fresh.s.shape[0]
+    if block >= buf.capacity:
+        # Block alone overflows the ring: keep its LAST `capacity` rows
+        # (reference trim keeps the newest buffer_size rows). A modular
+        # scatter would have duplicate indices with unspecified winners.
+        keep = jax.tree.map(lambda x: x[block - buf.capacity :], fresh)
+        return ReplayBuffer(
+            s=keep.s,
+            ns=keep.ns,
+            a=keep.a,
+            r=keep.r,
+            ptr=jnp.zeros((), jnp.int32),
+            count=jnp.full((), buf.capacity, jnp.int32),
+        )
+    idx = (buf.ptr + jnp.arange(block)) % buf.capacity
+    return ReplayBuffer(
+        s=buf.s.at[idx].set(fresh.s),
+        ns=buf.ns.at[idx].set(fresh.ns),
+        a=buf.a.at[idx].set(fresh.a),
+        r=buf.r.at[idx].set(fresh.r),
+        ptr=(buf.ptr + block) % buf.capacity,
+        count=jnp.minimum(buf.count + block, buf.capacity),
+    )
+
+
+def update_batch(buf: ReplayBuffer, fresh: Batch) -> Batch:
+    """The batch an update block sees: kept rows + the fresh block
+    (reference semantics: updates run BEFORE the trim, over up to
+    buffer_size + block rows)."""
+    return Batch(
+        s=jnp.concatenate([buf.s, fresh.s], axis=0),
+        ns=jnp.concatenate([buf.ns, fresh.ns], axis=0),
+        a=jnp.concatenate([buf.a, fresh.a], axis=0),
+        r=jnp.concatenate([buf.r, fresh.r], axis=0),
+        mask=jnp.concatenate([buf.mask, fresh.mask], axis=0),
+    )

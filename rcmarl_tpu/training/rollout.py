@@ -1,0 +1,146 @@
+"""On-device episode rollout as a jitted ``lax.scan``.
+
+TPU-native rebuild of the reference's rollout hot loop
+(``train_agents.py:46-80``), which issues n_agents x max_ep_len Keras
+``predict`` calls on batches of 1 per episode (~2.5 env-steps/s). Here a
+whole update block — ``n_ep_fixed`` episodes x ``max_ep_len`` steps — is
+one XLA program: vmapped policy forward for all agents at once, the pure
+grid-world step, and metric accumulation, scanned over steps and episodes
+with zero host round-trips.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from rcmarl_tpu.agents.updates import AgentParams, Batch
+from rcmarl_tpu.config import Config
+from rcmarl_tpu.envs.grid_world import (
+    GridWorld,
+    env_reset,
+    env_step,
+    scale_reward,
+    scale_state,
+)
+from rcmarl_tpu.models.mlp import actor_probs, mlp_forward
+
+
+class EpisodeMetrics(NamedTuple):
+    """Per-episode scalars matching the reference's sim_data columns
+    (``train_agents.py:175-179``)."""
+
+    true_team_returns: jnp.ndarray  # mean discounted return, cooperative agents
+    true_adv_returns: jnp.ndarray  # mean discounted return, adversaries
+    est_team_returns: jnp.ndarray  # mean cooperative critic V(s0)
+
+
+def sample_actions(
+    cfg: Config, actor: object, state_scaled: jnp.ndarray, key: jax.Array
+) -> jnp.ndarray:
+    """eps-mixed policy sampling for all agents at once.
+
+    Reference ``get_action`` (resilient_CAC_agents.py:208-219): sample the
+    softmax policy, then with prob. ``mu`` replace by a uniform random
+    action. Every agent observes the same GLOBAL state; each applies its
+    own actor. Returns (N,) int32.
+    """
+    n = cfg.n_agents
+    # (N, batch=1, N, n_states): same global state for every agent
+    obs = jnp.broadcast_to(state_scaled[None], (n, *state_scaled.shape))[:, None]
+    probs = jax.vmap(lambda p, x: actor_probs(p, x, cfg.leaky_alpha))(actor, obs)
+    k_pol, k_rand, k_mix = jax.random.split(key, 3)
+    policy_a = jax.vmap(jax.random.categorical)(
+        jax.random.split(k_pol, n), jnp.log(probs[:, 0, :])
+    )
+    random_a = jax.random.randint(k_rand, (n,), 0, cfg.n_actions)
+    use_random = jax.random.bernoulli(k_mix, cfg.eps_explore, (n,))
+    return jnp.where(use_random, random_a, policy_a).astype(jnp.int32)
+
+
+def rollout_episode(
+    cfg: Config,
+    env: GridWorld,
+    params: AgentParams,
+    desired: jnp.ndarray,
+    key: jax.Array,
+    initial: jnp.ndarray = None,
+) -> Tuple[Batch, EpisodeMetrics]:
+    """One episode: reset, ``max_ep_len`` steps, per-episode metrics
+    evaluated with the CURRENT (episode-start) parameters, exactly as the
+    reference interleaves metric evaluation with training
+    (``train_agents.py:55-71``).
+
+    Reset honors ``cfg.randomize_state`` (reference ``grid_world.py:39-43``):
+    random positions by default, else the fixed ``initial`` layout drawn at
+    startup (reference ``main.py:49``).
+    """
+    k_reset, k_steps = jax.random.split(key)
+    if cfg.randomize_state or initial is None:
+        pos0 = env_reset(env, k_reset)
+    else:
+        pos0 = initial
+
+    # Estimated team returns at s0 (train_agents.py:60-62)
+    s0 = scale_state(env, pos0)
+    coop = jnp.asarray(cfg.coop_mask)
+    v0 = jax.vmap(lambda p: mlp_forward(p, s0[None].reshape(1, -1))[0, 0])(
+        params.critic
+    )  # (N,)
+    est = jnp.sum(jnp.where(coop, v0, 0.0)) / max(cfg.n_coop, 1)
+
+    def step(carry, k):
+        pos, ret, j = carry
+        s_scaled = scale_state(env, pos)
+        actions = sample_actions(cfg, params.actor, s_scaled, k)
+        npos, reward = env_step(env, pos, desired, actions)
+        r_scaled = scale_reward(env, reward)  # (N,)
+        ret = ret + r_scaled * cfg.gamma**j
+        out = (
+            s_scaled,
+            scale_state(env, npos),
+            actions.astype(jnp.float32)[:, None],
+            r_scaled[:, None],
+        )
+        return (npos, ret, j + 1.0), out
+
+    (_, ep_returns, _), (s, ns, a, r) = jax.lax.scan(
+        step,
+        (pos0, jnp.zeros((cfg.n_agents,)), 0.0),
+        jax.random.split(k_steps, cfg.max_ep_len),
+    )
+
+    true_team = jnp.sum(jnp.where(coop, ep_returns, 0.0)) / max(cfg.n_coop, 1)
+    true_adv = jnp.sum(jnp.where(coop, 0.0, ep_returns)) / max(cfg.n_adv, 1)
+    batch = Batch(s=s, ns=ns, a=a, r=r, mask=jnp.ones((cfg.max_ep_len,), jnp.float32))
+    return batch, EpisodeMetrics(true_team, true_adv, est)
+
+
+def rollout_block(
+    cfg: Config,
+    env: GridWorld,
+    params: AgentParams,
+    desired: jnp.ndarray,
+    key: jax.Array,
+    initial: jnp.ndarray = None,
+) -> Tuple[Batch, EpisodeMetrics]:
+    """``n_ep_fixed`` consecutive episodes under frozen parameters (the
+    reference only updates at block boundaries, ``train_agents.py:86``).
+
+    Returns the fresh on-policy window as a flat (block_steps, N, ...)
+    batch — exactly the ``s[-max_ep_len*n_ep_fixed:]`` actor window of
+    ``train_agents.py:149-153`` — plus per-episode metrics (n_ep_fixed,).
+    """
+
+    def one_ep(_, k):
+        return None, rollout_episode(cfg, env, params, desired, k, initial)
+
+    _, (ep_batch, metrics) = jax.lax.scan(
+        one_ep, None, jax.random.split(key, cfg.n_ep_fixed)
+    )
+    flat = jax.tree.map(
+        lambda x: x.reshape(cfg.block_steps, *x.shape[2:]), ep_batch
+    )
+    return flat, metrics

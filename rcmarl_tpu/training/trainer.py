@@ -1,0 +1,188 @@
+"""Top-level trainer: blocks of rollout + update, host- or device-looped.
+
+TPU-native twin of ``train_RPBCAC`` (reference ``training/train_agents.py:
+15-184``). A *block* is ``n_ep_fixed`` episodes followed by one update
+(phases I-IV); the whole block is a single jitted program. Two drivers:
+
+- :func:`train` — host loop over blocks (jit per block): supports
+  checkpointing, logging and warm-start, compiles once, and matches the
+  reference's observable behavior episode-for-episode.
+- :func:`train_scanned` — the entire run as ONE ``lax.scan`` over blocks
+  (used by the benchmark and by seed-parallel sharding, where the host
+  must stay out of the loop entirely).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rcmarl_tpu.agents.updates import AgentParams, Batch
+from rcmarl_tpu.config import Config
+from rcmarl_tpu.envs.grid_world import GridWorld, env_reset
+from rcmarl_tpu.training.buffer import (
+    ReplayBuffer,
+    buffer_init,
+    buffer_push_block,
+    update_batch,
+)
+from rcmarl_tpu.training.rollout import EpisodeMetrics, rollout_block
+from rcmarl_tpu.training.update import init_agent_params, update_block
+
+
+class TrainState(NamedTuple):
+    """Everything that evolves across blocks (one checkpointable pytree)."""
+
+    params: AgentParams
+    buffer: ReplayBuffer
+    desired: jnp.ndarray  # (N, 2) int32 goal layout, fixed for the run
+    initial: jnp.ndarray  # (N, 2) int32 reset layout (randomize_state=False)
+    key: jax.Array
+    block: jnp.ndarray  # () int32 completed-block counter
+
+
+def make_env(cfg: Config) -> GridWorld:
+    return GridWorld(
+        nrow=cfg.nrow,
+        ncol=cfg.ncol,
+        n_agents=cfg.n_agents,
+        scaling=cfg.scaling,
+        collision_physics=cfg.collision_physics,
+    )
+
+
+def init_train_state(
+    cfg: Config,
+    key: jax.Array,
+    desired: Optional[jnp.ndarray] = None,
+    params: Optional[AgentParams] = None,
+    buffer: Optional[ReplayBuffer] = None,
+) -> TrainState:
+    """Fresh run state. The goal and initial layouts are drawn once at
+    startup from the run's RNG, as the reference draws ``s_desired`` and
+    ``s_initial`` before training (``main.py:48-49``); pass ``desired``/
+    ``params`` to resume."""
+    k_desired, k_initial, k_params, k_run = jax.random.split(key, 4)
+    env = make_env(cfg)
+    if desired is None:
+        desired = env_reset(env, k_desired)
+    initial = env_reset(env, k_initial)
+    if params is None:
+        params = init_agent_params(k_params, cfg)
+    if buffer is None:
+        buffer = buffer_init(cfg.buffer_size, cfg.n_agents, cfg.n_states)
+    return TrainState(
+        params=params,
+        buffer=buffer,
+        desired=jnp.asarray(desired, jnp.int32),
+        initial=jnp.asarray(initial, jnp.int32),
+        key=k_run,
+        block=jnp.zeros((), jnp.int32),
+    )
+
+
+@partial(jax.jit, static_argnums=0)
+def train_block(cfg: Config, state: TrainState) -> Tuple[TrainState, EpisodeMetrics]:
+    """One block: rollout ``n_ep_fixed`` episodes, update, push to buffer.
+
+    Jitted once per (frozen, hashable) Config — repeated ``train`` calls
+    with the same config reuse the compiled program.
+    """
+    env = make_env(cfg)
+    key, k_roll, k_upd = jax.random.split(state.key, 3)
+    fresh, metrics = rollout_block(
+        cfg, env, state.params, state.desired, k_roll, state.initial
+    )
+    batch = update_batch(state.buffer, fresh)
+    params = update_block(cfg, state.params, batch, fresh, k_upd)
+    buffer = buffer_push_block(state.buffer, fresh)
+    return (
+        TrainState(
+            params, buffer, state.desired, state.initial, key, state.block + 1
+        ),
+        metrics,
+    )
+
+
+def train_scanned(
+    cfg: Config, state: TrainState, n_blocks: int
+) -> Tuple[TrainState, EpisodeMetrics]:
+    """``n_blocks`` blocks as one ``lax.scan`` — zero host round-trips.
+
+    Returned metrics leaves have shape (n_blocks * n_ep_fixed,) == one row
+    per episode, flattened in episode order.
+    """
+
+    def body(s, _):
+        return train_block(cfg, s)
+
+    state, metrics = jax.lax.scan(body, state, None, length=n_blocks)
+    return state, jax.tree.map(lambda x: x.reshape(-1), metrics)
+
+
+def metrics_to_dataframe(metrics: EpisodeMetrics):
+    """Per-episode metrics -> the reference's sim_data DataFrame layout
+    (columns ``True_team_returns`` / ``True_adv_returns`` /
+    ``Estimated_team_returns``, one row per episode;
+    ``train_agents.py:175-183``) so the reference's plotting pipeline works
+    unchanged on our outputs."""
+    import pandas as pd
+
+    return pd.DataFrame(
+        {
+            "True_team_returns": np.asarray(metrics.true_team_returns),
+            "True_adv_returns": np.asarray(metrics.true_adv_returns),
+            "Estimated_team_returns": np.asarray(metrics.est_team_returns),
+        }
+    )
+
+
+def train(
+    cfg: Config,
+    n_episodes: Optional[int] = None,
+    state: Optional[TrainState] = None,
+    verbose: bool = False,
+    block_callback=None,
+):
+    """Host-looped training run (the ``train_RPBCAC`` equivalent).
+
+    Args:
+      n_episodes: override cfg.n_episodes; must be a multiple of
+        ``n_ep_fixed`` (the reference silently never updates on a trailing
+        partial block; we reject it instead).
+      state: resume from a prior TrainState (warm-started buffer included,
+        the ``exp_buffer`` feature of ``train_agents.py:15``).
+      block_callback: called as ``f(state, block_idx)`` after each block
+        (checkpoint hook).
+
+    Returns (state, sim_data DataFrame with one row per episode).
+    """
+    n_eps = cfg.n_episodes if n_episodes is None else n_episodes
+    if n_eps % cfg.n_ep_fixed != 0:
+        raise ValueError(
+            f"n_episodes={n_eps} must be a multiple of n_ep_fixed={cfg.n_ep_fixed}"
+        )
+    n_blocks = n_eps // cfg.n_ep_fixed
+    if state is None:
+        state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
+
+    all_metrics = []
+    for b in range(n_blocks):
+        state, m = train_block(cfg, state)
+        all_metrics.append(m)
+        if verbose:
+            tt = float(jnp.mean(m.true_team_returns))
+            et = float(jnp.mean(m.est_team_returns))
+            print(
+                f"| Block {int(state.block)} | episodes {(b + 1) * cfg.n_ep_fixed}"
+                f" | team return {tt:.3f} | est return {et:.3f}"
+            )
+        if block_callback is not None:
+            block_callback(state, b)
+
+    metrics = jax.tree.map(lambda *xs: jnp.concatenate(xs), *all_metrics)
+    return state, metrics_to_dataframe(metrics)

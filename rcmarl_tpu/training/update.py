@@ -1,0 +1,229 @@
+"""The update block: phases I-III as one jitted XLA program.
+
+Rebuild of the reference's update schedule (``train_agents.py:86-163``,
+SURVEY.md §3.3): per epoch, (I) local critic/TR fits for every agent
+produce the transmitted messages, (II) cooperative agents run resilient
+consensus over their in-neighborhoods, then (III) once per block, actor
+updates over the fresh on-policy window. The reference dispatches on
+agent-label strings in Python loops; here heterogeneous behavior is
+compute-per-role + masked select over stacked parameters, with role
+composition STATIC (from Config) so absent roles are never traced.
+
+Epoch-loop semantics preserved exactly (SURVEY.md §7 trap 2): consensus
+inputs are the SAME epoch's phase-I messages (synchronous simultaneous
+exchange); cooperative agents' own nets are restored after the local fit
+(the local step produces the message, not a state change); hidden-layer
+consensus mutates the trunk BEFORE the projection step evaluates neighbor
+heads on it.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rcmarl_tpu.agents.updates import (
+    AgentParams,
+    Batch,
+    adv_actor_update,
+    adv_critic_fit,
+    adv_tr_fit,
+    consensus_update_one,
+    coop_actor_update,
+    coop_local_critic_fit,
+    coop_local_tr_fit,
+    select_tree,
+)
+from rcmarl_tpu.config import Config, Roles
+from rcmarl_tpu.models.mlp import init_stacked_mlp
+from rcmarl_tpu.ops.optim import adam_init
+
+
+def init_agent_params(key: jax.Array, cfg: Config) -> AgentParams:
+    """All-agent learnable state; each agent draws an independent
+    Glorot-uniform init, as the reference builds N Keras models in a loop
+    (``main.py:56-82``). ``critic_local`` (the malicious agent's private
+    critic, ``adversarial_CAC_agents.py:99``) gets its own draw."""
+    k_a, k_c, k_t, k_l = jax.random.split(key, 4)
+    actor = init_stacked_mlp(k_a, cfg.n_agents, cfg.obs_dim, cfg.hidden, cfg.n_actions)
+    critic = init_stacked_mlp(k_c, cfg.n_agents, cfg.obs_dim, cfg.hidden, 1)
+    tr = init_stacked_mlp(k_t, cfg.n_agents, cfg.sa_dim, cfg.hidden, 1)
+    critic_local = init_stacked_mlp(k_l, cfg.n_agents, cfg.obs_dim, cfg.hidden, 1)
+    actor_opt = jax.vmap(adam_init)(actor)
+    return AgentParams(actor, critic, tr, critic_local, actor_opt)
+
+
+def _role_mask(cfg: Config, role: int) -> jnp.ndarray:
+    return jnp.asarray(np.array(cfg.agent_roles) == role)
+
+
+def team_average_reward(cfg: Config, r: jnp.ndarray) -> jnp.ndarray:
+    """r_coop: mean reward of cooperative agents (``train_agents.py:96-98``).
+
+    r: (B, N, 1) -> (B, 1).
+    """
+    coop = jnp.asarray(cfg.coop_mask, jnp.float32)[None, :, None]
+    return jnp.sum(r * coop, axis=1) / max(cfg.n_coop, 1)
+
+
+def critic_tr_epoch(
+    cfg: Config, carry, batch: Batch, r_coop: jnp.ndarray, ekey: jax.Array
+):
+    """One epoch of phases I+II over stacked params.
+
+    carry = (critic, tr, critic_local), each leaf (N, ...).
+    """
+    critic, tr, critic_local = carry
+    s, ns, sa, mask = batch.s, batch.ns, batch.sa, batch.mask
+    r_agents = jnp.moveaxis(batch.r, 1, 0)  # (N, B, 1) per-agent rewards
+    N = cfg.n_agents
+
+    # ---- Phase I: local fits -> messages (+ persisted adversary updates)
+    msg_critic, msg_tr = critic, tr  # Faulty default: transmit frozen nets
+    new_critic, new_tr, new_critic_local = critic, tr, critic_local
+
+    if cfg.n_coop:
+        # common_reward applies to cooperative local fits ONLY
+        # (train_agents.py:106)
+        if cfg.common_reward:
+            r_applied = jnp.broadcast_to(r_coop[None], (N, *r_coop.shape))
+        else:
+            r_applied = r_agents
+        coop_c = jax.vmap(
+            lambda p, r: coop_local_critic_fit(p, s, ns, r, mask, cfg)
+        )(critic, r_applied)
+        coop_t = jax.vmap(lambda p, r: coop_local_tr_fit(p, sa, r, mask, cfg))(
+            tr, r_applied
+        )
+        m = _role_mask(cfg, Roles.COOPERATIVE)
+        msg_critic = select_tree(m, coop_c, msg_critic)
+        msg_tr = select_tree(m, coop_t, msg_tr)
+        # own nets restored (resilient_CAC_agents.py:120,138): new_* unchanged
+
+    k_gc, k_gt, k_ml, k_mc, k_mt = jax.random.split(ekey, 5)
+
+    if cfg.has_role(Roles.GREEDY):
+        greedy_c = jax.vmap(
+            lambda k, p, r: adv_critic_fit(k, p, s, ns, r, mask, cfg)
+        )(jax.random.split(k_gc, N), critic, r_agents)
+        greedy_t = jax.vmap(lambda k, p, r: adv_tr_fit(k, p, sa, r, mask, cfg))(
+            jax.random.split(k_gt, N), tr, r_agents
+        )
+        m = _role_mask(cfg, Roles.GREEDY)
+        msg_critic = select_tree(m, greedy_c, msg_critic)
+        msg_tr = select_tree(m, greedy_t, msg_tr)
+        new_critic = select_tree(m, greedy_c, new_critic)  # persists
+        new_tr = select_tree(m, greedy_t, new_tr)
+
+    if cfg.has_role(Roles.MALICIOUS):
+        # private critic on own reward (adversarial_CAC_agents.py:137-152)
+        mal_local = jax.vmap(
+            lambda k, p, r: adv_critic_fit(k, p, s, ns, r, mask, cfg)
+        )(jax.random.split(k_ml, N), critic_local, r_agents)
+        # compromised critic/TR toward -r_coop (adversarial:121-135,154-165)
+        neg = jnp.broadcast_to(-r_coop[None], (N, *r_coop.shape))
+        mal_c = jax.vmap(
+            lambda k, p, r: adv_critic_fit(k, p, s, ns, r, mask, cfg)
+        )(jax.random.split(k_mc, N), critic, neg)
+        mal_t = jax.vmap(lambda k, p, r: adv_tr_fit(k, p, sa, r, mask, cfg))(
+            jax.random.split(k_mt, N), tr, neg
+        )
+        m = _role_mask(cfg, Roles.MALICIOUS)
+        msg_critic = select_tree(m, mal_c, msg_critic)
+        msg_tr = select_tree(m, mal_t, msg_tr)
+        new_critic = select_tree(m, mal_c, new_critic)  # persists
+        new_tr = select_tree(m, mal_t, new_tr)
+        new_critic_local = select_tree(m, mal_local, new_critic_local)
+
+    # ---- Phase II: resilient consensus, cooperative agents only
+    if cfg.n_coop:
+        in_arr = jnp.asarray(np.array(cfg.in_nodes))  # (N, n_in)
+        nbr_c = jax.tree.map(lambda l: l[in_arr], msg_critic)  # (N, n_in, ...)
+        nbr_t = jax.tree.map(lambda l: l[in_arr], msg_tr)
+        cons = jax.vmap(
+            lambda own, nbr, x: consensus_update_one(own, nbr, x, mask, cfg),
+            in_axes=(0, 0, None),
+        )
+        m = _role_mask(cfg, Roles.COOPERATIVE)
+        new_critic = select_tree(m, cons(new_critic, nbr_c, s), new_critic)
+        new_tr = select_tree(m, cons(new_tr, nbr_t, sa), new_tr)
+
+    return new_critic, new_tr, new_critic_local
+
+
+def actor_phase(
+    cfg: Config, params: AgentParams, fresh: Batch, key: jax.Array
+) -> Tuple[object, object]:
+    """Phase III: actor updates over the fresh on-policy window
+    (``train_agents.py:149-153``). Returns (new_actor, new_actor_opt)."""
+    s, ns, sa = fresh.s, fresh.ns, fresh.sa
+    a_own = jnp.moveaxis(fresh.a[..., 0], 1, 0).astype(jnp.int32)  # (N, B)
+    r_own = jnp.moveaxis(fresh.r, 1, 0)  # (N, B, 1)
+    N = cfg.n_agents
+
+    new_actor, new_opt = params.actor, params.actor_opt
+    if cfg.n_coop:
+        coop_a, coop_o = jax.vmap(
+            lambda ac, op, cr, t, a: coop_actor_update(
+                ac, op, cr, t, s, ns, sa, a, cfg
+            )
+        )(params.actor, params.actor_opt, params.critic, params.tr, a_own)
+        m = _role_mask(cfg, Roles.COOPERATIVE)
+        new_actor = select_tree(m, coop_a, new_actor)
+        new_opt = select_tree(m, coop_o, new_opt)
+
+    if cfg.n_adv:
+        # Malicious agents drive their actor with the PRIVATE local critic
+        # (adversarial_CAC_agents.py:102-119); greedy/faulty use their own.
+        critic_in = select_tree(
+            _role_mask(cfg, Roles.MALICIOUS), params.critic_local, params.critic
+        )
+        adv_a, adv_o = jax.vmap(
+            lambda k, ac, op, cr, r, a: adv_actor_update(
+                k, ac, op, cr, s, ns, r, a, cfg
+            )
+        )(
+            jax.random.split(key, N),
+            params.actor,
+            params.actor_opt,
+            critic_in,
+            r_own,
+            a_own,
+        )
+        m = jnp.asarray(~np.array(cfg.coop_mask))
+        new_actor = select_tree(m, adv_a, new_actor)
+        new_opt = select_tree(m, adv_o, new_opt)
+
+    return new_actor, new_opt
+
+
+@partial(jax.jit, static_argnums=0)
+def update_block(
+    cfg: Config, params: AgentParams, batch: Batch, fresh: Batch, key: jax.Array
+) -> AgentParams:
+    """Full update block: ``n_epochs`` x (phase I + II) then phase III.
+
+    Args:
+      params: stacked agent state.
+      batch: replay window (kept buffer + fresh block), masked.
+      fresh: the on-policy actor window (fully valid).
+      key: PRNG key for adversary fit shuffles and actor minibatching.
+    """
+    r_coop = team_average_reward(cfg, batch.r)
+    k_epochs, k_actor = jax.random.split(key)
+
+    def epoch(carry, ekey):
+        return critic_tr_epoch(cfg, carry, batch, r_coop, ekey), None
+
+    (critic, tr, critic_local), _ = jax.lax.scan(
+        epoch,
+        (params.critic, params.tr, params.critic_local),
+        jax.random.split(k_epochs, cfg.n_epochs),
+    )
+    params = params._replace(critic=critic, tr=tr, critic_local=critic_local)
+    actor, actor_opt = actor_phase(cfg, params, fresh, k_actor)
+    return params._replace(actor=actor, actor_opt=actor_opt)
