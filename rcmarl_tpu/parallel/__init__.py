@@ -1,0 +1,7 @@
+from rcmarl_tpu.parallel.seeds import (  # noqa: F401
+    init_states,
+    make_mesh,
+    state_shardings,
+    train_block_parallel,
+    train_parallel,
+)

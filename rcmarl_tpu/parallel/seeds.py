@@ -1,0 +1,166 @@
+"""Seed- and agent-axis parallelism over a TPU device mesh.
+
+The reference achieves seed parallelism by submitting independent SGE jobs
+(``simulation_results/raw_data/*/job.sh``, SURVEY.md C15) and has no other
+parallel axis. Here both axes are first-class sharding dimensions of ONE
+jitted program over a ``jax.sharding.Mesh``:
+
+- ``seed`` axis (data parallel): independent training replicas, vmapped
+  over a leading seed axis and sharded across chips. No cross-replica
+  communication — XLA partitions the program with zero collectives, so it
+  scales embarrassingly over ICI and DCN alike.
+- ``agent`` axis (model parallel): the stacked per-agent parameters can
+  additionally be sharded over agents. The consensus gather
+  ``msgs[in_nodes]`` then lowers to an XLA all-gather/collective-permute
+  over ICI — the TPU-native twin of the reference's in-memory weight-list
+  exchange (SURVEY.md C16).
+
+The entry point is :func:`train_parallel`; sharding specs are derived
+structurally from the TrainState field layout by :func:`state_shardings`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from rcmarl_tpu.config import Config
+from rcmarl_tpu.training.rollout import EpisodeMetrics
+from rcmarl_tpu.training.trainer import (
+    TrainState,
+    init_train_state,
+    train_block,
+    train_scanned,
+)
+
+
+def make_mesh(
+    n_devices: Optional[int] = None, seed_axis: Optional[int] = None
+) -> Mesh:
+    """A ('seed', 'agent') mesh over the first ``n_devices`` devices.
+
+    ``seed_axis`` fixes the seed-parallel extent; the agent axis gets the
+    rest. Defaults put everything on the seed axis (the scaling axis that
+    matters at reference model sizes)."""
+    all_devs = jax.devices()
+    if n_devices is not None and n_devices > len(all_devs):
+        raise ValueError(
+            f"requested {n_devices} devices, only {len(all_devs)} available"
+        )
+    devs = all_devs if n_devices is None else all_devs[:n_devices]
+    n = len(devs)
+    if seed_axis is None:
+        seed_axis = n
+    if n % seed_axis != 0:
+        raise ValueError(f"seed_axis={seed_axis} must divide device count {n}")
+    import numpy as np
+
+    return Mesh(
+        np.asarray(devs).reshape(seed_axis, n // seed_axis), ("seed", "agent")
+    )
+
+
+def state_shardings(
+    mesh: Mesh, state_batched: TrainState, shard_agents: bool = True
+) -> TrainState:
+    """NamedShardings for a seed-batched TrainState (leaves carry a leading
+    seed axis), built structurally field by field.
+
+    Field layout (axis holding the agent dimension, after the seed axis):
+      params.*        (S, N, ...)    -> agent at 1
+      buffer.s/ns/a/r (S, C, N, ...) -> agent at 2
+      buffer.ptr/count, key, block   -> seed only
+      desired/initial (S, N, 2)      -> agent at 1
+    """
+    a = "agent" if shard_agents else None
+
+    def ns(spec):
+        return NamedSharding(mesh, spec)
+
+    def fill(subtree, spec):
+        return jax.tree.map(lambda _: ns(spec), subtree)
+
+    buf = state_batched.buffer
+    return TrainState(
+        params=fill(state_batched.params, P("seed", a)),
+        buffer=buf._replace(
+            s=ns(P("seed", None, a)),
+            ns=ns(P("seed", None, a)),
+            a=ns(P("seed", None, a)),
+            r=ns(P("seed", None, a)),
+            ptr=ns(P("seed")),
+            count=ns(P("seed")),
+        ),
+        desired=ns(P("seed", a)),
+        initial=ns(P("seed", a)),
+        key=ns(P("seed")),
+        block=ns(P("seed")),
+    )
+
+
+def init_states(cfg: Config, seeds) -> TrainState:
+    """vmapped :func:`init_train_state` over a batch of integer seeds —
+    each replica draws its own goal layout, initial layout, and parameter
+    init, exactly like independent reference jobs."""
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seeds, jnp.uint32))
+    return jax.vmap(lambda k: init_train_state(cfg, k))(keys)
+
+
+def train_parallel(
+    cfg: Config,
+    seeds=None,
+    n_blocks: int = 1,
+    mesh: Optional[Mesh] = None,
+    shard_agents: bool = False,
+    states: Optional[TrainState] = None,
+) -> Tuple[TrainState, EpisodeMetrics]:
+    """Run independent replicas as one sharded XLA program.
+
+    Args:
+      seeds: integer seeds for FRESH replicas, length divisible by the
+        mesh 'seed' axis. Mutually exclusive with ``states``.
+      n_blocks: training blocks per replica (n_ep_fixed episodes each).
+      mesh: ('seed', 'agent') mesh; defaults to all devices on 'seed'.
+      shard_agents: also partition the agent axis over the mesh's 'agent'
+        dimension (consensus gathers become ICI collectives).
+      states: resume from previously returned batched states (their RNG
+        streams continue; seeds must then be None).
+
+    Returns (batched TrainState, EpisodeMetrics with leading seed axis).
+    """
+    if (seeds is None) == (states is None):
+        raise ValueError("pass exactly one of `seeds` (fresh) or `states` (resume)")
+    if mesh is None:
+        mesh = make_mesh()
+    if states is None:
+        states = init_states(cfg, seeds)
+
+    in_shard = state_shardings(mesh, states, shard_agents)
+    states = jax.device_put(states, in_shard)
+
+    fn = jax.jit(
+        jax.vmap(lambda s: train_scanned(cfg, s, n_blocks)),
+        in_shardings=(in_shard,),
+        out_shardings=(in_shard, NamedSharding(mesh, P("seed"))),
+    )
+    return fn(states)
+
+
+def train_block_parallel(
+    cfg: Config,
+    states: TrainState,
+    mesh: Mesh,
+    shard_agents: bool = False,
+) -> Tuple[TrainState, EpisodeMetrics]:
+    """One sharded multi-replica block (the checkpointable granularity)."""
+    in_shard = state_shardings(mesh, states, shard_agents)
+    states = jax.device_put(states, in_shard)
+    fn = jax.jit(
+        jax.vmap(lambda s: train_block(cfg, s)),
+        in_shardings=(in_shard,),
+        out_shardings=(in_shard, NamedSharding(mesh, P("seed"))),
+    )
+    return fn(states)

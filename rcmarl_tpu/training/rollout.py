@@ -78,8 +78,13 @@ def rollout_episode(
     startup (reference ``main.py:49``).
     """
     k_reset, k_steps = jax.random.split(key)
-    if cfg.randomize_state or initial is None:
+    if cfg.randomize_state:
         pos0 = env_reset(env, k_reset)
+    elif initial is None:
+        raise ValueError(
+            "randomize_state=False requires a fixed `initial` layout "
+            "(drawn at startup; see TrainState.initial)"
+        )
     else:
         pos0 = initial
 

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from rcmarl_tpu.agents.updates import AgentParams, Batch
+from rcmarl_tpu.agents.updates import AgentParams
 from rcmarl_tpu.config import Config
 from rcmarl_tpu.envs.grid_world import GridWorld, env_reset
 from rcmarl_tpu.training.buffer import (
