@@ -1,0 +1,8 @@
+"""``python -m rcmarl_tpu`` — the reference's ``python main.py`` entry."""
+
+import sys
+
+from rcmarl_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+"""Offline results aggregation and figures (reference ``plot_results.py``)."""
+
+from rcmarl_tpu.analysis.plots import (
+    aggregate_scenario,
+    final_returns,
+    load_run,
+    plot_returns,
+)
+
+__all__ = ["aggregate_scenario", "final_returns", "load_run", "plot_returns"]

@@ -1,0 +1,167 @@
+"""Results aggregation and figures (the reference ``plot_results.py`` twin).
+
+Walks an experiment tree laid out as
+``raw_data/<scenario>/H=<h>/seed=<s>/sim_data*.pkl`` (the layout the
+reference's SGE sweeps produced and :mod:`rcmarl_tpu.cli` ``sweep``
+reproduces), aggregates per-(scenario, H) seed-mean curves with a rolling
+mean, and renders the README-style figures.
+
+Two deliberate fixes over the reference (``plot_results.py:10-59``,
+SURVEY.md §3.5): (a) private-reward and ``_global`` (team-average-reward)
+runs are paired EXPLICITLY by name, not by ``os.listdir`` adjacency; (b)
+aggregation is exposed as a pure function returning DataFrames so tests and
+notebooks can use it without touching matplotlib.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+#: Columns written by the trainer (reference ``train_agents.py:175-183``).
+COLUMNS = ("True_team_returns", "True_adv_returns", "Estimated_team_returns")
+
+
+def load_run(run_dir) -> List[pd.DataFrame]:
+    """Load one seed's ``sim_data*.pkl`` phases in numeric order, one
+    DataFrame per phase (the reference's two-phase 4000+4000 runs store
+    sim_data1 + sim_data2; per-phase warm-up dropping and concatenation
+    happen in :func:`aggregate_scenario`)."""
+    run_dir = Path(run_dir)
+    # Numbered phases only (the files plot_results.py:28-29 reads); a bare
+    # sim_data.pkl — a duplicate in reference run dirs — is the fallback,
+    # never mixed with phases. Non-numeric suffixes (sim_data_old.pkl) are
+    # stray files, not phases: ignore them.
+    numbered = [
+        (int(p.stem.removeprefix("sim_data")), p)
+        for p in run_dir.glob("sim_data*.pkl")
+        if p.stem.removeprefix("sim_data").isdigit()
+    ]
+    paths = [p for _, p in sorted(numbered)]
+    if not paths and (run_dir / "sim_data.pkl").exists():
+        paths = [run_dir / "sim_data.pkl"]
+    if not paths:
+        raise FileNotFoundError(f"no sim_data*.pkl under {run_dir}")
+    return [pd.read_pickle(p).reset_index(drop=True) for p in paths]
+
+
+def aggregate_scenario(
+    scenario_dir, H: int, drop: int = 500, rolling: int = 200
+) -> Optional[pd.DataFrame]:
+    """Seed-mean curve for one (scenario, H) cell.
+
+    Mirrors the reference pipeline (``plot_results.py:28-39``): per seed,
+    drop the first ``drop`` episodes of each phase, concatenate phases;
+    then mean across seeds index-wise and apply a ``rolling`` mean.
+    Returns None if the cell has no runs.
+    """
+    h_dir = Path(scenario_dir) / f"H={H}"
+    if not h_dir.is_dir():
+        return None
+    per_seed = []
+    for seed_dir in sorted(h_dir.iterdir()):
+        if not seed_dir.is_dir():
+            continue
+        try:
+            phases = load_run(seed_dir)
+        except FileNotFoundError:
+            continue
+        kept = [df.iloc[drop:].reset_index(drop=True) for df in phases]
+        per_seed.append(pd.concat(kept, ignore_index=True))
+    if not per_seed:
+        return None
+    stacked = pd.concat(per_seed, keys=range(len(per_seed)))
+    mean = stacked.groupby(level=1).mean()
+    return mean.rolling(rolling, min_periods=1).mean()
+
+
+def final_returns(
+    raw_data_dir, window: int = 500
+) -> pd.DataFrame:
+    """BASELINE-style summary table: mean True_team_returns (and adv) over
+    the final ``window`` episodes, per (scenario, H) — the quantity
+    SURVEY.md §6's convergence table reports."""
+    rows = []
+    root = Path(raw_data_dir)
+    for scen_dir in sorted(p for p in root.iterdir() if p.is_dir()):
+        for h_dir in sorted(scen_dir.glob("H=*")):
+            H = int(h_dir.name.split("=")[1])
+            agg = aggregate_scenario(scen_dir, H, drop=0, rolling=1)
+            if agg is None or len(agg) < 1:
+                continue
+            tail = agg.iloc[-window:]
+            rows.append(
+                {
+                    "scenario": scen_dir.name,
+                    "H": H,
+                    "team_return": tail["True_team_returns"].mean(),
+                    "adv_return": tail["True_adv_returns"].mean(),
+                    "est_return": tail["Estimated_team_returns"].mean(),
+                    "episodes": len(agg),
+                }
+            )
+    return pd.DataFrame(rows)
+
+
+def plot_returns(
+    raw_data_dir,
+    out_dir,
+    scenarios: Optional[List[str]] = None,
+    H_values: Tuple[int, ...] = (0, 1),
+    drop: int = 500,
+    rolling: int = 200,
+) -> List[str]:
+    """Render per-(scenario, H) figures overlaying the private-reward run
+    with its explicitly-paired ``<scenario>_global`` run, Estimated vs True
+    team returns — the reference README's figure set. Returns the written
+    paths."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    root = Path(raw_data_dir)
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    if scenarios is None:
+        scenarios = sorted(
+            p.name
+            for p in root.iterdir()
+            if p.is_dir() and not p.name.endswith("_global")
+        )
+    written = []
+    for scen in scenarios:
+        for H in H_values:
+            base = aggregate_scenario(root / scen, H, drop, rolling)
+            if base is None:
+                continue
+            paired = None
+            if (root / f"{scen}_global").is_dir():
+                paired = aggregate_scenario(
+                    root / f"{scen}_global", H, drop, rolling
+                )
+            fig, ax = plt.subplots(figsize=(6, 4))
+            ax.plot(base["True_team_returns"], label="True team returns")
+            ax.plot(
+                base["Estimated_team_returns"],
+                label="Estimated team returns",
+                linestyle="--",
+            )
+            if paired is not None:
+                ax.plot(
+                    paired["True_team_returns"],
+                    label="True team returns (team-avg reward)",
+                )
+            ax.set_xlabel("Episode (post warm-up)")
+            ax.set_ylabel("Discounted return")
+            ax.set_title(f"{scen}, H={H}")
+            ax.legend(fontsize=8)
+            fig.tight_layout()
+            path = out_dir / f"{scen}_h{H}.png"
+            fig.savefig(path, dpi=120)
+            plt.close(fig)
+            written.append(str(path))
+    return written

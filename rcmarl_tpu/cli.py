@@ -1,0 +1,347 @@
+"""Command-line entry points: ``train``, ``sweep``, ``plot``.
+
+TPU-native replacement for the reference's L4/L5 layers: ``train`` mirrors
+``python main.py`` (reference ``main.py:22-121``) with the same flag names
+and artifact outputs; ``sweep`` replaces the SGE job-array orchestration
+(``simulation_results/raw_data/*/job.sh``, SURVEY.md C15) with one sharded
+on-device run over scenario x H x seed; ``plot`` replaces
+``plot_results.py``. Unlike the reference — where ``--agent_label`` and
+``--in_nodes`` were unoverridable argparse defaults (SURVEY.md §5) —
+topology and cast are real flags here, plus ``--scenario`` presets for the
+published experiment matrix.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+
+#: The published experiment matrix (reference README "four scenarios" and
+#: raw_data/ layout): the adversary, when present, is node 4 (verified in
+#: raw_data/*/H=1/seed=100/out.txt config dumps).
+SCENARIOS = {
+    "coop": ["Cooperative"] * 5,
+    "greedy": ["Cooperative"] * 4 + ["Greedy"],
+    "faulty": ["Cooperative"] * 4 + ["Faulty"],
+    "malicious": ["Cooperative"] * 4 + ["Malicious"],
+}
+
+
+def scenario_labels(name: str):
+    base = name.removesuffix("_global")
+    if base not in SCENARIOS:
+        raise SystemExit(
+            f"unknown scenario {name!r}; pick from "
+            f"{sorted(SCENARIOS)} (+ '_global' suffix for team-average reward)"
+        )
+    return SCENARIOS[base], name.endswith("_global")
+
+
+def _add_config_flags(p: argparse.ArgumentParser) -> None:
+    """Reference main.py:25-44 flag surface (same names/defaults), with the
+    list-valued flags made real."""
+    p.add_argument("--n_agents", type=int, default=5)
+    p.add_argument(
+        "--agent_label",
+        nargs="+",
+        default=None,
+        help="per-agent role labels (Cooperative/Greedy/Faulty/Malicious)",
+    )
+    p.add_argument(
+        "--in_degree",
+        type=int,
+        default=4,
+        help="circulant-graph in-degree incl. self (reference default graph)",
+    )
+    p.add_argument(
+        "--in_nodes",
+        type=str,
+        default=None,
+        help="explicit topology as JSON, e.g. '[[0,1,2,3],[1,2,3,4],...]'",
+    )
+    p.add_argument("--n_actions", type=int, default=5)
+    p.add_argument("--n_states", type=int, default=2)
+    p.add_argument("--n_episodes", type=int, default=7000)
+    p.add_argument("--max_ep_len", type=int, default=20)
+    p.add_argument("--n_ep_fixed", type=int, default=50)
+    p.add_argument("--n_epochs", type=int, default=10)
+    p.add_argument("--slow_lr", type=float, default=0.01)
+    p.add_argument("--fast_lr", type=float, default=0.01)
+    p.add_argument("--batch_size", type=int, default=200)
+    p.add_argument("--buffer_size", type=int, default=2000)
+    p.add_argument("--gamma", type=float, default=0.9)
+    p.add_argument("--H", type=int, default=0)
+    p.add_argument("--common_reward", action="store_true")
+    p.add_argument("--eps", type=float, default=0.1, help="exploration mix")
+    p.add_argument(
+        "--scenario",
+        type=str,
+        default=None,
+        help="preset cast: coop/greedy/faulty/malicious[_global]",
+    )
+
+
+def config_from_args(args) -> Config:
+    labels = args.agent_label
+    common = args.common_reward
+    if args.scenario:
+        labels, is_global = scenario_labels(args.scenario)
+        common = common or is_global
+    if labels is None:
+        labels = ["Cooperative"] * args.n_agents
+    if len(labels) != args.n_agents:
+        raise SystemExit(
+            f"--agent_label has {len(labels)} entries for --n_agents={args.n_agents}"
+        )
+    bad = [l for l in labels if l not in Roles.BY_NAME]
+    if bad:
+        raise SystemExit(
+            f"unknown agent label(s) {bad}; valid: {sorted(Roles.BY_NAME)}"
+        )
+    if args.in_nodes is not None:
+        in_nodes = tuple(tuple(n) for n in json.loads(args.in_nodes))
+    else:
+        in_nodes = circulant_in_nodes(args.n_agents, args.in_degree)
+    return Config(
+        n_agents=args.n_agents,
+        agent_roles=tuple(Roles.BY_NAME[l] for l in labels),
+        in_nodes=in_nodes,
+        n_actions=args.n_actions,
+        n_states=args.n_states,
+        n_episodes=args.n_episodes,
+        max_ep_len=args.max_ep_len,
+        n_ep_fixed=args.n_ep_fixed,
+        n_epochs=args.n_epochs,
+        slow_lr=args.slow_lr,
+        fast_lr=args.fast_lr,
+        batch_size=args.batch_size,
+        buffer_size=args.buffer_size,
+        gamma=args.gamma,
+        H=args.H,
+        common_reward=common,
+        eps_explore=args.eps,
+        seed=getattr(args, "random_seed", 300),
+    )
+
+
+# --------------------------------------------------------------------------
+# train
+# --------------------------------------------------------------------------
+
+
+def cmd_train(argv) -> int:
+    p = argparse.ArgumentParser(
+        prog="rcmarl_tpu train",
+        description="Train RPBCAC agents (reference main.py equivalent)",
+    )
+    _add_config_flags(p)
+    p.add_argument("--random_seed", type=int, default=300)
+    p.add_argument("--summary_dir", type=str, default="./simulation_results/")
+    p.add_argument(
+        "--pretrained_agents",
+        type=str,
+        default=None,
+        help="resume source: a checkpoint .npz or a directory holding "
+        "reference-format pretrained_weights.npy + desired_state.npy",
+    )
+    p.add_argument(
+        "--checkpoint_every",
+        type=int,
+        default=0,
+        help="save checkpoint.npz every K blocks (0 = only at the end)",
+    )
+    p.add_argument(
+        "--phase",
+        type=int,
+        default=None,
+        help="write sim_data<phase>.pkl (reference two-phase protocol); "
+        "default: next free phase number, so resumed runs never clobber "
+        "earlier phases' metrics",
+    )
+    p.add_argument("--quiet", action="store_true")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from rcmarl_tpu.training.trainer import init_train_state, train
+    from rcmarl_tpu.training.update import init_agent_params
+    from rcmarl_tpu.utils.checkpoint import (
+        import_reference_weights,
+        load_checkpoint,
+        save_checkpoint,
+        save_reference_artifacts,
+    )
+
+    cfg = config_from_args(args)
+    out = Path(args.summary_dir)
+    out.mkdir(parents=True, exist_ok=True)
+
+    state = None
+    if args.pretrained_agents:
+        src = Path(args.pretrained_agents)
+        if src.is_file():  # our checkpoint
+            state, ckpt_cfg = load_checkpoint(src, cfg)
+            print(f"resumed checkpoint {src} at block {int(state.block)}")
+        else:  # reference-format artifact directory (main.py:52-54,83-92)
+            weights = np.load(src / "pretrained_weights.npy", allow_pickle=True)
+            desired = np.load(src / "desired_state.npy", allow_pickle=True)
+            state = init_train_state(
+                cfg, jax.random.PRNGKey(cfg.seed), desired=np.asarray(desired)
+            )
+            params = import_reference_weights(weights, cfg, state.params)
+            state = state._replace(params=params)
+            print(f"warm-started from reference artifacts in {src}")
+
+    def checkpoint_cb(s, b):
+        if args.checkpoint_every and (b + 1) % args.checkpoint_every == 0:
+            save_checkpoint(out / "checkpoint.npz", s, cfg)
+
+    t0 = time.perf_counter()
+    state, sim_data = train(
+        cfg, state=state, verbose=not args.quiet, block_callback=checkpoint_cb
+    )
+    dt = time.perf_counter() - t0
+
+    phase = args.phase
+    if phase is None:  # next free number: phase 1 fresh, 2 after resume, ...
+        existing = [
+            int(p.stem.removeprefix("sim_data"))
+            for p in out.glob("sim_data*.pkl")
+            if p.stem.removeprefix("sim_data").isdigit()
+        ]
+        phase = max(existing, default=0) + 1
+    sim_data.to_pickle(out / f"sim_data{phase}.pkl")
+    save_checkpoint(out / "checkpoint.npz", state, cfg)
+    save_reference_artifacts(out, state, cfg)
+    steps = cfg.n_episodes * cfg.max_ep_len
+    print(
+        f"done: {cfg.n_episodes} episodes in {dt:.1f}s "
+        f"({steps / dt:.1f} env-steps/s) -> {out}"
+    )
+    return 0
+
+
+# --------------------------------------------------------------------------
+# sweep
+# --------------------------------------------------------------------------
+
+
+def cmd_sweep(argv) -> int:
+    p = argparse.ArgumentParser(
+        prog="rcmarl_tpu sweep",
+        description="Run the experiment matrix on-device (replaces the "
+        "reference's SGE job arrays)",
+    )
+    p.add_argument(
+        "--scenarios",
+        nargs="+",
+        default=["coop", "greedy", "faulty", "malicious"],
+        help="scenario names; append '_global' for team-average reward",
+    )
+    p.add_argument("--H", nargs="+", type=int, default=[0, 1])
+    p.add_argument("--seeds", nargs="+", type=int, default=[100, 200, 300])
+    p.add_argument("--n_episodes", type=int, default=4000)
+    p.add_argument("--max_ep_len", type=int, default=20)
+    p.add_argument("--n_ep_fixed", type=int, default=50)
+    p.add_argument("--n_epochs", type=int, default=10)
+    p.add_argument("--buffer_size", type=int, default=2000)
+    p.add_argument("--slow_lr", type=float, default=0.002)
+    p.add_argument("--fast_lr", type=float, default=0.01)
+    p.add_argument("--out", type=str, default="./simulation_results/raw_data")
+    p.add_argument("--phase", type=int, default=1, help="sim_data<phase>.pkl")
+    args = p.parse_args(argv)
+    if args.n_episodes <= 0 or args.n_episodes % args.n_ep_fixed != 0:
+        raise SystemExit(
+            f"--n_episodes={args.n_episodes} must be a positive multiple of "
+            f"--n_ep_fixed={args.n_ep_fixed}"
+        )
+
+    from rcmarl_tpu.parallel.seeds import train_parallel
+    from rcmarl_tpu.training.trainer import metrics_to_dataframe
+
+    out_root = Path(args.out)
+    for scen in args.scenarios:
+        labels, is_global = scenario_labels(scen)
+        for H in args.H:
+            cfg = Config.from_labels(
+                labels,
+                H=H,
+                common_reward=is_global,
+                n_episodes=args.n_episodes,
+                max_ep_len=args.max_ep_len,
+                n_ep_fixed=args.n_ep_fixed,
+                n_epochs=args.n_epochs,
+                buffer_size=args.buffer_size,
+                slow_lr=args.slow_lr,
+                fast_lr=args.fast_lr,
+            )
+            n_blocks = args.n_episodes // cfg.n_ep_fixed
+            t0 = time.perf_counter()
+            # all seeds of a cell run as ONE sharded/vmapped program
+            states, metrics = train_parallel(
+                cfg, seeds=args.seeds, n_blocks=n_blocks
+            )
+            dt = time.perf_counter() - t0
+            for i, seed in enumerate(args.seeds):
+                cell = out_root / scen / f"H={H}" / f"seed={seed}"
+                cell.mkdir(parents=True, exist_ok=True)
+                df = metrics_to_dataframe(
+                    type(metrics)(*(np.asarray(l[i]) for l in metrics))
+                )
+                df.to_pickle(cell / f"sim_data{args.phase}.pkl")
+            sps = len(args.seeds) * args.n_episodes * cfg.max_ep_len / dt
+            print(
+                f"{scen} H={H}: {len(args.seeds)} seeds x {args.n_episodes} eps "
+                f"in {dt:.1f}s ({sps:.0f} env-steps/s aggregate)"
+            )
+    return 0
+
+
+# --------------------------------------------------------------------------
+# plot
+# --------------------------------------------------------------------------
+
+
+def cmd_plot(argv) -> int:
+    p = argparse.ArgumentParser(
+        prog="rcmarl_tpu plot",
+        description="Aggregate sweep results and render figures "
+        "(plot_results.py equivalent)",
+    )
+    p.add_argument("--raw_data", type=str, default="./simulation_results/raw_data")
+    p.add_argument("--out", type=str, default="./simulation_results/figures")
+    p.add_argument("--drop", type=int, default=500)
+    p.add_argument("--rolling", type=int, default=200)
+    p.add_argument("--summary", action="store_true", help="print final-return table")
+    args = p.parse_args(argv)
+
+    from rcmarl_tpu.analysis.plots import final_returns, plot_returns
+
+    if args.summary:
+        print(final_returns(args.raw_data).to_string(index=False))
+    written = plot_returns(
+        args.raw_data, args.out, drop=args.drop, rolling=args.rolling
+    )
+    for w in written:
+        print(w)
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    cmds = {"train": cmd_train, "sweep": cmd_sweep, "plot": cmd_plot}
+    if not argv or argv[0] in ("-h", "--help"):
+        print(f"usage: python -m rcmarl_tpu {{{','.join(cmds)}}} [flags]")
+        return 0 if argv else 2
+    cmd = argv[0]
+    if cmd not in cmds:
+        print(f"unknown command {cmd!r}; expected one of {sorted(cmds)}")
+        return 2
+    return cmds[cmd](argv[1:])

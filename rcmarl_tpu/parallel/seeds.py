@@ -134,7 +134,17 @@ def train_parallel(
     if (seeds is None) == (states is None):
         raise ValueError("pass exactly one of `seeds` (fresh) or `states` (resume)")
     if mesh is None:
-        mesh = make_mesh()
+        # Default mesh must evenly shard the replica axis: use the largest
+        # device count that divides the replica count, all on 'seed'.
+        n_rep = (
+            len(seeds)
+            if seeds is not None
+            else int(jax.tree.leaves(states)[0].shape[0])
+        )
+        n_dev = max(
+            d for d in range(1, len(jax.devices()) + 1) if n_rep % d == 0
+        )
+        mesh = make_mesh(n_dev)
     if states is None:
         states = init_states(cfg, seeds)
 

@@ -1,0 +1,17 @@
+"""Auxiliary subsystems: checkpointing, profiling."""
+
+from rcmarl_tpu.utils.checkpoint import (
+    export_reference_weights,
+    import_reference_weights,
+    load_checkpoint,
+    save_checkpoint,
+    save_reference_artifacts,
+)
+
+__all__ = [
+    "export_reference_weights",
+    "import_reference_weights",
+    "load_checkpoint",
+    "save_checkpoint",
+    "save_reference_artifacts",
+]

@@ -1,0 +1,188 @@
+"""Checkpoint / resume for full training state, plus reference interop.
+
+The reference checkpoints only the agents' weight lists and the goal layout
+(``np.save('pretrained_weights.npy', ...)`` / ``desired_state.npy``,
+reference ``main.py:119-121``), losing optimizer state and the replay
+buffer on resume (SURVEY.md §5 "Checkpoint / resume"). Here a checkpoint is
+the COMPLETE :class:`~rcmarl_tpu.training.trainer.TrainState` pytree —
+stacked params, Adam moments, replay ring, RNG key, and block counter — so
+a resumed run continues bit-for-bit where it stopped.
+
+Format: a single ``.npz`` holding every pytree leaf under a structural key
+(``leaf_000``...), plus a JSON header recording the Config the state was
+built under. Restore unflattens into a template built from that Config, so
+structure mismatches fail loudly instead of silently mis-assigning leaves.
+
+Interop: :func:`export_reference_weights` / :func:`import_reference_weights`
+translate between our stacked pytrees and the reference's nested-list
+layout (``pretrained_weights[node] = [actor, critic, TR(, critic_local)]``
+with Keras ``get_weights()`` order ``[W1, b1, W2, b2, W3, b3]``; reference
+``main.py:83-92``), so reference-trained weights can warm-start this
+framework and vice versa.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from rcmarl_tpu.agents.updates import AgentParams
+from rcmarl_tpu.config import Config
+from rcmarl_tpu.training.trainer import TrainState, init_train_state
+
+
+# --------------------------------------------------------------------------
+# Full-state checkpointing
+# --------------------------------------------------------------------------
+
+
+def _config_to_json(cfg: Config) -> str:
+    return json.dumps(dataclasses.asdict(cfg), sort_keys=True)
+
+
+def config_from_json(s: str) -> Config:
+    d = json.loads(s)
+    d["agent_roles"] = tuple(d["agent_roles"])
+    d["in_nodes"] = tuple(tuple(n) for n in d["in_nodes"])
+    d["hidden"] = tuple(d["hidden"])
+    return Config(**d)
+
+
+def save_checkpoint(path, state: TrainState, cfg: Config) -> None:
+    """Write the full TrainState to ``path`` (.npz) with a Config header."""
+    leaves = jax.tree.leaves(state)
+    arrays = {f"leaf_{i:03d}": np.asarray(l) for i, l in enumerate(leaves)}
+    arrays["__config__"] = np.frombuffer(
+        _config_to_json(cfg).encode(), dtype=np.uint8
+    )
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+
+
+def load_checkpoint(path, cfg: Optional[Config] = None) -> Tuple[TrainState, Config]:
+    """Restore (TrainState, Config) from ``path``.
+
+    If ``cfg`` is given it must structurally match the stored one (same
+    shapes); otherwise the stored Config is used.
+    """
+    with np.load(path) as z:
+        stored_cfg = config_from_json(bytes(z["__config__"]).decode())
+        if cfg is None:
+            cfg = stored_cfg
+        template = jax.eval_shape(
+            lambda k: init_train_state(cfg, k), jax.random.PRNGKey(0)
+        )
+        t_leaves, treedef = jax.tree.flatten(template)
+        keys = [f"leaf_{i:03d}" for i in range(len(t_leaves))]
+        missing = [k for k in keys if k not in z]
+        if missing:
+            raise ValueError(
+                f"checkpoint {path} does not match config structure: "
+                f"missing {missing[:3]}... ({len(missing)} leaves)"
+            )
+        leaves = [z[k] for k in keys]
+        for k, leaf, tmpl in zip(keys, leaves, t_leaves):
+            if tuple(leaf.shape) != tuple(tmpl.shape):
+                raise ValueError(
+                    f"checkpoint leaf {k} has shape {leaf.shape}, "
+                    f"config expects {tmpl.shape}"
+                )
+    return jax.tree.unflatten(treedef, leaves), cfg
+
+
+# --------------------------------------------------------------------------
+# Reference-format interop
+# --------------------------------------------------------------------------
+
+
+def _stacked_to_keras(params, agent: int) -> list:
+    """One agent's MLP -> Keras get_weights() order [W1, b1, W2, b2, ...]."""
+    out = []
+    for W, b in params:
+        out.append(np.asarray(W[agent]))
+        out.append(np.asarray(b[agent]))
+    return out
+
+
+def _keras_to_layers(flat: list) -> tuple:
+    """[W1, b1, W2, b2, ...] -> ((W1, b1), (W2, b2), ...)."""
+    return tuple(
+        (np.asarray(flat[i]), np.asarray(flat[i + 1]))
+        for i in range(0, len(flat), 2)
+    )
+
+
+def export_reference_weights(params: AgentParams, cfg: Config) -> np.ndarray:
+    """Stacked params -> the reference's ``pretrained_weights.npy`` object
+    layout: per node ``[actor, critic, TR]`` (+ ``critic_local`` appended
+    for every node, a superset of the reference's malicious-only 4th entry
+    — reference importers index the first 3, ``main.py:83-86``)."""
+    out = []
+    for i in range(cfg.n_agents):
+        out.append(
+            [
+                _stacked_to_keras(params.actor, i),
+                _stacked_to_keras(params.critic, i),
+                _stacked_to_keras(params.tr, i),
+                _stacked_to_keras(params.critic_local, i),
+            ]
+        )
+    arr = np.empty(len(out), dtype=object)
+    arr[:] = out
+    return arr
+
+
+def import_reference_weights(
+    weights: np.ndarray, cfg: Config, params: AgentParams
+) -> AgentParams:
+    """Reference ``pretrained_weights.npy`` content -> AgentParams.
+
+    ``params`` supplies the template (and Adam state, which the reference
+    never checkpoints — moments reset on resume there too, SURVEY.md §5).
+    Nodes with a 4th entry restore ``critic_local`` (reference
+    ``main.py:91-92``); others keep the template's.
+    """
+
+    def set_agent(stacked, i, layers):
+        return tuple(
+            (W.at[i].set(lw), b.at[i].set(lb))
+            for (W, b), (lw, lb) in zip(stacked, layers)
+        )
+
+    actor, critic, tr = params.actor, params.critic, params.tr
+    critic_local = params.critic_local
+    for i in range(cfg.n_agents):
+        entry = weights[i]
+        actor = set_agent(actor, i, _keras_to_layers(entry[0]))
+        critic = set_agent(critic, i, _keras_to_layers(entry[1]))
+        tr = set_agent(tr, i, _keras_to_layers(entry[2]))
+        if len(entry) > 3:
+            critic_local = set_agent(critic_local, i, _keras_to_layers(entry[3]))
+    return params._replace(
+        actor=actor, critic=critic, tr=tr, critic_local=critic_local
+    )
+
+
+def save_reference_artifacts(out_dir, state: TrainState, cfg: Config) -> None:
+    """Write ``pretrained_weights.npy`` + ``desired_state.npy`` in the
+    reference's layout (reference ``main.py:119-121``) so its resume path
+    and analysis scripts accept our runs."""
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    np.save(
+        out_dir / "pretrained_weights.npy",
+        export_reference_weights(state.params, cfg),
+        allow_pickle=True,
+    )
+    np.save(
+        out_dir / "desired_state.npy",
+        np.asarray(state.desired),
+        allow_pickle=True,
+    )
