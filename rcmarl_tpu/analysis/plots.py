@@ -25,6 +25,16 @@ import pandas as pd
 COLUMNS = ("True_team_returns", "True_adv_returns", "Estimated_team_returns")
 
 
+def _h_cells(scenario_dir) -> List[int]:
+    """Sorted H values of the ``H=<int>`` cell directories under
+    ``scenario_dir``; stray files and non-numeric names are skipped."""
+    return sorted(
+        int(d.name.split("=")[1])
+        for d in Path(scenario_dir).glob("H=*")
+        if d.is_dir() and d.name.split("=")[1].lstrip("-").isdigit()
+    )
+
+
 def load_run(run_dir) -> List[pd.DataFrame]:
     """Load one seed's ``sim_data*.pkl`` phases in numeric order, one
     DataFrame per phase (the reference's two-phase 4000+4000 runs store
@@ -87,8 +97,7 @@ def final_returns(
     rows = []
     root = Path(raw_data_dir)
     for scen_dir in sorted(p for p in root.iterdir() if p.is_dir()):
-        for h_dir in sorted(scen_dir.glob("H=*")):
-            H = int(h_dir.name.split("=")[1])
+        for H in _h_cells(scen_dir):
             agg = aggregate_scenario(scen_dir, H, drop=0, rolling=1)
             if agg is None or len(agg) < 1:
                 continue
@@ -110,14 +119,15 @@ def plot_returns(
     raw_data_dir,
     out_dir,
     scenarios: Optional[List[str]] = None,
-    H_values: Tuple[int, ...] = (0, 1),
+    H_values: Optional[Tuple[int, ...]] = None,
     drop: int = 500,
     rolling: int = 200,
 ) -> List[str]:
     """Render per-(scenario, H) figures overlaying the private-reward run
     with its explicitly-paired ``<scenario>_global`` run, Estimated vs True
-    team returns — the reference README's figure set. Returns the written
-    paths."""
+    team returns — the reference README's figure set. ``H_values=None``
+    plots every ``H=*`` cell found on disk, so sweeps with nonstandard H
+    are never silently skipped. Returns the written paths."""
     import matplotlib
 
     matplotlib.use("Agg")
@@ -134,7 +144,8 @@ def plot_returns(
         )
     written = []
     for scen in scenarios:
-        for H in H_values:
+        cells = _h_cells(root / scen) if H_values is None else list(H_values)
+        for H in cells:
             base = aggregate_scenario(root / scen, H, drop, rolling)
             if base is None:
                 continue

@@ -14,6 +14,7 @@ published experiment matrix.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import sys
 import time
@@ -92,6 +93,11 @@ def config_from_args(args) -> Config:
     labels = args.agent_label
     common = args.common_reward
     if args.scenario:
+        if labels is not None:
+            raise SystemExit(
+                "--scenario and --agent_label conflict: the preset would "
+                "replace your explicit cast; pass only one of them"
+            )
         labels, is_global = scenario_labels(args.scenario)
         common = common or is_global
     if labels is None:
@@ -171,7 +177,6 @@ def cmd_train(argv) -> int:
     import jax
 
     from rcmarl_tpu.training.trainer import init_train_state, train
-    from rcmarl_tpu.training.update import init_agent_params
     from rcmarl_tpu.utils.checkpoint import (
         import_reference_weights,
         load_checkpoint,
@@ -186,9 +191,27 @@ def cmd_train(argv) -> int:
     state = None
     if args.pretrained_agents:
         src = Path(args.pretrained_agents)
+        if not src.exists():
+            raise SystemExit(f"--pretrained_agents: {src} does not exist")
         if src.is_file():  # our checkpoint
             state, ckpt_cfg = load_checkpoint(src, cfg)
             print(f"resumed checkpoint {src} at block {int(state.block)}")
+            # Shapes were validated by load_checkpoint; non-structural
+            # hyperparameters (H, lrs, gamma, schedule...) come from the
+            # CLI and may silently differ from the stored run — surface it.
+            diffs = {
+                f.name: (getattr(ckpt_cfg, f.name), getattr(cfg, f.name))
+                for f in dataclasses.fields(Config)
+                if getattr(ckpt_cfg, f.name) != getattr(cfg, f.name)
+            }
+            if diffs:
+                print(
+                    "WARNING: resumed run overrides checkpointed config "
+                    "(stored -> active): "
+                    + ", ".join(
+                        f"{k}: {a!r} -> {b!r}" for k, (a, b) in diffs.items()
+                    )
+                )
         else:  # reference-format artifact directory (main.py:52-54,83-92)
             weights = np.load(src / "pretrained_weights.npy", allow_pickle=True)
             desired = np.load(src / "desired_state.npy", allow_pickle=True)
@@ -319,6 +342,13 @@ def cmd_plot(argv) -> int:
     p.add_argument("--out", type=str, default="./simulation_results/figures")
     p.add_argument("--drop", type=int, default=500)
     p.add_argument("--rolling", type=int, default=200)
+    p.add_argument(
+        "--H",
+        nargs="+",
+        type=int,
+        default=None,
+        help="H cells to plot (default: every H=* directory found)",
+    )
     p.add_argument("--summary", action="store_true", help="print final-return table")
     args = p.parse_args(argv)
 
@@ -327,7 +357,11 @@ def cmd_plot(argv) -> int:
     if args.summary:
         print(final_returns(args.raw_data).to_string(index=False))
     written = plot_returns(
-        args.raw_data, args.out, drop=args.drop, rolling=args.rolling
+        args.raw_data,
+        args.out,
+        H_values=None if args.H is None else tuple(args.H),
+        drop=args.drop,
+        rolling=args.rolling,
     )
     for w in written:
         print(w)

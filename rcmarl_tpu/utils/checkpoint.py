@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 from pathlib import Path
 from typing import Optional, Tuple
 
@@ -62,15 +63,22 @@ def save_checkpoint(path, state: TrainState, cfg: Config) -> None:
     )
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    with open(path, "wb") as f:
+    # Write-then-rename so a crash mid-write can't destroy the previous
+    # good checkpoint (periodic checkpointing exists exactly for kills).
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "wb") as f:
         np.savez(f, **arrays)
+    os.replace(tmp, path)
 
 
 def load_checkpoint(path, cfg: Optional[Config] = None) -> Tuple[TrainState, Config]:
-    """Restore (TrainState, Config) from ``path``.
+    """Restore ``(TrainState, stored_config)`` from ``path``.
 
     If ``cfg`` is given it must structurally match the stored one (same
-    shapes); otherwise the stored Config is used.
+    shapes) and the state is unflattened against it; otherwise the stored
+    Config is used. The returned Config is always the STORED one, so
+    callers can detect hyperparameter drift between the checkpointed run
+    and their active config.
     """
     with np.load(path) as z:
         stored_cfg = config_from_json(bytes(z["__config__"]).decode())
@@ -94,7 +102,7 @@ def load_checkpoint(path, cfg: Optional[Config] = None) -> Tuple[TrainState, Con
                     f"checkpoint leaf {k} has shape {leaf.shape}, "
                     f"config expects {tmpl.shape}"
                 )
-    return jax.tree.unflatten(treedef, leaves), cfg
+    return jax.tree.unflatten(treedef, leaves), stored_cfg
 
 
 # --------------------------------------------------------------------------
@@ -151,6 +159,11 @@ def import_reference_weights(
     """
 
     def set_agent(stacked, i, layers):
+        if len(stacked) != len(layers):
+            raise ValueError(
+                f"agent {i}: reference weights have {len(layers)} layers, "
+                f"config expects {len(stacked)} — layer-count mismatch"
+            )
         return tuple(
             (W.at[i].set(lw), b.at[i].set(lb))
             for (W, b), (lw, lb) in zip(stacked, layers)

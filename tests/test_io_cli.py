@@ -6,7 +6,6 @@ shipped with the reference (``raw_data/coop/H=1/seed=100/``) to pin the
 interop layout, not a synthetic imitation of it.
 """
 
-import os
 from pathlib import Path
 
 import jax
@@ -92,6 +91,25 @@ class TestCheckpoint:
         for field in ("actor", "critic", "tr", "critic_local"):
             assert leaves_equal(getattr(restored, field), getattr(state.params, field))
 
+    def test_save_is_atomic_no_tmp_left_behind(self, tmp_path):
+        cfg = tiny_cfg()
+        state = init_train_state(cfg, jax.random.PRNGKey(0))
+        path = tmp_path / "ck.npz"
+        save_checkpoint(path, state, cfg)
+        save_checkpoint(path, state, cfg)  # overwrite goes through rename
+        assert path.exists()
+        assert not list(tmp_path.glob("*.tmp"))
+        load_checkpoint(path)  # still a valid archive
+
+    def test_import_rejects_layer_count_mismatch(self):
+        cfg = tiny_cfg()
+        state = init_train_state(cfg, jax.random.PRNGKey(1))
+        exported = export_reference_weights(state.params, cfg)
+        deeper = tiny_cfg(hidden=(8, 8, 8))
+        deep_state = init_train_state(deeper, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="layer-count mismatch"):
+            import_reference_weights(exported, deeper, deep_state.params)
+
     def test_loads_real_reference_artifacts(self):
         """Real reference checkpoint (Keras get_weights layout, main.py:83-92)
         imports into the default Config's shapes."""
@@ -146,6 +164,39 @@ class TestCLI:
         # warm-start from the reference-format artifacts we just wrote
         assert main(flags + ["--pretrained_agents", str(out)]) == 0
         assert (out / "sim_data3.pkl").exists()
+
+    def test_scenario_conflicts_with_explicit_labels(self):
+        with pytest.raises(SystemExit, match="conflict"):
+            main([
+                "train", "--scenario", "coop",
+                "--agent_label", "Cooperative", "Cooperative", "Cooperative",
+                "Cooperative", "Greedy",
+            ])
+
+    def test_missing_pretrained_path_is_clear_error(self, tmp_path):
+        with pytest.raises(SystemExit, match="does not exist"):
+            main([
+                "train", "--summary_dir", str(tmp_path), "--quiet",
+                "--pretrained_agents", str(tmp_path / "no_such.npz"),
+            ])
+
+    def test_resume_warns_on_config_drift(self, tmp_path, capsys):
+        out = tmp_path / "run"
+        flags = [
+            "train",
+            "--n_agents", "3", "--in_degree", "2",
+            "--n_episodes", "2", "--max_ep_len", "4", "--n_ep_fixed", "2",
+            "--n_epochs", "1", "--buffer_size", "16", "--batch_size", "4",
+            "--random_seed", "7", "--summary_dir", str(out), "--quiet",
+            "--gamma", "0.95",
+        ]
+        assert main(flags) == 0
+        capsys.readouterr()
+        # resume WITHOUT --gamma: shape-compatible but hyperparam drift
+        resume = [f for f in flags if f not in ("--gamma", "0.95")]
+        assert main(resume + ["--pretrained_agents", str(out / "checkpoint.npz")]) == 0
+        msg = capsys.readouterr().out
+        assert "WARNING" in msg and "gamma" in msg
 
     def test_sweep_plot_summary(self, tmp_path, capsys):
         raw = tmp_path / "raw_data"
