@@ -203,7 +203,7 @@ def consensus_update_one(
     n_trunk = len(own) - 1
     # b) hidden-layer consensus over trunk arrays
     trunk_agg = resilient_aggregate_tree(
-        tuple(nbr_msgs[i] for i in range(n_trunk)), cfg.H
+        tuple(nbr_msgs[i] for i in range(n_trunk)), cfg.H, cfg.consensus_impl
     )
     new_params: MLPParams = tuple(trunk_agg) + (own[-1],)
     # c) projection: phi with aggregated trunk, all neighbor heads at once
@@ -215,7 +215,7 @@ def consensus_update_one(
         )
         + b_nbr[:, None, :]
     )  # (n_in, B, 1)
-    agg = resilient_aggregate(vals, cfg.H)  # (B, 1)
+    agg = resilient_aggregate(vals, cfg.H, cfg.consensus_impl)  # (B, 1)
     agg = jax.lax.stop_gradient(agg)
     # d) normalized team update of the head only
     phi_sg = jax.lax.stop_gradient(phi)

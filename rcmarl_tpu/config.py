@@ -107,6 +107,12 @@ class Config:
     # --- cooperative local fit (reference resilient_CAC_agents.py:118,136) ---
     coop_fit_steps: int = 5
     seed: int = 300
+    # --- consensus kernel implementation ---
+    # 'xla' (default): jnp sort/clip/mean, best at reference scale.
+    # 'pallas': fused VMEM-resident kernel (ops/pallas_aggregation.py),
+    # for large-N/large-model scale-out on TPU.
+    # 'pallas_interpret': pallas in interpreter mode (CPU tests only).
+    consensus_impl: str = "xla"
 
     def __post_init__(self):
         if len(self.agent_roles) != self.n_agents:
@@ -129,6 +135,11 @@ class Config:
         if not 0 <= 2 * self.H <= n_in - 1:
             raise ValueError(
                 f"H={self.H} too large for in-degree {n_in}: need 2H <= n_in-1"
+            )
+        if self.consensus_impl not in ("xla", "pallas", "pallas_interpret"):
+            raise ValueError(
+                f"consensus_impl={self.consensus_impl!r}: expected "
+                "'xla', 'pallas', or 'pallas_interpret'"
             )
 
     # ---- derived (static) quantities ----

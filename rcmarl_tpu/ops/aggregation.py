@@ -28,16 +28,26 @@ import jax
 import jax.numpy as jnp
 
 
-def resilient_aggregate(values: jnp.ndarray, H: int) -> jnp.ndarray:
+def resilient_aggregate(
+    values: jnp.ndarray, H: int, impl: str = "xla"
+) -> jnp.ndarray:
     """Clip-and-average over the leading neighbor axis.
 
     Args:
       values: (n_in, ...) stacked neighbor values, own value at index 0.
       H: max number of adversaries tolerated in the neighborhood (static).
+      impl: 'xla' (default), 'pallas' (fused TPU kernel,
+        :mod:`rcmarl_tpu.ops.pallas_aggregation`), or 'pallas_interpret'.
 
     Returns:
       (...) aggregated values.
     """
+    if impl != "xla":
+        from rcmarl_tpu.ops.pallas_aggregation import fused_resilient_aggregate
+
+        return fused_resilient_aggregate(
+            values, H, interpret=impl == "pallas_interpret"
+        )
     n_in = values.shape[0]
     if not 0 <= 2 * H <= n_in - 1:
         raise ValueError(f"H={H} invalid for n_in={n_in}: need 0 <= 2H <= n_in-1")
@@ -51,8 +61,17 @@ def resilient_aggregate(values: jnp.ndarray, H: int) -> jnp.ndarray:
     return jnp.mean(jnp.clip(values, lower, upper), axis=0)
 
 
-def resilient_aggregate_tree(tree, H: int):
+def resilient_aggregate_tree(tree, H: int, impl: str = "xla"):
     """Apply :func:`resilient_aggregate` to every leaf of a pytree whose
     leaves carry a leading neighbor axis (e.g. a gathered parameter
-    pytree with leaves (n_in, ...))."""
+    pytree with leaves (n_in, ...)). With a pallas impl the whole tree is
+    flattened into ONE fused kernel launch instead of one sort per leaf."""
+    if impl != "xla":
+        from rcmarl_tpu.ops.pallas_aggregation import (
+            fused_resilient_aggregate_tree,
+        )
+
+        return fused_resilient_aggregate_tree(
+            tree, H, interpret=impl == "pallas_interpret"
+        )
     return jax.tree.map(lambda v: resilient_aggregate(v, H), tree)

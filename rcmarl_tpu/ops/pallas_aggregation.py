@@ -1,0 +1,154 @@
+"""Fused Pallas TPU kernel for resilient (clip-and-average) aggregation.
+
+Same semantics as :func:`rcmarl_tpu.ops.aggregation.resilient_aggregate`
+(the reference's ``_resilient_aggregation``, ``resilient_CAC_agents.py:
+42-58``): sort over the leading neighbor axis, clip every value into
+``[min(sorted[H], own), max(sorted[n_in-H-1], own)]`` with own value at
+index 0, then mean over neighbors.
+
+Why a kernel at all: at reference scale (5 agents, 20-unit MLPs) XLA's
+``sort -> clip -> mean`` is already fine (SURVEY.md §7 hard part (e)).
+At scale-out (N=64 agents, 256x256 trunks — BASELINE.json config 5) the
+consensus pass is HBM-bandwidth-bound: XLA materializes the full sorted
+copy of the gathered (n_in, P) parameter block in HBM between the sort
+and the clip/mean. This kernel streams each (n_in, rows, 128) tile
+through VMEM once, runs an odd-even transposition sorting network over
+the tiny static neighbor axis entirely in registers/VMEM (n_in
+compare-exchange rounds of (rows, 128) ``minimum``/``maximum`` VPU ops
+— no data-dependent control flow), and writes only the aggregated tile
+back — one HBM read + one HBM write total.
+
+The public entry points mirror the XLA versions and are exact drop-ins:
+
+- :func:`fused_resilient_aggregate` — one (n_in, ...) array.
+- :func:`fused_resilient_aggregate_tree` — a whole pytree with (n_in,
+  ...) leaves, flattened into ONE kernel launch (vs one XLA sort per
+  leaf), then split back.
+
+Both fall back to nothing special on CPU: pass ``interpret=True`` (the
+tests do) or keep ``Config.consensus_impl='xla'``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_LANES = 128
+
+
+def _sorting_network(rows):
+    """Odd-even transposition sort of a static list of equal-shape arrays.
+
+    n rounds of adjacent compare-exchange; fully unrolled (n is tiny and
+    static), so it lowers to pure vectorized min/max with no control flow.
+    """
+    s = list(rows)
+    n = len(s)
+    for rnd in range(n):
+        for j in range(rnd % 2, n - 1, 2):
+            lo = jnp.minimum(s[j], s[j + 1])
+            hi = jnp.maximum(s[j], s[j + 1])
+            s[j], s[j + 1] = lo, hi
+    return s
+
+
+def _agg_kernel(vals_ref, out_ref, *, n_in: int, H: int):
+    """One (n_in, rows, LANES) tile: sort over axis 0, clip, mean."""
+    rows = [vals_ref[i] for i in range(n_in)]  # each (rows, LANES)
+    own = rows[0]
+    if H > 0:
+        s = _sorting_network(rows)
+        lower = jnp.minimum(s[H], own)
+        upper = jnp.maximum(s[n_in - 1 - H], own)
+        clipped = [jnp.clip(r, lower, upper) for r in rows]
+    else:  # H=0: clip bounds span the whole range -> plain mean
+        clipped = rows
+    acc = clipped[0]
+    for r in clipped[1:]:
+        acc = acc + r
+    out_ref[...] = acc * (1.0 / n_in)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("H", "block_rows", "interpret")
+)
+def fused_resilient_aggregate(
+    values: jnp.ndarray,
+    H: int,
+    *,
+    block_rows: int = 32,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas twin of :func:`~rcmarl_tpu.ops.aggregation.resilient_aggregate`.
+
+    Args:
+      values: (n_in, ...) stacked neighbor values, own value at index 0.
+      H: trim parameter (static); 0 <= 2H <= n_in-1.
+      block_rows: sublane rows per grid step (VMEM tile is
+        n_in x block_rows x 128 floats).
+      interpret: run in the Pallas interpreter (for CPU tests).
+
+    Returns:
+      (...) aggregated values, f32.
+    """
+    n_in = values.shape[0]
+    if not 0 <= 2 * H <= n_in - 1:
+        raise ValueError(f"H={H} invalid for n_in={n_in}: need 0 <= 2H <= n_in-1")
+    out_shape = values.shape[1:]
+    flat = values.reshape(n_in, -1).astype(jnp.float32)
+    m = flat.shape[1]
+    tile = block_rows * _LANES
+    padded = ((m + tile - 1) // tile) * tile
+    if padded != m:
+        flat = jnp.pad(flat, ((0, 0), (0, padded - m)))
+    rows_total = padded // _LANES
+    v3 = flat.reshape(n_in, rows_total, _LANES)
+    grid = (rows_total // block_rows,)
+    out = pl.pallas_call(
+        functools.partial(_agg_kernel, n_in=n_in, H=H),
+        out_shape=jax.ShapeDtypeStruct((rows_total, _LANES), jnp.float32),
+        in_specs=[
+            pl.BlockSpec((n_in, block_rows, _LANES), lambda i: (0, i, 0))
+        ],
+        out_specs=pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
+        grid=grid,
+        interpret=interpret,
+    )(v3)
+    return out.reshape(-1)[:m].reshape(out_shape)
+
+
+def fused_resilient_aggregate_tree(
+    tree, H: int, *, block_rows: int = 32, interpret: bool = False
+):
+    """Aggregate every (n_in, ...) leaf of ``tree`` in ONE kernel launch.
+
+    Ravels all leaves along their trailing dims, concatenates into a
+    single (n_in, P) block, runs :func:`fused_resilient_aggregate` once,
+    and splits back — the whole hidden-layer consensus of an agent's
+    trunk (reference ``resilient_CAC_agents.py:142-166``) becomes a
+    single HBM pass instead of one sort per weight array.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    n_in = leaves[0].shape[0]
+    bad = [l.shape for l in leaves if l.shape[0] != n_in]
+    if bad:
+        raise ValueError(
+            f"all leaves must share the leading neighbor dim {n_in}; "
+            f"got leaves with shapes {bad[:3]}"
+        )
+    sizes = [l[0].size for l in leaves]
+    flat = jnp.concatenate(
+        [l.reshape(n_in, -1) for l in leaves], axis=1
+    )
+    agg = fused_resilient_aggregate(
+        flat, H, block_rows=block_rows, interpret=interpret
+    )
+    out, off = [], 0
+    for leaf, size in zip(leaves, sizes):
+        out.append(agg[off : off + size].reshape(leaf.shape[1:]))
+        off += size
+    return jax.tree.unflatten(treedef, out)
