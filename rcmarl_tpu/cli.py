@@ -14,6 +14,7 @@ published experiment matrix.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import dataclasses
 import json
 import sys
@@ -172,6 +173,18 @@ def cmd_train(argv) -> int:
         "earlier phases' metrics",
     )
     p.add_argument("--quiet", action="store_true")
+    p.add_argument(
+        "--profile",
+        action="store_true",
+        help="print per-phase timing breakdown before training "
+        "(utils/profiling.py)",
+    )
+    p.add_argument(
+        "--trace_dir",
+        type=str,
+        default=None,
+        help="record a TensorBoard/Perfetto device trace of the run",
+    )
     args = p.parse_args(argv)
 
     import jax
@@ -226,10 +239,21 @@ def cmd_train(argv) -> int:
         if args.checkpoint_every and (b + 1) % args.checkpoint_every == 0:
             save_checkpoint(out / "checkpoint.npz", s, cfg)
 
+    if args.profile:
+        from rcmarl_tpu.utils.profiling import profile_phases
+
+        for name, secs in profile_phases(cfg).items():
+            print(f"profile {name:18s} {secs * 1e3:9.2f} ms")
+
     t0 = time.perf_counter()
-    state, sim_data = train(
-        cfg, state=state, verbose=not args.quiet, block_callback=checkpoint_cb
-    )
+    with contextlib.ExitStack() as stack:
+        if args.trace_dir:
+            from rcmarl_tpu.utils.profiling import trace as profiler_trace
+
+            stack.enter_context(profiler_trace(args.trace_dir))
+        state, sim_data = train(
+            cfg, state=state, verbose=not args.quiet, block_callback=checkpoint_cb
+        )
     dt = time.perf_counter() - t0
 
     phase = args.phase
@@ -311,13 +335,14 @@ def cmd_sweep(argv) -> int:
             states, metrics = train_parallel(
                 cfg, seeds=args.seeds, n_blocks=n_blocks
             )
+            # force completion before timing: dispatch is async, and a
+            # host-side fetch is the only reliable barrier on all backends
+            metrics = type(metrics)(*(np.asarray(l) for l in metrics))
             dt = time.perf_counter() - t0
             for i, seed in enumerate(args.seeds):
                 cell = out_root / scen / f"H={H}" / f"seed={seed}"
                 cell.mkdir(parents=True, exist_ok=True)
-                df = metrics_to_dataframe(
-                    type(metrics)(*(np.asarray(l[i]) for l in metrics))
-                )
+                df = metrics_to_dataframe(type(metrics)(*(l[i] for l in metrics)))
                 df.to_pickle(cell / f"sim_data{args.phase}.pkl")
             sps = len(args.seeds) * args.n_episodes * cfg.max_ep_len / dt
             print(

@@ -7,6 +7,7 @@ from rcmarl_tpu.utils.checkpoint import (
     save_checkpoint,
     save_reference_artifacts,
 )
+from rcmarl_tpu.utils.profiling import Timer, profile_phases, trace
 
 __all__ = [
     "export_reference_weights",
@@ -14,4 +15,7 @@ __all__ = [
     "load_checkpoint",
     "save_checkpoint",
     "save_reference_artifacts",
+    "Timer",
+    "profile_phases",
+    "trace",
 ]

@@ -1,0 +1,130 @@
+"""Tracing and per-phase timing (SURVEY.md §5 "Tracing / profiling").
+
+The reference has no profiling at all — TF logging is silenced and the
+only observable is the per-episode console print. Here profiling is a
+first-class utility:
+
+- :func:`trace` — context manager around ``jax.profiler.trace``; writes a
+  TensorBoard/XProf-compatible trace of every XLA launch inside the block.
+- :func:`profile_phases` — a diagnostic that times the training
+  sub-programs SEPARATELY (rollout block, one phase I+II critic/TR epoch,
+  phase III actor update, full fused block), each jitted on its own with
+  a host-fetch barrier. In production the whole block is ONE fused XLA
+  program, so per-phase cost cannot be observed from the host; this
+  deliberately un-fused breakdown exists for performance work, not
+  training.
+- :class:`Timer` — tiny wall-clock timer with forced completion, used by
+  the benchmark harness and the phase profiler.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Dict
+
+import jax
+
+from rcmarl_tpu.training.update import team_average_reward
+
+
+@contextlib.contextmanager
+def trace(logdir: str, *, create_perfetto_link: bool = False):
+    """Record a device trace of everything run inside the block.
+
+    View with TensorBoard's profile plugin or Perfetto:
+    ``tensorboard --logdir <logdir>``.
+    """
+    jax.profiler.start_trace(logdir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Timer:
+    """Wall-clock timer whose stop forces device completion of ``value``."""
+
+    def __init__(self) -> None:
+        self._t0 = 0.0
+        self.elapsed = 0.0
+
+    def start(self) -> "Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def stop(self, value=None) -> float:
+        """Stop after fetching ``value`` (a jax array/pytree), if given.
+
+        A host-side fetch is used rather than ``block_until_ready``
+        because some remote backends complete the latter early.
+        """
+        if value is not None:
+            jax.device_get(value)
+        self.elapsed = time.perf_counter() - self._t0
+        return self.elapsed
+
+
+def _timeit(fn: Callable, *args, warmup: int = 1, reps: int = 3) -> float:
+    """Best-of-``reps`` wall time after ``warmup`` compile/warm calls."""
+    for _ in range(warmup):
+        # fetch, don't just dispatch: queued warmup work would otherwise
+        # drain inside the first timed rep
+        jax.device_get(fn(*args))
+    best = float("inf")
+    for _ in range(reps):
+        t = Timer().start()
+        out = fn(*args)
+        best = min(best, t.stop(out))
+    return best
+
+
+def profile_phases(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
+    """Time each training sub-program separately; returns seconds per call.
+
+    Keys: ``rollout_block`` (n_ep_fixed scanned episodes),
+    ``critic_tr_epoch`` (ONE phase I+II epoch over the replay window —
+    the production block runs ``cfg.n_epochs`` of these),
+    ``actor_phase`` (phase III over the fresh window), and
+    ``full_block`` (the production fused program: rollout + n_epochs
+    epochs + actor + buffer push).
+    """
+    from rcmarl_tpu.training.buffer import update_batch
+    from rcmarl_tpu.training.rollout import rollout_block
+    from rcmarl_tpu.training.trainer import (
+        init_train_state,
+        make_env,
+        train_block,
+    )
+    from rcmarl_tpu.training.update import actor_phase, critic_tr_epoch
+
+    if state is None:
+        state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
+    # one production block first: warm the buffer to steady-state occupancy
+    state, _ = train_block(cfg, state)
+
+    env = make_env(cfg)
+    key = jax.random.PRNGKey(0)
+    out: Dict[str, float] = {}
+
+    roll = jax.jit(
+        lambda s, k: rollout_block(cfg, env, s.params, s.desired, k, s.initial)
+    )
+    out["rollout_block"] = _timeit(roll, state, key, reps=reps)
+
+    fresh, _ = roll(state, key)
+    batch = jax.jit(update_batch)(state.buffer, fresh)
+    r_coop = team_average_reward(cfg, batch.r)
+
+    epoch = jax.jit(
+        lambda p, b, rc, k: critic_tr_epoch(
+            cfg, (p.critic, p.tr, p.critic_local), b, rc, k
+        )
+    )
+    out["critic_tr_epoch"] = _timeit(epoch, state.params, batch, r_coop, key, reps=reps)
+
+    actor = jax.jit(lambda p, f, k: actor_phase(cfg, p, f, k))
+    out["actor_phase"] = _timeit(actor, state.params, fresh, key, reps=reps)
+
+    out["full_block"] = _timeit(lambda s: train_block(cfg, s), state, reps=reps)
+    return out

@@ -1,0 +1,55 @@
+"""Profiling utilities (SURVEY.md §5 tracing/profiling subsystem)."""
+
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+from rcmarl_tpu.utils.profiling import Timer, profile_phases, trace
+
+
+def tiny_cfg():
+    return Config(
+        n_agents=3,
+        agent_roles=(Roles.COOPERATIVE, Roles.COOPERATIVE, Roles.GREEDY),
+        in_nodes=circulant_in_nodes(3, 2),
+        nrow=3,
+        ncol=3,
+        max_ep_len=4,
+        n_ep_fixed=2,
+        n_epochs=1,
+        buffer_size=16,
+        hidden=(8, 8),
+        coop_fit_steps=1,
+        adv_fit_epochs=1,
+        adv_fit_batch=4,
+        batch_size=4,
+        n_episodes=2,
+    )
+
+
+def test_timer_forces_completion():
+    t = Timer().start()
+    x = jnp.ones((64, 64)) @ jnp.ones((64, 64))
+    dt = t.stop(x)
+    assert dt > 0 and t.elapsed == dt
+
+
+def test_profile_phases_covers_training_subprograms():
+    times = profile_phases(tiny_cfg(), reps=1)
+    assert set(times) == {
+        "rollout_block",
+        "critic_tr_epoch",
+        "actor_phase",
+        "full_block",
+    }
+    assert all(v > 0 for v in times.values())
+
+
+def test_trace_writes_artifacts(tmp_path):
+    logdir = tmp_path / "trace"
+    with trace(str(logdir)):
+        jax.block_until_ready(jnp.ones((32, 32)) @ jnp.ones((32, 32)))
+    files = list(Path(logdir).rglob("*"))
+    assert any(f.is_file() for f in files)
