@@ -23,7 +23,9 @@ from pathlib import Path
 
 import numpy as np
 
-from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+import math
+
+from rcmarl_tpu.config import Config, Roles, circulant_in_nodes, full_in_nodes
 
 #: The published experiment matrix (reference README "four scenarios" and
 #: raw_data/ layout): the adversary, when present, is node 4 (verified in
@@ -353,6 +355,107 @@ def cmd_sweep(argv) -> int:
 
 
 # --------------------------------------------------------------------------
+# bench
+# --------------------------------------------------------------------------
+
+#: BASELINE.json's scaling matrix. ``degree`` = non-self in-neighbors on a
+#: circulant ring (None = full graph); reference topology is the first row
+#: (n_in=4 incl. self, main.py:28).
+BENCH_CONFIGS = {
+    "ref5_ring": dict(n_agents=5, hidden=(20, 20), degree=3, H=1),
+    "n16_ring": dict(n_agents=16, hidden=(20, 20), degree=4, H=1),
+    "n16_full": dict(n_agents=16, hidden=(20, 20), degree=None, H=1),
+    "n64_ring": dict(n_agents=64, hidden=(20, 20), degree=4, H=1),
+    "n64_full": dict(n_agents=64, hidden=(20, 20), degree=None, H=1),
+    "n64_large_h2": dict(n_agents=64, hidden=(256, 256, 256), degree=8, H=2),
+}
+
+
+def _bench_config(name: str, impl: str, n_ep_fixed: int) -> Config:
+    spec = BENCH_CONFIGS[name]
+    n = spec["n_agents"]
+    side = max(3, int(round(math.sqrt(n))))  # BASELINE: sqrt(N) x sqrt(N) grid
+    if spec["degree"] is None:
+        in_nodes = full_in_nodes(n)
+    else:
+        in_nodes = circulant_in_nodes(n, spec["degree"] + 1)
+    return Config(
+        n_agents=n,
+        agent_roles=(Roles.COOPERATIVE,) * n,
+        in_nodes=in_nodes,
+        nrow=side,
+        ncol=side,
+        hidden=spec["hidden"],
+        H=spec["H"],
+        n_episodes=n_ep_fixed,
+        n_ep_fixed=n_ep_fixed,
+        slow_lr=0.002,
+        consensus_impl=impl,
+    )
+
+
+def cmd_bench(argv) -> int:
+    p = argparse.ArgumentParser(
+        prog="rcmarl_tpu bench",
+        description="Scaling benchmark over BASELINE.json's config matrix "
+        "(agent count, graph density, model size, consensus impl)",
+    )
+    p.add_argument(
+        "--configs",
+        nargs="+",
+        default=list(BENCH_CONFIGS),
+        choices=list(BENCH_CONFIGS),
+    )
+    p.add_argument(
+        "--impl",
+        nargs="+",
+        default=["xla"],
+        choices=["xla", "pallas", "pallas_interpret"],
+        help="consensus implementation(s) to compare",
+    )
+    p.add_argument("--n_ep_fixed", type=int, default=10)
+    p.add_argument("--blocks", type=int, default=3, help="timed blocks per rep")
+    p.add_argument("--reps", type=int, default=3)
+    args = p.parse_args(argv)
+    if args.blocks < 1 or args.reps < 1 or args.n_ep_fixed < 1:
+        raise SystemExit("--blocks, --reps, and --n_ep_fixed must be >= 1")
+
+    import jax
+
+    from rcmarl_tpu.training.trainer import init_train_state, train_scanned
+    from rcmarl_tpu.utils.profiling import Timer
+
+    for name in args.configs:
+        for impl in args.impl:
+            cfg = _bench_config(name, impl, args.n_ep_fixed)
+            state = init_train_state(cfg, jax.random.PRNGKey(0))
+            run = jax.jit(lambda s, cfg=cfg: train_scanned(cfg, s, args.blocks))
+            state, metrics = run(state)  # compile + warm
+            jax.device_get(metrics.true_team_returns)
+            best = float("inf")
+            for _ in range(args.reps):
+                t = Timer().start()
+                state, metrics = run(state)
+                best = min(best, t.stop(metrics.true_team_returns))
+            steps = args.blocks * cfg.block_steps
+            print(
+                json.dumps(
+                    {
+                        "config": name,
+                        "impl": impl,
+                        "n_agents": cfg.n_agents,
+                        "n_in": cfg.n_in,
+                        "hidden": list(cfg.hidden),
+                        "H": cfg.H,
+                        "env_steps_per_sec": round(steps / best, 1),
+                        "sec_per_block": round(best / args.blocks, 4),
+                    }
+                )
+            )
+    return 0
+
+
+# --------------------------------------------------------------------------
 # plot
 # --------------------------------------------------------------------------
 
@@ -395,7 +498,12 @@ def cmd_plot(argv) -> int:
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    cmds = {"train": cmd_train, "sweep": cmd_sweep, "plot": cmd_plot}
+    cmds = {
+        "train": cmd_train,
+        "sweep": cmd_sweep,
+        "plot": cmd_plot,
+        "bench": cmd_bench,
+    }
     if not argv or argv[0] in ("-h", "--help"):
         print(f"usage: python -m rcmarl_tpu {{{','.join(cmds)}}} [flags]")
         return 0 if argv else 2

@@ -198,6 +198,21 @@ class TestCLI:
         msg = capsys.readouterr().out
         assert "WARNING" in msg and "gamma" in msg
 
+    def test_bench_reports_scaling_configs(self, capsys):
+        import json as _json
+
+        assert main([
+            "bench", "--configs", "ref5_ring", "--impl", "xla",
+            "--n_ep_fixed", "2", "--blocks", "1", "--reps", "1",
+        ]) == 0
+        rows = [
+            _json.loads(line)
+            for line in capsys.readouterr().out.strip().splitlines()
+        ]
+        assert rows[0]["config"] == "ref5_ring"
+        assert rows[0]["n_in"] == 4  # reference topology incl. self
+        assert rows[0]["env_steps_per_sec"] > 0
+
     def test_sweep_plot_summary(self, tmp_path, capsys):
         raw = tmp_path / "raw_data"
         assert main([
