@@ -21,11 +21,17 @@ import sys
 import time
 from pathlib import Path
 
-import numpy as np
-
 import math
 
-from rcmarl_tpu.config import Config, Roles, circulant_in_nodes, full_in_nodes
+import numpy as np
+
+from rcmarl_tpu.config import (
+    CONSENSUS_IMPLS,
+    Config,
+    Roles,
+    circulant_in_nodes,
+    full_in_nodes,
+)
 
 #: The published experiment matrix (reference README "four scenarios" and
 #: raw_data/ layout): the adversary, when present, is node 4 (verified in
@@ -90,6 +96,13 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         default=None,
         help="preset cast: coop/greedy/faulty/malicious[_global]",
     )
+    p.add_argument(
+        "--consensus_impl",
+        type=str,
+        default="xla",
+        choices=list(CONSENSUS_IMPLS),
+        help="consensus aggregation backend (pallas = fused TPU kernel)",
+    )
 
 
 def config_from_args(args) -> Config:
@@ -137,6 +150,7 @@ def config_from_args(args) -> Config:
         common_reward=common,
         eps_explore=args.eps,
         seed=getattr(args, "random_seed", 300),
+        consensus_impl=args.consensus_impl,
     )
 
 
@@ -305,6 +319,13 @@ def cmd_sweep(argv) -> int:
     p.add_argument("--fast_lr", type=float, default=0.01)
     p.add_argument("--out", type=str, default="./simulation_results/raw_data")
     p.add_argument("--phase", type=int, default=1, help="sim_data<phase>.pkl")
+    p.add_argument(
+        "--consensus_impl",
+        type=str,
+        default="xla",
+        choices=list(CONSENSUS_IMPLS),
+        help="consensus aggregation backend (pallas = fused TPU kernel)",
+    )
     args = p.parse_args(argv)
     if args.n_episodes <= 0 or args.n_episodes % args.n_ep_fixed != 0:
         raise SystemExit(
@@ -330,6 +351,7 @@ def cmd_sweep(argv) -> int:
                 buffer_size=args.buffer_size,
                 slow_lr=args.slow_lr,
                 fast_lr=args.fast_lr,
+                consensus_impl=args.consensus_impl,
             )
             n_blocks = args.n_episodes // cfg.n_ep_fixed
             t0 = time.perf_counter()
@@ -410,7 +432,7 @@ def cmd_bench(argv) -> int:
         "--impl",
         nargs="+",
         default=["xla"],
-        choices=["xla", "pallas", "pallas_interpret"],
+        choices=list(CONSENSUS_IMPLS),
         help="consensus implementation(s) to compare",
     )
     p.add_argument("--n_ep_fixed", type=int, default=10)

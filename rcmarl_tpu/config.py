@@ -18,6 +18,10 @@ from dataclasses import dataclass
 from typing import Tuple
 
 
+#: Valid consensus aggregation backends (see ops/aggregation.py).
+CONSENSUS_IMPLS = ("xla", "pallas", "pallas_interpret")
+
+
 class Roles:
     """Integer role codes for the four agent behaviors (reference
     ``main.py:88-104`` dispatches on the same four labels)."""
@@ -136,10 +140,10 @@ class Config:
             raise ValueError(
                 f"H={self.H} too large for in-degree {n_in}: need 2H <= n_in-1"
             )
-        if self.consensus_impl not in ("xla", "pallas", "pallas_interpret"):
+        if self.consensus_impl not in CONSENSUS_IMPLS:
             raise ValueError(
-                f"consensus_impl={self.consensus_impl!r}: expected "
-                "'xla', 'pallas', or 'pallas_interpret'"
+                f"consensus_impl={self.consensus_impl!r}: expected one of "
+                f"{CONSENSUS_IMPLS}"
             )
 
     # ---- derived (static) quantities ----

@@ -36,6 +36,16 @@ def test_matches_xla_reference(n_in, H, shape):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
 
 
+def test_multi_tile_grid():
+    """Payload larger than one block (block_rows*128) exercises the
+    BlockSpec index_map across several grid steps — the path taken at
+    the kernel's target scale (N=64 agents, 256-wide trunks)."""
+    vals = jax.random.normal(jax.random.PRNGKey(11), (5, 300, 41))  # 12300 el
+    want = resilient_aggregate(vals, 2)
+    got = fused_resilient_aggregate(vals, 2, block_rows=8, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
 def test_h2_wide_neighborhood():
     vals = jax.random.normal(jax.random.PRNGKey(0), (7, 129))  # pad path
     want = resilient_aggregate(vals, 2)

@@ -1,0 +1,138 @@
+"""Property-based tests (hypothesis) for the consensus kernel and env.
+
+The reference validates these components only empirically (SURVEY.md §4);
+here the algebraic contracts that make the H-trimming defense work are
+pinned as properties over randomized inputs:
+
+- resilient aggregation: H=0 degenerates to the mean; output always lies
+  within [min, max] of the inputs; invariant to permutations of the
+  non-self neighbors; affine-equivariant; and — the Byzantine-resilience
+  contract — with at most H adversarial inputs the output stays within
+  the cooperative inputs' range no matter what the adversaries send.
+- grid world: positions stay in the grid under arbitrary action
+  sequences; rewards have the documented sign/zero structure.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+from hypothesis.extra.numpy import arrays
+
+from rcmarl_tpu.envs.grid_world import GridWorld, env_step
+from rcmarl_tpu.ops.aggregation import resilient_aggregate
+
+finite = st.floats(-1e6, 1e6, allow_nan=False, width=32)
+
+
+def vals_strategy(min_n=3, max_n=9, m=5):
+    return st.integers(min_n, max_n).flatmap(
+        lambda n: arrays(np.float32, (n, m), elements=finite)
+    )
+
+
+@settings(max_examples=25, deadline=None)
+@given(vals_strategy())
+def test_h0_is_mean(vals):
+    out = resilient_aggregate(jnp.asarray(vals), 0)
+    np.testing.assert_allclose(
+        np.asarray(out), vals.mean(axis=0), rtol=1e-4, atol=1e-4
+    )
+
+
+@settings(max_examples=25, deadline=None)
+@given(vals_strategy(), st.integers(0, 3))
+def test_output_within_input_range(vals, H):
+    n = vals.shape[0]
+    if 2 * H > n - 1:
+        H = (n - 1) // 2
+    out = np.asarray(resilient_aggregate(jnp.asarray(vals), H))
+    tol = 1e-4 + 1e-5 * np.abs(vals).max(axis=0)  # f32 summation rounding
+    assert (out <= vals.max(axis=0) + tol).all()
+    assert (out >= vals.min(axis=0) - tol).all()
+
+
+@settings(max_examples=25, deadline=None)
+@given(vals_strategy(min_n=4), st.randoms(use_true_random=False))
+def test_permutation_invariance_of_neighbors(vals, rng):
+    """Aggregation must not depend on the order neighbors arrive in —
+    only index 0 (own value) is special."""
+    n = vals.shape[0]
+    perm = list(range(1, n))
+    rng.shuffle(perm)
+    permuted = vals[[0] + perm]
+    a = np.asarray(resilient_aggregate(jnp.asarray(vals), 1))
+    b = np.asarray(resilient_aggregate(jnp.asarray(permuted), 1))
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    vals_strategy(),
+    st.floats(0.1, 10.0, allow_nan=False),
+    st.floats(-100.0, 100.0, allow_nan=False),
+)
+def test_affine_equivariance(vals, a, b):
+    """agg(a*x + b) == a*agg(x) + b for a > 0 (sort/clip/mean are all
+    affine-equivariant), so consensus is unit-independent."""
+    x = jnp.asarray(vals)
+    lhs = np.asarray(resilient_aggregate(a * x + b, 1))
+    rhs = a * np.asarray(resilient_aggregate(x, 1)) + b
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-3, atol=1e-2)
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    arrays(np.float32, (4, 5), elements=st.floats(-10, 10, allow_nan=False, width=32)),
+    arrays(np.float32, (1, 5), elements=finite),
+)
+def test_byzantine_bound(coop, adv):
+    """With own value cooperative and <= H adversarial neighbors, the
+    aggregate stays within the cooperative range REGARDLESS of what the
+    adversary transmits — the defense's core guarantee."""
+    vals = jnp.concatenate([jnp.asarray(coop), jnp.asarray(adv)], axis=0)
+    out = np.asarray(resilient_aggregate(vals, 1))
+    assert (out <= coop.max(axis=0) + 1e-4).all()
+    assert (out >= coop.min(axis=0) - 1e-4).all()
+
+
+# ---------------------------------------------------------------------------
+# Environment invariants
+# ---------------------------------------------------------------------------
+
+
+@settings(max_examples=20, deadline=None)
+@given(
+    st.integers(0, 10**6),
+    st.lists(st.integers(0, 4), min_size=1, max_size=30),
+    st.booleans(),
+)
+def test_positions_stay_in_grid(seed, action_seq, collision):
+    env = GridWorld(nrow=4, ncol=6, n_agents=3, collision_physics=collision)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    pos = jax.random.randint(k1, (3, 2), 0, jnp.array([4, 6]), dtype=jnp.int32)
+    desired = jax.random.randint(k2, (3, 2), 0, jnp.array([4, 6]), dtype=jnp.int32)
+    for a in action_seq:
+        actions = jnp.full((3,), a, jnp.int32)
+        pos, reward = env_step(env, pos, desired, actions)
+        assert bool((pos[:, 0] >= 0).all() and (pos[:, 0] < 4).all())
+        assert bool((pos[:, 1] >= 0).all() and (pos[:, 1] < 6).all())
+        assert bool((np.asarray(reward) <= 0).all())
+
+
+@settings(max_examples=20, deadline=None)
+@given(st.integers(0, 10**6))
+def test_zero_reward_iff_stay_at_goal(seed):
+    env = GridWorld(nrow=5, ncol=5, n_agents=4)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    pos = jax.random.randint(k1, (4, 2), 0, 5, dtype=jnp.int32)
+    # random goals, but agent 0 pinned exactly at its goal
+    desired = jax.random.randint(k2, (4, 2), 0, 5, dtype=jnp.int32)
+    desired = desired.at[0].set(pos[0])
+    actions = jnp.zeros((4,), jnp.int32)  # everyone stays
+    _, reward = env_step(env, pos, desired, actions)
+    at_goal = np.asarray(jnp.sum(jnp.abs(pos - desired), axis=1) == 0)
+    r = np.asarray(reward)
+    assert (r[at_goal] == 0).all()
+    assert (r[~at_goal] < 0).all()
