@@ -1,3 +1,8 @@
+from rcmarl_tpu.parallel.distributed import (  # noqa: F401
+    gather_metrics,
+    initialize,
+    multihost_mesh,
+)
 from rcmarl_tpu.parallel.seeds import (  # noqa: F401
     init_states,
     make_mesh,

@@ -1,0 +1,119 @@
+"""Multi-host (multi-process) execution over ICI + DCN.
+
+The reference's only scale-out mechanism is submitting more SGE jobs
+(SURVEY.md C15). Here multi-host runs are the same single jitted program
+as :func:`rcmarl_tpu.parallel.seeds.train_parallel`, launched once per
+host with a shared coordinator — the JAX SPMD model (one controller per
+process, XLA partitions globally).
+
+Axis-to-fabric mapping (the design rule, not an accident):
+
+- The ``seed`` axis carries ZERO collectives (replicas are independent),
+  so it is the axis that may span hosts — traffic over DCN is nil except
+  for the final metrics gather.
+- The ``agent`` axis carries the consensus gather/all-gather every epoch,
+  so agent groups must stay within one host's chips where XLA lowers the
+  collectives onto ICI. :func:`multihost_mesh` enforces this by keeping
+  the agent dimension inside each process's local devices.
+
+None of this requires code changes elsewhere: ``Mesh`` axes are named, and
+``train_parallel`` accepts any mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+#: Env vars consulted by :func:`initialize` (the standard JAX cluster set).
+_COORD_ENV = "JAX_COORDINATOR_ADDRESS"
+_NPROC_ENV = "JAX_NUM_PROCESSES"
+_PID_ENV = "JAX_PROCESS_ID"
+
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    *,
+    auto: bool = False,
+) -> None:
+    """Join (or start) a multi-host JAX cluster.
+
+    Thin wrapper over ``jax.distributed.initialize`` that (a) reads the
+    standard env vars when args are omitted, (b) is a no-op when no
+    cluster configuration is present so the same launch script works on a
+    single host, and (c) is idempotent.
+
+    Args left as None are passed through as None so JAX's cluster
+    auto-detection (TPU pod metadata, SLURM, ...) can fill them in; on a
+    managed TPU pod with no env vars set, pass ``auto=True`` to force
+    full auto-detection instead of the single-host no-op.
+
+    MUST run before any other JAX call: querying devices (even
+    ``jax.process_count()``) initializes the local backend, after which
+    distributed initialization is rejected.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(_COORD_ENV)
+    if num_processes is None and _NPROC_ENV in os.environ:
+        num_processes = int(os.environ[_NPROC_ENV])
+    if process_id is None and _PID_ENV in os.environ:
+        process_id = int(os.environ[_PID_ENV])
+    no_cluster_config = (
+        coordinator_address is None
+        and num_processes is None
+        and process_id is None
+    )
+    if no_cluster_config and not auto:
+        return  # single host, nothing to coordinate
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+
+
+def multihost_mesh(agent_axis: int = 1) -> Mesh:
+    """A global ('seed', 'agent') mesh with agent groups pinned to hosts.
+
+    ``jax.devices()`` orders devices process-by-process, so reshaping to
+    (n_global // agent_axis, agent_axis) makes each agent group a
+    contiguous run of one process's local devices — consensus collectives
+    ride ICI, the host-spanning seed axis carries no traffic.
+
+    Args:
+      agent_axis: devices per agent-sharding group; must divide the LOCAL
+        device count (an agent group must not straddle hosts).
+    """
+    local = jax.local_device_count()
+    if agent_axis < 1 or local % agent_axis != 0:
+        raise ValueError(
+            f"agent_axis={agent_axis} must divide the local device count "
+            f"{local} so consensus collectives stay on ICI"
+        )
+    devs = np.asarray(jax.devices())
+    return Mesh(devs.reshape(-1, agent_axis), ("seed", "agent"))
+
+
+def gather_metrics(metrics):
+    """All-gather per-replica metrics across hosts (the run's only DCN
+    traffic), returning host-local numpy with the global seed axis.
+
+    On a single process this is just ``jax.device_get``.
+    """
+    if jax.process_count() == 1:
+        return jax.tree.map(np.asarray, jax.device_get(metrics))
+    from jax.experimental import multihost_utils
+
+    return jax.tree.map(np.asarray, multihost_utils.process_allgather(metrics))

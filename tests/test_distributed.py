@@ -1,0 +1,75 @@
+"""Multi-host layer on the virtual 8-device CPU mesh.
+
+True multi-process runs need separate hosts; what IS testable here — and
+what the driver's dryrun validates too — is the mesh construction rule
+(agent groups contiguous, never straddling a host boundary), the
+single-process fallbacks, and that training actually executes over a
+multihost_mesh-shaped mesh.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+from rcmarl_tpu.parallel import (
+    gather_metrics,
+    initialize,
+    multihost_mesh,
+    train_parallel,
+)
+
+
+def test_initialize_single_process_noop(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    initialize()  # must not raise or try to reach a coordinator
+    assert jax.process_count() == 1
+
+
+def test_multihost_mesh_layout():
+    mesh = multihost_mesh(agent_axis=2)
+    assert mesh.axis_names == ("seed", "agent")
+    assert mesh.devices.shape == (4, 2)
+    # agent groups are contiguous device runs (the within-host rule)
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    assert (ids[:, 1] - ids[:, 0] == 1).all()
+
+
+def test_multihost_mesh_rejects_straddling():
+    with pytest.raises(ValueError, match="divide the local device count"):
+        multihost_mesh(agent_axis=3)
+
+
+def test_gather_metrics_single_process():
+    x = {"a": jax.numpy.arange(4.0)}
+    out = gather_metrics(x)
+    assert isinstance(out["a"], np.ndarray)
+    np.testing.assert_array_equal(out["a"], np.arange(4.0))
+
+
+def test_train_parallel_over_multihost_mesh():
+    cfg = Config(
+        n_agents=4,
+        agent_roles=(Roles.COOPERATIVE,) * 3 + (Roles.GREEDY,),
+        in_nodes=circulant_in_nodes(4, 3),
+        H=1,
+        nrow=3,
+        ncol=3,
+        n_episodes=2,
+        max_ep_len=2,
+        n_ep_fixed=2,
+        n_epochs=1,
+        buffer_size=8,
+        hidden=(8, 8),
+        coop_fit_steps=1,
+        adv_fit_epochs=1,
+        adv_fit_batch=4,
+        batch_size=4,
+    )
+    mesh = multihost_mesh(agent_axis=2)
+    states, metrics = train_parallel(
+        cfg, seeds=list(range(4)), n_blocks=1, mesh=mesh, shard_agents=True
+    )
+    got = gather_metrics(metrics)
+    assert got.true_team_returns.shape == (4, 2)  # (seeds, episodes)
+    assert np.isfinite(got.true_team_returns).all()
