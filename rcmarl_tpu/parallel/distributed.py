@@ -102,8 +102,19 @@ def multihost_mesh(agent_axis: int = 1) -> Mesh:
             f"agent_axis={agent_axis} must divide the local device count "
             f"{local} so consensus collectives stay on ICI"
         )
-    devs = np.asarray(jax.devices())
-    return Mesh(devs.reshape(-1, agent_axis), ("seed", "agent"))
+    # jax.devices() does NOT guarantee process grouping (on some slice
+    # topologies global order follows physical coordinates), so group
+    # explicitly and verify the invariant instead of assuming it.
+    devs = np.asarray(
+        sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    ).reshape(-1, agent_axis)
+    for row in devs:
+        procs = {d.process_index for d in row}
+        if len(procs) != 1:  # pragma: no cover - needs >1 process
+            raise AssertionError(
+                f"agent group {[d.id for d in row]} spans processes {procs}"
+            )
+    return Mesh(devs, ("seed", "agent"))
 
 
 def gather_metrics(metrics):
