@@ -9,26 +9,52 @@ sizes every op is tiny, so replicas batch onto the chip almost for free
 and aggregate throughput is the honest utilization number.
 
 Baseline: the reference's ~2.5 env-steps/s per 4-core CPU job
-(BASELINE.md). Timing is measured to a host-side fetch of a value that
-depends on the whole computation — on the axon backend,
-``block_until_ready`` does not actually wait.
+(BASELINE.md).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Robustness (round-1 post-mortem, VERDICT.md item 1): the axon TPU tunnel
+can be down in two ways — a fast ``RuntimeError: Unable to initialize
+backend`` or a silent hang on first device contact. Neither may cost us
+the round's only perf artifact again, so the measurement runs in child
+subprocesses with hard wall-clock timeouts, orchestrated by this parent:
+
+1. probe the TPU with a tiny program and a short timeout (cheap first
+   contact — no compile of the full trainer at risk);
+2. on success, run the full TPU measurement (generous timeout: first
+   compile of the scanned trainer is slow);
+3. retry the probe with backoff a bounded number of times;
+4. if the TPU never comes up, fall back to a smaller CPU measurement so
+   the driver still records a real, parsable number (tagged
+   ``"platform": "cpu"`` — honest, not a fake TPU claim).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"platform", "attempts"}.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
 BASELINE_STEPS_PER_SEC = 2.5  # reference CPU throughput (BASELINE.md)
-N_SEEDS = 32  # replicas batched on the single chip
-N_BLOCKS = 10  # 500 episodes / 10k env steps per replica per repetition
+
+PROBE_TIMEOUT_S = 240  # tiny program; a healthy tunnel answers in < 60s
+TPU_TIMEOUT_S = 1800  # full run incl. first compile (~20-40s) + execution
+CPU_TIMEOUT_S = 1200
+PROBE_ATTEMPTS = 3
+BACKOFF_S = 30.0
 
 
-def main():
+def _measure(n_seeds: int, n_blocks: int, reps: int) -> None:
+    """Child: run the measurement on whatever backend JAX_PLATFORMS says.
+
+    Prints one JSON line with the raw measurement; the parent re-emits it
+    with orchestration metadata attached.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from rcmarl_tpu.config import Config
     from rcmarl_tpu.parallel.seeds import init_states
     from rcmarl_tpu.training import train_scanned
@@ -36,8 +62,8 @@ def main():
     # Published-run hyperparameters (job.sh: slow_lr=0.002; BASELINE.md)
     cfg = Config(slow_lr=0.002, fast_lr=0.01, seed=100)
 
-    states = init_states(cfg, list(range(100, 100 + N_SEEDS)))
-    run = jax.jit(jax.vmap(lambda s: train_scanned(cfg, s, N_BLOCKS)))
+    states = init_states(cfg, list(range(100, 100 + n_seeds)))
+    run = jax.jit(jax.vmap(lambda s: train_scanned(cfg, s, n_blocks)))
 
     def fetch(states, metrics):
         """Force completion: pull a scalar depending on every replica."""
@@ -47,7 +73,6 @@ def main():
     states, metrics = run(states)
     fetch(states, metrics)
 
-    reps = 3
     t0 = time.perf_counter()
     for _ in range(reps):
         states, metrics = run(states)
@@ -55,19 +80,127 @@ def main():
     dt = time.perf_counter() - t0
     assert np.isfinite(checksum)
 
-    steps = reps * N_SEEDS * N_BLOCKS * cfg.block_steps
-    sps = steps / dt
+    steps = reps * n_seeds * n_blocks * cfg.block_steps
     print(
         json.dumps(
             {
                 "metric": "train_env_steps_per_sec",
-                "value": round(sps, 1),
+                "value": round(steps / dt, 1),
                 "unit": "steps/s",
-                "vs_baseline": round(sps / BASELINE_STEPS_PER_SEC, 1),
+                "vs_baseline": round(steps / dt / BASELINE_STEPS_PER_SEC, 1),
+                "platform": jax.devices()[0].platform,
             }
         )
     )
 
 
+def _probe() -> None:
+    """Child: the cheapest possible end-to-end device contact."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((128, 128))
+    assert float((x @ x).sum()) == 128.0 * 128 * 128
+    print(json.dumps({"probe": "ok", "platform": jax.devices()[0].platform}))
+
+
+def _run_child(argv, env_overrides, timeout_s):
+    """Run this script as a child with a hard timeout.
+
+    Returns the parsed JSON from the child's last stdout line, or an
+    error dict {"error": ...} — never raises.
+    """
+    env = dict(os.environ)
+    env.update(env_overrides)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), *argv],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout_s}s"}
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    if proc.returncode != 0 or not lines:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
+        return {"error": f"rc={proc.returncode}: " + " | ".join(tail)[-400:]}
+    try:
+        return json.loads(lines[-1])
+    except json.JSONDecodeError:
+        return {"error": f"unparsable child output: {lines[-1][:200]}"}
+
+
+def main() -> int:
+    attempts = []
+    # 1-3: probe the TPU, with bounded retries + backoff on any failure
+    # (covers both the fast RuntimeError and the silent-hang mode).
+    tpu_ok = False
+    for i in range(PROBE_ATTEMPTS):
+        res = _run_child(["--probe"], {}, PROBE_TIMEOUT_S)
+        attempts.append({"stage": f"probe{i}", **res})
+        # Require a non-CPU platform: JAX can silently fall back to CPU
+        # instead of raising, and a CPU "probe ok" must not trigger the
+        # full-size measurement.
+        if res.get("probe") == "ok" and res.get("platform") != "cpu":
+            tpu_ok = True
+            break
+        if i + 1 < PROBE_ATTEMPTS:
+            time.sleep(BACKOFF_S * (2**i))
+
+    if tpu_ok:
+        res = _run_child(
+            ["--child", "--seeds", "32", "--blocks", "10", "--reps", "3"],
+            {},
+            TPU_TIMEOUT_S,
+        )
+        attempts.append({"stage": "tpu_measure", **res})
+        if "value" in res:
+            res["attempts"] = len(attempts)
+            print(json.dumps(res))
+            return 0
+
+    # Fallback: a smaller CPU measurement — still a real end-to-end number
+    # the driver can parse, honestly tagged with its platform.
+    res = _run_child(
+        ["--child", "--seeds", "4", "--blocks", "2", "--reps", "1"],
+        {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+        CPU_TIMEOUT_S,
+    )
+    attempts.append({"stage": "cpu_measure", **res})
+    if "value" in res:
+        res["attempts"] = len(attempts)
+        res["note"] = "TPU backend unavailable; CPU fallback measurement"
+        print(json.dumps(res))
+        return 0
+
+    # Total failure: emit a structured record so the round still has an
+    # artifact explaining what happened.
+    print(
+        json.dumps(
+            {
+                "metric": "train_env_steps_per_sec",
+                "value": None,
+                "unit": "steps/s",
+                "vs_baseline": None,
+                "error": attempts,
+            }
+        )
+    )
+    return 1
+
+
 if __name__ == "__main__":
-    main()
+    if "--probe" in sys.argv:
+        _probe()
+    elif "--child" in sys.argv:
+        args = sys.argv
+        _measure(
+            n_seeds=int(args[args.index("--seeds") + 1]),
+            n_blocks=int(args[args.index("--blocks") + 1]),
+            reps=int(args[args.index("--reps") + 1]),
+        )
+    else:
+        sys.exit(main())

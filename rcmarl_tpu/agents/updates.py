@@ -179,6 +179,7 @@ def consensus_update_one(
     x: jnp.ndarray,
     mask: jnp.ndarray,
     cfg: Config,
+    valid: jnp.ndarray | None = None,
 ) -> MLPParams:
     """Full Phase-II update for ONE cooperative agent's critic or TR net.
 
@@ -188,6 +189,9 @@ def consensus_update_one(
       nbr_msgs: gathered neighbor messages, leaves (n_in, ...), own
         message at index 0 (in_nodes convention).
       x: (B, ...) the net's input batch (s for critic, sa for TR).
+      valid: optional (n_in,) edge-validity mask when the graph has
+        heterogeneous in-degrees and neighborhoods are padded (see
+        :func:`rcmarl_tpu.ops.aggregation.resilient_aggregate`).
 
     Steps b-d of reference train_agents.py:125-145:
       b) hidden consensus (resilient_CAC_agents.py:142-166): clip-mean
@@ -203,7 +207,10 @@ def consensus_update_one(
     n_trunk = len(own) - 1
     # b) hidden-layer consensus over trunk arrays
     trunk_agg = resilient_aggregate_tree(
-        tuple(nbr_msgs[i] for i in range(n_trunk)), cfg.H, cfg.consensus_impl
+        tuple(nbr_msgs[i] for i in range(n_trunk)),
+        cfg.H,
+        cfg.consensus_impl,
+        valid=valid,
     )
     new_params: MLPParams = tuple(trunk_agg) + (own[-1],)
     # c) projection: phi with aggregated trunk, all neighbor heads at once
@@ -215,7 +222,7 @@ def consensus_update_one(
         )
         + b_nbr[:, None, :]
     )  # (n_in, B, 1)
-    agg = resilient_aggregate(vals, cfg.H, cfg.consensus_impl)  # (B, 1)
+    agg = resilient_aggregate(vals, cfg.H, cfg.consensus_impl, valid=valid)  # (B, 1)
     agg = jax.lax.stop_gradient(agg)
     # d) normalized team update of the head only
     phi_sg = jax.lax.stop_gradient(phi)

@@ -115,6 +115,170 @@ def final_returns(
     return pd.DataFrame(rows)
 
 
+def per_seed_final_returns(raw_data_dir, window: int = 500) -> pd.DataFrame:
+    """Per-(scenario, H, seed) final-``window`` mean returns — the
+    disaggregated form of :func:`final_returns`, exposing the seed spread
+    (VERDICT.md round-1: parity deltas need error bars to separate 3-seed
+    noise from systematic drift)."""
+    rows = []
+    root = Path(raw_data_dir)
+    scen_dirs = (
+        sorted(p for p in root.iterdir() if p.is_dir()) if root.is_dir() else []
+    )
+    for scen_dir in scen_dirs:
+        for H in _h_cells(scen_dir):
+            h_dir = scen_dir / f"H={H}"
+            for seed_dir in sorted(h_dir.iterdir()):
+                if not seed_dir.is_dir():
+                    continue
+                try:
+                    phases = load_run(seed_dir)
+                except FileNotFoundError:
+                    continue
+                run = pd.concat(phases, ignore_index=True)
+                tail = run.iloc[-window:]
+                rows.append(
+                    {
+                        "scenario": scen_dir.name,
+                        "H": H,
+                        "seed": seed_dir.name.split("=")[-1],
+                        "team_return": tail["True_team_returns"].mean(),
+                        "adv_return": tail["True_adv_returns"].mean(),
+                        "episodes": len(run),
+                    }
+                )
+    return pd.DataFrame(
+        rows,
+        columns=["scenario", "H", "seed", "team_return", "adv_return", "episodes"],
+    )
+
+
+def parity_table(
+    mine_dir, ref_dir, window: int = 500, tolerance: float = 0.05
+) -> pd.DataFrame:
+    """Cell-by-cell convergence comparison of two experiment trees with
+    identical layout (ours vs the reference's shipped
+    ``simulation_results/raw_data``) — the reference numbers are computed
+    from its artifacts by the SAME pipeline, not transcribed by hand.
+
+    Columns: reference/mine team returns (seed mean), seed std-devs,
+    delta, relative delta, and a within-``tolerance`` verdict.
+    """
+    mine = per_seed_final_returns(mine_dir, window)
+    ref = per_seed_final_returns(ref_dir, window)
+    # Union of cells from BOTH trees: a cell we trained that the reference
+    # never shipped must still appear (as 'no reference'), and a reference
+    # cell we haven't run yet appears as 'missing'.
+    cells = sorted(
+        set(map(tuple, ref[["scenario", "H"]].itertuples(index=False)))
+        | set(map(tuple, mine[["scenario", "H"]].itertuples(index=False)))
+    )
+    rows = []
+    for scen, H in cells:
+        r = ref[(ref.scenario == scen) & (ref.H == H)]
+        m = mine[(mine.scenario == scen) & (mine.H == H)]
+        row = {
+            "scenario": scen,
+            "H": H,
+            "ref_mean": r.team_return.mean() if len(r) else np.nan,
+            "ref_std": r.team_return.std(ddof=0) if len(r) else np.nan,
+            "ref_seeds": len(r),
+            "mine_mean": m.team_return.mean() if len(m) else np.nan,
+            "mine_std": m.team_return.std(ddof=0) if len(m) else np.nan,
+            "mine_seeds": len(m),
+            "ref_adv": r.adv_return.mean() if len(r) else np.nan,
+            "mine_adv": m.adv_return.mean() if len(m) else np.nan,
+        }
+        row["delta"] = row["mine_mean"] - row["ref_mean"]
+        row["rel"] = (
+            abs(row["delta"]) / abs(row["ref_mean"])
+            if np.isfinite(row["delta"]) and row["ref_mean"] != 0
+            else np.nan
+        )
+        if not len(r):
+            row["verdict"] = "no reference"
+        elif not np.isfinite(row["delta"]):
+            row["verdict"] = "missing"
+        elif row["rel"] <= tolerance:
+            row["verdict"] = "within"
+        else:
+            # outside tolerance on the mean — is the reference mean inside
+            # our seed spread (2 std)? then it's plausibly seed noise
+            spread = 2 * row["mine_std"] if np.isfinite(row["mine_std"]) else 0
+            row["verdict"] = (
+                "outside (seed-noise-compatible)"
+                if abs(row["delta"]) <= spread + 2 * row["ref_std"]
+                else "outside"
+            )
+        rows.append(row)
+    return pd.DataFrame(rows).sort_values(["scenario", "H"]).reset_index(drop=True)
+
+
+def write_parity_md(
+    table: pd.DataFrame,
+    path,
+    window: int = 500,
+    tolerance: float = 0.05,
+    extra_sections: str = "",
+    mine_dir: str = "simulation_results/raw_data",
+    ref_dir: str = "/root/reference/simulation_results/raw_data",
+) -> None:
+    """Render PARITY.md entirely from :func:`parity_table` output — no
+    hand-maintained result rows (VERDICT.md round-1 weakness 1)."""
+    lines = [
+        "# PARITY — measured convergence vs the reference's shipped artifacts",
+        "",
+        "**Generated by `python -m rcmarl_tpu parity` — do not edit result",
+        "rows by hand.** Both columns are computed by the same pipeline",
+        f"(`analysis/plots.py:per_seed_final_returns`, final-{window} episode",
+        "window) from `sim_data*.pkl` trees: ours from",
+        f"`{mine_dir}`, the reference's from",
+        f"`{ref_dir}` (its shipped two-phase 4000+4000",
+        "runs; phases concatenated, exactly as `plot_results.py` reads them).",
+        "",
+        "RNG streams cannot match the reference's global-NumPy sequencing",
+        "under JAX's split-based PRNG, so parity is statistical over the",
+        "seed set (the paper's own protocol, SURVEY.md §7 hard part (c)).",
+        "",
+        f"Parity target: seed-mean team return within ±{tolerance:.0%}",
+        "(BASELINE.json). `outside (seed-noise-compatible)` = mean delta",
+        "exceeds the target but lies within 2·(ref std + our std) — i.e.",
+        "not distinguishable from seed noise at these sample sizes.",
+        "",
+        "| Scenario | H | reference (±std, n) | this framework (±std, n) | Δ | rel | verdict |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for _, r in table.iterrows():
+        mine = (
+            f"{r.mine_mean:.2f} ±{r.mine_std:.2f} (n={int(r.mine_seeds)})"
+            if np.isfinite(r.mine_mean)
+            else "—"
+        )
+        ref = (
+            f"{r.ref_mean:.2f} ±{r.ref_std:.2f} (n={int(r.ref_seeds)})"
+            if np.isfinite(r.ref_mean)
+            else "—"
+        )
+        delta = f"{r.delta:+.2f}" if np.isfinite(r.delta) else "—"
+        rel = f"{r.rel:.1%}" if np.isfinite(r.rel) else "—"
+        lines.append(
+            f"| {r.scenario} | {int(r.H)} | {ref} | {mine} | {delta} | {rel} "
+            f"| {r.verdict} |"
+        )
+    n_done = int((~table.verdict.isin(["missing", "no reference"])).sum())
+    n_within = int((table.verdict == "within").sum())
+    n_noise = int((table.verdict == "outside (seed-noise-compatible)").sum())
+    lines += [
+        "",
+        f"**{n_done}/{len(table)} cells measured; {n_within} within "
+        f"±{tolerance:.0%}, {n_noise} outside-but-seed-noise-compatible, "
+        f"{n_done - n_within - n_noise} outside.**",
+    ]
+    if extra_sections:
+        lines += ["", extra_sections]
+    Path(path).write_text("\n".join(lines) + "\n")
+
+
 def plot_returns(
     raw_data_dir,
     out_dir,

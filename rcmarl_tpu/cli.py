@@ -19,6 +19,7 @@ import dataclasses
 import json
 import sys
 import time
+from datetime import datetime
 from pathlib import Path
 
 import math
@@ -438,6 +439,13 @@ def cmd_bench(argv) -> int:
     p.add_argument("--n_ep_fixed", type=int, default=10)
     p.add_argument("--blocks", type=int, default=3, help="timed blocks per rep")
     p.add_argument("--reps", type=int, default=3)
+    p.add_argument(
+        "--out",
+        type=str,
+        default=None,
+        help="append each result as a JSON line to this file (so scaling "
+        "runs leave a reviewable artifact, not just stdout)",
+    )
     args = p.parse_args(argv)
     if args.blocks < 1 or args.reps < 1 or args.n_ep_fixed < 1:
         raise SystemExit("--blocks, --reps, and --n_ep_fixed must be >= 1")
@@ -460,20 +468,24 @@ def cmd_bench(argv) -> int:
                 state, metrics = run(state)
                 best = min(best, t.stop(metrics.true_team_returns))
             steps = args.blocks * cfg.block_steps
-            print(
-                json.dumps(
-                    {
-                        "config": name,
-                        "impl": impl,
-                        "n_agents": cfg.n_agents,
-                        "n_in": cfg.n_in,
-                        "hidden": list(cfg.hidden),
-                        "H": cfg.H,
-                        "env_steps_per_sec": round(steps / best, 1),
-                        "sec_per_block": round(best / args.blocks, 4),
-                    }
-                )
+            row = json.dumps(
+                {
+                    "config": name,
+                    "impl": impl,
+                    "n_agents": cfg.n_agents,
+                    "n_in": cfg.n_in,
+                    "hidden": list(cfg.hidden),
+                    "H": cfg.H,
+                    "env_steps_per_sec": round(steps / best, 1),
+                    "sec_per_block": round(best / args.blocks, 4),
+                    "platform": jax.devices()[0].platform,
+                    "timestamp": datetime.now().isoformat(timespec="seconds"),
+                }
             )
+            print(row)
+            if args.out:
+                with open(args.out, "a") as f:
+                    f.write(row + "\n")
     return 0
 
 
@@ -518,6 +530,42 @@ def cmd_plot(argv) -> int:
     return 0
 
 
+def cmd_parity(argv) -> int:
+    p = argparse.ArgumentParser(
+        prog="rcmarl_tpu parity",
+        description="Regenerate PARITY.md from the sweep artifacts: ours "
+        "vs the reference's shipped raw_data, same aggregation pipeline "
+        "for both sides (no hand-maintained rows)",
+    )
+    p.add_argument("--raw_data", type=str, default="./simulation_results/raw_data")
+    p.add_argument(
+        "--ref_raw_data",
+        type=str,
+        default="/root/reference/simulation_results/raw_data",
+    )
+    p.add_argument("--out", type=str, default="./PARITY.md")
+    p.add_argument("--window", type=int, default=500)
+    p.add_argument("--tolerance", type=float, default=0.05)
+    args = p.parse_args(argv)
+
+    from rcmarl_tpu.analysis.plots import parity_table, write_parity_md
+
+    table = parity_table(
+        args.raw_data, args.ref_raw_data, args.window, args.tolerance
+    )
+    write_parity_md(
+        table,
+        args.out,
+        args.window,
+        args.tolerance,
+        mine_dir=args.raw_data,
+        ref_dir=args.ref_raw_data,
+    )
+    print(table.to_string(index=False))
+    print(f"wrote {args.out}")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     cmds = {
@@ -525,6 +573,7 @@ def main(argv=None) -> int:
         "sweep": cmd_sweep,
         "plot": cmd_plot,
         "bench": cmd_bench,
+        "parity": cmd_parity,
     }
     if not argv or argv[0] in ("-h", "--help"):
         print(f"usage: python -m rcmarl_tpu {{{','.join(cmds)}}} [flags]")

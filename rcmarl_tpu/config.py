@@ -123,23 +123,20 @@ class Config:
             raise ValueError("agent_roles length must equal n_agents")
         if len(self.in_nodes) != self.n_agents:
             raise ValueError("in_nodes length must equal n_agents")
-        degs = {len(nbrs) for nbrs in self.in_nodes}
-        if len(degs) != 1:
-            raise ValueError(
-                "all agents must currently have the same in-degree "
-                f"(got degrees {sorted(degs)})"
-            )
         for i, nbrs in enumerate(self.in_nodes):
             if nbrs[0] != i:
                 raise ValueError(
                     f"in_nodes[{i}] must list the agent itself first "
                     "(reference convention, main.py:28)"
                 )
-        n_in = len(self.in_nodes[0])
-        if not 0 <= 2 * self.H <= n_in - 1:
-            raise ValueError(
-                f"H={self.H} too large for in-degree {n_in}: need 2H <= n_in-1"
-            )
+            # H must be valid in EVERY neighborhood (heterogeneous
+            # in-degrees allowed, as the reference accepts arbitrary
+            # adjacency lists — main.py:28)
+            if not 0 <= 2 * self.H <= len(nbrs) - 1:
+                raise ValueError(
+                    f"H={self.H} too large for in_nodes[{i}] of degree "
+                    f"{len(nbrs)}: need 2H <= degree-1"
+                )
         if self.consensus_impl not in CONSENSUS_IMPLS:
             raise ValueError(
                 f"consensus_impl={self.consensus_impl!r}: expected one of "
@@ -150,7 +147,66 @@ class Config:
 
     @property
     def n_in(self) -> int:
-        return len(self.in_nodes[0])
+        """Max in-degree (the padded neighbor-axis size for irregular
+        graphs; for regular graphs, THE in-degree)."""
+        return max(len(nbrs) for nbrs in self.in_nodes)
+
+    @property
+    def in_degrees(self) -> Tuple[int, ...]:
+        return tuple(len(nbrs) for nbrs in self.in_nodes)
+
+    @property
+    def regular_graph(self) -> bool:
+        """True when every agent has the same in-degree — the fast path
+        with no edge-validity masking."""
+        return len(set(self.in_degrees)) == 1
+
+    @property
+    def uniform_shifts(self) -> "Tuple[int, ...] | None":
+        """Shift set S (with S[0] == 0) such that every agent's
+        in-neighborhood is ``{(i + s) % N for s in S}`` as a multiset —
+        i.e. the graph is vertex-transitive under rotation (circulant
+        graphs of any degree, including the fully-connected graph).
+
+        When present, the consensus gather can be expressed as ``n_in``
+        static rolls of the stacked message arrays instead of a fancy
+        index: under an agent-sharded mesh, XLA lowers a sharded roll to
+        a ring collective-permute of just the (shift)-row halo, where the
+        general gather all-gathers ALL N agents' parameters to every
+        shard (measured: 64-row all-gather vs 1-3-row permutes at N=64,
+        degree 4 — see PARALLELISM.md). Returns None for graphs without
+        this structure (they use the general gather).
+
+        The reordering is safe because resilient aggregation is
+        permutation-invariant in the non-self neighbors (the kernel
+        sorts); only index 0 (self, shift 0) is positional.
+        """
+        if not self.regular_graph:
+            return None
+        N = self.n_agents
+        base = tuple(sorted((j - 0) % N for j in self.in_nodes[0]))
+        for i, nbrs in enumerate(self.in_nodes):
+            if tuple(sorted((j - i) % N for j in nbrs)) != base:
+                return None
+        return base  # 0 first: self is always present, shifts in [0, N)
+
+    def padded_in_nodes(self):
+        """(in_arr, valid) as nested tuples, each row padded to
+        :attr:`n_in`: padded slots repeat the agent's own index (a
+        harmless gather target) and are zero in ``valid``. ``valid`` is
+        None for regular graphs (fast path, no masking)."""
+        n_in = self.n_in
+        in_arr = tuple(
+            nbrs + (i,) * (n_in - len(nbrs))
+            for i, nbrs in enumerate(self.in_nodes)
+        )
+        if self.regular_graph:
+            return in_arr, None
+        valid = tuple(
+            (1.0,) * len(nbrs) + (0.0,) * (n_in - len(nbrs))
+            for nbrs in self.in_nodes
+        )
+        return in_arr, valid
 
     @property
     def obs_dim(self) -> int:

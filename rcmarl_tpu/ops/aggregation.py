@@ -27,9 +27,24 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from rcmarl_tpu.config import CONSENSUS_IMPLS
+
+
+def _check_impl(impl: str) -> None:
+    """Reject unknown impl strings up front: anything not in
+    CONSENSUS_IMPLS would otherwise be routed to the Pallas kernel with
+    interpret=False and die in lowering with an obscure error."""
+    if impl not in CONSENSUS_IMPLS:
+        raise ValueError(
+            f"unknown consensus impl {impl!r}; expected one of {CONSENSUS_IMPLS}"
+        )
+
 
 def resilient_aggregate(
-    values: jnp.ndarray, H: int, impl: str = "xla"
+    values: jnp.ndarray,
+    H: int,
+    impl: str = "xla",
+    valid: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Clip-and-average over the leading neighbor axis.
 
@@ -38,10 +53,21 @@ def resilient_aggregate(
       H: max number of adversaries tolerated in the neighborhood (static).
       impl: 'xla' (default), 'pallas' (fused TPU kernel,
         :mod:`rcmarl_tpu.ops.pallas_aggregation`), or 'pallas_interpret'.
+      valid: optional (n_in,) edge-validity mask for heterogeneous
+        in-degree graphs (reference ``main.py:28`` accepts arbitrary
+        adjacency lists): neighborhoods are padded to the graph's max
+        in-degree and padded slots masked out. Index 0 (self) must be
+        valid, and ``2H <= sum(valid) - 1`` must hold (checked statically
+        per agent by ``Config``). May be traced (vmapped over agents).
+        The masked path is XLA-only: padded graphs route past the Pallas
+        kernel (irregular graphs are host-defined, small-scale usage).
 
     Returns:
       (...) aggregated values.
     """
+    _check_impl(impl)
+    if valid is not None:
+        return _masked_aggregate(values, H, valid)
     if impl != "xla":
         from rcmarl_tpu.ops.pallas_aggregation import fused_resilient_aggregate
 
@@ -61,11 +87,55 @@ def resilient_aggregate(
     return jnp.mean(jnp.clip(values, lower, upper), axis=0)
 
 
-def resilient_aggregate_tree(tree, H: int, impl: str = "xla"):
+def _masked_aggregate(
+    values: jnp.ndarray, H: int, valid: jnp.ndarray
+) -> jnp.ndarray:
+    """Clip-and-average over only the valid neighbor slots.
+
+    Exactly :func:`resilient_aggregate` restricted to the ``d = sum(valid)``
+    valid entries: invalid slots sort to the end as +inf, so
+    ``sorted[H]`` is the H-th smallest valid value and the upper bound is
+    ``sorted[d - H - 1]`` (a dynamic index — d is data under vmap, H is
+    static); the mean runs over the d valid entries only.
+    """
+    n_in = values.shape[0]
+    # Same static sanity check as the unmasked path (vs the padded size;
+    # the exact per-neighborhood 2H <= count-1 requirement is enforced
+    # statically per agent by Config, since counts are traced data here).
+    if not 0 <= 2 * H <= n_in - 1:
+        raise ValueError(f"H={H} invalid for n_in={n_in}: need 0 <= 2H <= n_in-1")
+    shape = (n_in,) + (1,) * (values.ndim - 1)
+    v = valid.astype(values.dtype).reshape(shape)
+    count = jnp.sum(valid.astype(values.dtype))
+    if H == 0:
+        # where (not multiply): padded slots may hold arbitrary values
+        # (even non-finite) and must not poison the sum
+        return jnp.sum(jnp.where(v > 0, values, 0.0), axis=0) / count
+    own = values[0]
+    masked = jnp.where(v > 0, values, jnp.inf)
+    sorted_vals = jnp.sort(masked, axis=0)
+    lower = jnp.minimum(sorted_vals[H], own)
+    upper_idx = count.astype(jnp.int32) - H - 1
+    upper_row = jax.lax.dynamic_index_in_dim(
+        sorted_vals, upper_idx, axis=0, keepdims=False
+    )
+    upper = jnp.maximum(upper_row, own)
+    clipped = jnp.where(v > 0, jnp.clip(values, lower, upper), 0.0)
+    return jnp.sum(clipped, axis=0) / count
+
+
+def resilient_aggregate_tree(
+    tree, H: int, impl: str = "xla", valid: jnp.ndarray | None = None
+):
     """Apply :func:`resilient_aggregate` to every leaf of a pytree whose
     leaves carry a leading neighbor axis (e.g. a gathered parameter
     pytree with leaves (n_in, ...)). With a pallas impl the whole tree is
-    flattened into ONE fused kernel launch instead of one sort per leaf."""
+    flattened into ONE fused kernel launch instead of one sort per leaf.
+    ``valid`` masks padded neighbor slots (see :func:`resilient_aggregate`;
+    masked trees take the XLA path)."""
+    _check_impl(impl)
+    if valid is not None:
+        return jax.tree.map(lambda v: _masked_aggregate(v, H, valid), tree)
     if impl != "xla":
         from rcmarl_tpu.ops.pallas_aggregation import (
             fused_resilient_aggregate_tree,

@@ -93,7 +93,10 @@ def fused_resilient_aggregate(
       interpret: run in the Pallas interpreter (for CPU tests).
 
     Returns:
-      (...) aggregated values, f32.
+      (...) aggregated values in ``values.dtype``. Sort/clip/mean are
+      computed in f32 (the VPU-native width) regardless of input dtype
+      and cast back: exact for f32, an upcast for bf16, and a silent
+      precision LOSS for f64 inputs under x64 — use the XLA path there.
     """
     n_in = values.shape[0]
     if not 0 <= 2 * H <= n_in - 1:
@@ -118,7 +121,7 @@ def fused_resilient_aggregate(
         grid=grid,
         interpret=interpret,
     )(v3)
-    return out.reshape(-1)[:m].reshape(out_shape)
+    return out.reshape(-1)[:m].reshape(out_shape).astype(values.dtype)
 
 
 def fused_resilient_aggregate_tree(

@@ -121,10 +121,20 @@ def gather_metrics(metrics):
     """All-gather per-replica metrics across hosts (the run's only DCN
     traffic), returning host-local numpy with the global seed axis.
 
+    Inputs are expected to be either globally-sharded ``jax.Array``s from
+    :func:`~rcmarl_tpu.parallel.seeds.train_parallel` (for which
+    ``process_allgather`` assembles the global value on every host) or
+    host-local arrays sharded on their leading axis, for which
+    ``tiled=True`` concatenates along that axis instead of stacking a new
+    process dimension — either way the result keeps the documented
+    (global_seed, ...) shape.
+
     On a single process this is just ``jax.device_get``.
     """
     if jax.process_count() == 1:
         return jax.tree.map(np.asarray, jax.device_get(metrics))
     from jax.experimental import multihost_utils
 
-    return jax.tree.map(np.asarray, multihost_utils.process_allgather(metrics))
+    return jax.tree.map(
+        np.asarray, multihost_utils.process_allgather(metrics, tiled=True)
+    )

@@ -30,5 +30,15 @@ try:  # deregister the axon PJRT plugin installed by sitecustomize
     # sitecustomize's register() may have snapshotted jax_platforms=axon
     # before this conftest ran; force it back.
     jax.config.update("jax_platforms", "cpu")
+    # Persistent compilation cache: the suite is dominated by XLA compiles
+    # of the jitted trainer programs (identical across runs), so caching
+    # them cuts repeat wall-clock dramatically (VERDICT.md round-1
+    # weakness 3). Keyed on HLO + flags; safe across processes.
+    _repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.join(_repo_root, ".jax_cache")
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 except Exception:  # pragma: no cover - jax internals moved; env vars still apply
     pass

@@ -127,3 +127,79 @@ def test_vmap_over_agents():
             rtol=1e-5,
             atol=1e-6,
         )
+
+
+class TestMaskedAggregation:
+    """Padded-neighborhood (heterogeneous in-degree) semantics: the masked
+    aggregate over a padded block must equal the unmasked aggregate over
+    just the valid prefix (reference accepts arbitrary adjacency lists,
+    main.py:28)."""
+
+    def test_matches_unpadded_prefix(self):
+        rng = np.random.default_rng(5)
+        for trial in range(10):
+            d = int(rng.integers(3, 7))  # true degree
+            pad = int(rng.integers(1, 4))
+            H = int(rng.integers(0, (d - 1) // 2 + 1))
+            shape = (d,) + tuple(rng.integers(1, 6, size=2))
+            vals = rng.normal(size=shape).astype(np.float32)
+            padded = np.concatenate(
+                [vals, np.repeat(vals[:1], pad, axis=0) * 7.7], axis=0
+            )  # garbage in padded slots must not matter
+            valid = jnp.asarray([1.0] * d + [0.0] * pad)
+            out = resilient_aggregate(jnp.asarray(padded), H, valid=valid)
+            expect = resilient_aggregate(jnp.asarray(vals), H)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(expect), rtol=1e-5, atol=1e-6
+            )
+
+    def test_padding_value_irrelevant(self):
+        vals = jnp.array([[5.0], [1.0], [9.0], [3.0]])
+        valid = jnp.array([1.0, 1.0, 1.0, 1.0, 0.0, 0.0])
+        for junk in (0.0, 1e9, -1e9, jnp.nan):
+            padded = jnp.concatenate(
+                [vals, jnp.full((2, 1), junk)], axis=0
+            )
+            out = resilient_aggregate(padded, H=1, valid=valid)
+            np.testing.assert_allclose(np.asarray(out), [4.0])
+
+    def test_vmap_heterogeneous_degrees(self):
+        # Two agents, degrees 4 and 3, padded to 4: vmapped masked call
+        # matches per-agent unmasked calls.
+        a0 = jnp.array([[5.0], [1.0], [9.0], [3.0]])
+        a1 = jnp.array([[2.0], [8.0], [4.0], [2.0]])  # last row = pad
+        vals = jnp.stack([a0, a1])
+        valid = jnp.array([[1.0, 1.0, 1.0, 1.0], [1.0, 1.0, 1.0, 0.0]])
+        out = jax.vmap(
+            lambda v, m: resilient_aggregate(v, H=1, valid=m)
+        )(vals, valid)
+        np.testing.assert_allclose(np.asarray(out[0]), [4.0])
+        expect1 = resilient_aggregate(a1[:3], H=1)
+        np.testing.assert_allclose(
+            np.asarray(out[1]), np.asarray(expect1), rtol=1e-6
+        )
+
+    def test_tree_version_masked(self):
+        key = jax.random.PRNGKey(6)
+        k1, k2 = jax.random.split(key)
+        tree = {
+            "W": jax.random.normal(k1, (5, 3, 4)),
+            "b": jax.random.normal(k2, (5, 4)),
+        }
+        valid = jnp.array([1.0, 1.0, 1.0, 1.0, 0.0])
+        out = resilient_aggregate_tree(tree, H=1, valid=valid)
+        expect = resilient_aggregate_tree(
+            jax.tree.map(lambda l: l[:4], tree), H=1
+        )
+        for k in ("W", "b"):
+            np.testing.assert_allclose(
+                np.asarray(out[k]), np.asarray(expect[k]), rtol=1e-6
+            )
+
+
+def test_unknown_impl_rejected():
+    vals = jnp.zeros((4, 2))
+    with pytest.raises(ValueError, match="unknown consensus impl"):
+        resilient_aggregate(vals, H=1, impl="Pallas")
+    with pytest.raises(ValueError, match="unknown consensus impl"):
+        resilient_aggregate_tree({"w": vals}, H=1, impl="palas")

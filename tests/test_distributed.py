@@ -47,6 +47,7 @@ def test_gather_metrics_single_process():
     np.testing.assert_array_equal(out["a"], np.arange(4.0))
 
 
+@pytest.mark.slow
 def test_train_parallel_over_multihost_mesh():
     cfg = Config(
         n_agents=4,

@@ -56,6 +56,7 @@ def leaves_equal(a, b):
 
 
 class TestCheckpoint:
+    @pytest.mark.slow
     def test_roundtrip_and_deterministic_resume(self, tmp_path):
         cfg = tiny_cfg()
         state = init_train_state(cfg, jax.random.PRNGKey(0))
@@ -110,6 +111,7 @@ class TestCheckpoint:
         with pytest.raises(ValueError, match="layer-count mismatch"):
             import_reference_weights(exported, deeper, deep_state.params)
 
+    @pytest.mark.slow
     def test_loads_real_reference_artifacts(self):
         """Real reference checkpoint (Keras get_weights layout, main.py:83-92)
         imports into the default Config's shapes."""
@@ -137,6 +139,7 @@ class TestCLI:
         with pytest.raises(SystemExit):
             scenario_labels("nonsense")
 
+    @pytest.mark.slow
     def test_train_artifacts_and_resume(self, tmp_path, capsys):
         out = tmp_path / "run"
         flags = [
@@ -180,6 +183,7 @@ class TestCLI:
                 "--pretrained_agents", str(tmp_path / "no_such.npz"),
             ])
 
+    @pytest.mark.slow
     def test_resume_warns_on_config_drift(self, tmp_path, capsys):
         out = tmp_path / "run"
         flags = [
@@ -198,6 +202,7 @@ class TestCLI:
         msg = capsys.readouterr().out
         assert "WARNING" in msg and "gamma" in msg
 
+    @pytest.mark.slow
     def test_bench_reports_scaling_configs(self, capsys):
         import json as _json
 
@@ -213,6 +218,7 @@ class TestCLI:
         assert rows[0]["n_in"] == 4  # reference topology incl. self
         assert rows[0]["env_steps_per_sec"] > 0
 
+    @pytest.mark.slow
     def test_sweep_plot_summary(self, tmp_path, capsys):
         raw = tmp_path / "raw_data"
         assert main([

@@ -95,6 +95,7 @@ def test_invalid_h_rejected():
         fused_resilient_aggregate(vals, 2, interpret=True)
 
 
+@pytest.mark.slow
 def test_training_block_with_pallas_consensus():
     """End-to-end: one update block with consensus_impl='pallas_interpret'
     produces the same trajectory as the XLA implementation."""

@@ -33,6 +33,7 @@ def test_has_8_devices():
 
 
 class TestSeedParallel:
+    @pytest.mark.slow
     def test_matches_single_replica(self):
         """Sharded multi-seed training must be bitwise-equivalent in
         structure and numerically equivalent to running each seed alone."""
@@ -56,6 +57,7 @@ class TestSeedParallel:
         ):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5)
 
+    @pytest.mark.slow
     def test_block_parallel_resume(self):
         cfg = TINY
         mesh = make_mesh(2)
@@ -71,6 +73,7 @@ class TestSeedParallel:
 
 
 class TestAgentSharding:
+    @pytest.mark.slow
     def test_agent_axis_sharded_consensus(self):
         """8 agents sharded 2-way over the 'agent' mesh axis: the consensus
         gather lowers to cross-device collectives and still matches the

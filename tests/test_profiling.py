@@ -2,6 +2,7 @@
 
 from pathlib import Path
 
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -36,6 +37,7 @@ def test_timer_forces_completion():
     assert dt > 0 and t.elapsed == dt
 
 
+@pytest.mark.slow
 def test_profile_phases_covers_training_subprograms():
     times = profile_phases(tiny_cfg(), reps=1)
     assert set(times) == {

@@ -203,6 +203,7 @@ class TestUpdateBlock:
             np.full((3, 1), (0 + 1 + 2 + 3) / 4.0),
         )
 
+    @pytest.mark.slow
     def test_all_roles_update(self):
         """Every role's parameters move as the behavior matrix mandates
         (SURVEY.md §2): faulty critic/TR frozen; all actors train."""
@@ -235,6 +236,7 @@ class TestUpdateBlock:
         assert moved(params.critic_local, 4)  # malicious private critic
         assert not moved(params.critic_local, 0)
 
+    @pytest.mark.slow
     def test_adam_counts_per_role(self):
         """Coop actor: 1 Adam step/block. Adversary: ceil(B/batch) steps."""
         roles = (Roles.COOPERATIVE,) * 4 + (Roles.GREEDY,)
@@ -244,6 +246,7 @@ class TestUpdateBlock:
         assert counts[0] == 1
         assert counts[4] == int(np.ceil(cfg.block_steps / cfg.batch_size))
 
+    @pytest.mark.slow
     def test_coop_critic_restore_semantics(self):
         """With consensus effectively disabled (self-only graph, H=0), the
         local fit must still NOT persist into the agent's own critic trunk:
@@ -271,6 +274,7 @@ class TestUpdateBlock:
 
 
 class TestEndToEnd:
+    @pytest.mark.slow
     def test_train_runs_and_returns_frame(self):
         cfg = SMALL
         state, df = train(cfg)
@@ -283,6 +287,7 @@ class TestEndToEnd:
         assert int(state.block) == cfg.n_episodes // cfg.n_ep_fixed
         assert np.all(np.isfinite(df.values))
 
+    @pytest.mark.slow
     def test_train_scanned_matches_host_loop(self):
         """Device-scanned trainer is step-identical to the host loop."""
         cfg = SMALL
@@ -301,6 +306,7 @@ class TestEndToEnd:
         ):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4)
 
+    @pytest.mark.slow
     def test_heterogeneous_train(self):
         cfg = SMALL.replace(
             agent_roles=(
@@ -319,3 +325,56 @@ class TestEndToEnd:
     def test_rejects_partial_block(self):
         with pytest.raises(ValueError):
             train(SMALL, n_episodes=3)
+
+
+class TestHeterogeneousGraph:
+    """Irregular in-degree topologies (reference main.py:28 accepts any
+    adjacency list; VERDICT.md round-1 weakness 5)."""
+
+    def test_config_accepts_ragged_in_nodes(self):
+        cfg = SMALL.replace(
+            in_nodes=((0, 1, 2, 3), (1, 2, 3), (2, 3, 4, 0), (3, 4, 0), (4, 0, 1)),
+            H=1,
+        )
+        assert not cfg.regular_graph
+        assert cfg.n_in == 4
+        assert cfg.in_degrees == (4, 3, 4, 3, 3)
+        in_arr, valid = cfg.padded_in_nodes()
+        assert in_arr[1] == (1, 2, 3, 1)  # padded with self
+        assert valid[1] == (1.0, 1.0, 1.0, 0.0)
+        assert valid[0] == (1.0,) * 4
+
+    def test_h_checked_per_agent(self):
+        with pytest.raises(ValueError, match="H=1 too large"):
+            SMALL.replace(
+                in_nodes=((0, 1, 2, 3), (1, 2), (2, 3, 4, 0), (3, 4, 0), (4, 0, 1)),
+                H=1,
+            )
+
+    @pytest.mark.slow
+    def test_train_runs_on_ragged_graph(self):
+        cfg = SMALL.replace(
+            in_nodes=((0, 1, 2, 3), (1, 2, 3), (2, 3, 4, 0), (3, 4, 0), (4, 0, 1)),
+            H=1,
+        )
+        state, df = train(cfg)
+        assert np.all(np.isfinite(df.values))
+
+    def test_padded_equals_unpadded_on_regular_graph(self):
+        """Forcing the masked path on a regular graph must reproduce the
+        fast path bit-for-bit semantics (same math, different plumbing)."""
+        from rcmarl_tpu.agents.updates import consensus_update_one
+        from rcmarl_tpu.models.mlp import init_stacked_mlp
+
+        cfg = SMALL
+        key = jax.random.PRNGKey(0)
+        msgs = init_stacked_mlp(key, cfg.n_in, cfg.obs_dim, cfg.hidden, 1)
+        own = jax.tree.map(lambda l: l[0], msgs)
+        x = jax.random.normal(jax.random.PRNGKey(1), (7, cfg.n_agents, cfg.n_states))
+        mask = jnp.ones((7,))
+        fast = consensus_update_one(own, msgs, x, mask, cfg.replace(H=1))
+        masked = consensus_update_one(
+            own, msgs, x, mask, cfg.replace(H=1), valid=jnp.ones((cfg.n_in,))
+        )
+        for a, b in zip(jax.tree.leaves(fast), jax.tree.leaves(masked)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
