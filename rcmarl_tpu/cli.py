@@ -321,6 +321,15 @@ def cmd_sweep(argv) -> int:
     p.add_argument("--out", type=str, default="./simulation_results/raw_data")
     p.add_argument("--phase", type=int, default=1, help="sim_data<phase>.pkl")
     p.add_argument(
+        "--phases",
+        type=int,
+        default=1,
+        help="run this many phases of --n_episodes each, with the "
+        "reference's restart semantics at each boundary (weights + goal "
+        "kept; Adam moments, buffer, and RNG reset — the published runs "
+        "are --phases 2 --n_episodes 4000); writes sim_data<k>.pkl per phase",
+    )
+    p.add_argument(
         "--consensus_impl",
         type=str,
         default="xla",
@@ -333,8 +342,10 @@ def cmd_sweep(argv) -> int:
             f"--n_episodes={args.n_episodes} must be a positive multiple of "
             f"--n_ep_fixed={args.n_ep_fixed}"
         )
+    if args.phases < 1:
+        raise SystemExit(f"--phases={args.phases} must be >= 1")
 
-    from rcmarl_tpu.parallel.seeds import train_parallel
+    from rcmarl_tpu.parallel.seeds import reset_states_for_phase, train_parallel
     from rcmarl_tpu.training.trainer import metrics_to_dataframe
 
     out_root = Path(args.out)
@@ -356,23 +367,39 @@ def cmd_sweep(argv) -> int:
             )
             n_blocks = args.n_episodes // cfg.n_ep_fixed
             t0 = time.perf_counter()
-            # all seeds of a cell run as ONE sharded/vmapped program
-            states, metrics = train_parallel(
-                cfg, seeds=args.seeds, n_blocks=n_blocks
-            )
-            # force completion before timing: dispatch is async, and a
-            # host-side fetch is the only reliable barrier on all backends
-            metrics = type(metrics)(*(np.asarray(l) for l in metrics))
+            states = None
+            phase_metrics = []
+            for ph in range(args.phases):
+                if states is None:
+                    # all seeds of a cell run as ONE sharded/vmapped program
+                    states, metrics = train_parallel(
+                        cfg, seeds=args.seeds, n_blocks=n_blocks
+                    )
+                else:
+                    states = reset_states_for_phase(cfg, states, args.seeds)
+                    states, metrics = train_parallel(
+                        cfg, states=states, n_blocks=n_blocks
+                    )
+                # force completion before timing: dispatch is async, and a
+                # host-side fetch is the only reliable barrier on all backends
+                phase_metrics.append(
+                    type(metrics)(*(np.asarray(l) for l in metrics))
+                )
             dt = time.perf_counter() - t0
-            for i, seed in enumerate(args.seeds):
-                cell = out_root / scen / f"H={H}" / f"seed={seed}"
-                cell.mkdir(parents=True, exist_ok=True)
-                df = metrics_to_dataframe(type(metrics)(*(l[i] for l in metrics)))
-                df.to_pickle(cell / f"sim_data{args.phase}.pkl")
-            sps = len(args.seeds) * args.n_episodes * cfg.max_ep_len / dt
+            for ph, metrics in enumerate(phase_metrics):
+                for i, seed in enumerate(args.seeds):
+                    cell = out_root / scen / f"H={H}" / f"seed={seed}"
+                    cell.mkdir(parents=True, exist_ok=True)
+                    df = metrics_to_dataframe(
+                        type(metrics)(*(l[i] for l in metrics))
+                    )
+                    df.to_pickle(cell / f"sim_data{args.phase + ph}.pkl")
+            total_eps = args.n_episodes * args.phases
+            sps = len(args.seeds) * total_eps * cfg.max_ep_len / dt
             print(
-                f"{scen} H={H}: {len(args.seeds)} seeds x {args.n_episodes} eps "
-                f"in {dt:.1f}s ({sps:.0f} env-steps/s aggregate)"
+                f"{scen} H={H}: {len(args.seeds)} seeds x {total_eps} eps "
+                f"({args.phases} phase(s)) in {dt:.1f}s "
+                f"({sps:.0f} env-steps/s aggregate)"
             )
     return 0
 
@@ -537,12 +564,10 @@ def cmd_parity(argv) -> int:
         "vs the reference's shipped raw_data, same aggregation pipeline "
         "for both sides (no hand-maintained rows)",
     )
+    from rcmarl_tpu.analysis.plots import DEFAULT_REF_RAW_DATA
+
     p.add_argument("--raw_data", type=str, default="./simulation_results/raw_data")
-    p.add_argument(
-        "--ref_raw_data",
-        type=str,
-        default="/root/reference/simulation_results/raw_data",
-    )
+    p.add_argument("--ref_raw_data", type=str, default=DEFAULT_REF_RAW_DATA)
     p.add_argument("--out", type=str, default="./PARITY.md")
     p.add_argument("--window", type=int, default=500)
     p.add_argument("--tolerance", type=float, default=0.05)

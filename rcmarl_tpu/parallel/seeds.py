@@ -109,6 +109,31 @@ def init_states(cfg: Config, seeds) -> TrainState:
     return jax.vmap(lambda k: init_train_state(cfg, k))(keys)
 
 
+def reset_states_for_phase(cfg: Config, states: TrainState, seeds) -> TrainState:
+    """Reference two-phase protocol boundary (SURVEY.md §5): the published
+    runs are 4000+4000 episodes as two processes, where the restart
+    restores weights and the goal layout (``--pretrained_agents``,
+    reference ``main.py:52-54,83-86``) but resets the actor's Adam
+    moments, the replay buffer, and the RNG streams (``main.py:46-47``
+    re-seeds with the same ``--random_seed``). Applies that boundary to a
+    batch of replicas: params + desired carry over, everything else
+    re-initializes from each replica's seed exactly as phase 1 did."""
+    from rcmarl_tpu.ops.optim import adam_init
+
+    def one(state: TrainState, seed):
+        params = state.params._replace(
+            actor_opt=jax.vmap(adam_init)(state.params.actor)
+        )
+        return init_train_state(
+            cfg,
+            jax.random.PRNGKey(seed),
+            desired=state.desired,
+            params=params,
+        )
+
+    return jax.vmap(one)(states, jnp.asarray(seeds, jnp.uint32))
+
+
 def train_parallel(
     cfg: Config,
     seeds=None,

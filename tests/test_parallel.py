@@ -104,3 +104,44 @@ class TestAgentSharding:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5
             )
+
+
+class TestPhaseReset:
+    """Reference two-phase protocol boundary (SURVEY.md §5): weights and
+    goal layout carry over; Adam moments, buffer, block counter, and RNG
+    reset exactly as a phase-1 init from the same seed."""
+
+    def test_reset_semantics(self):
+        from rcmarl_tpu.parallel.seeds import (
+            init_states,
+            reset_states_for_phase,
+            train_parallel,
+        )
+
+        cfg = TINY
+        seeds = [7, 8]
+        states, _ = train_parallel(cfg, seeds=seeds, n_blocks=2)
+        reset = reset_states_for_phase(cfg, states, seeds)
+        fresh = init_states(cfg, seeds)
+
+        # weights + goal kept from the trained state
+        for a, b in zip(
+            jax.tree.leaves(reset.params.actor), jax.tree.leaves(states.params.actor)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(
+            np.asarray(reset.desired), np.asarray(states.desired)
+        )
+        # Adam moments zeroed, step count zeroed
+        assert np.all(np.asarray(reset.params.actor_opt.count) == 0)
+        for m in jax.tree.leaves(reset.params.actor_opt.m):
+            assert np.all(np.asarray(m) == 0)
+        # buffer, block, and RNG match a fresh phase-1 init from the seed
+        assert np.all(np.asarray(reset.buffer.count) == 0)
+        np.testing.assert_array_equal(np.asarray(reset.block), np.zeros(2))
+        np.testing.assert_array_equal(
+            np.asarray(reset.key), np.asarray(fresh.key)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(reset.initial), np.asarray(fresh.initial)
+        )
