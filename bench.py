@@ -89,6 +89,15 @@ def _measure(n_seeds: int, n_blocks: int, reps: int) -> None:
                 "unit": "steps/s",
                 "vs_baseline": round(steps / dt / BASELINE_STEPS_PER_SEC, 1),
                 "platform": jax.devices()[0].platform,
+                # Self-describing workload (VERDICT r2 item 7): TPU and CPU
+                # fallback measure different shapes, so cross-round numbers
+                # are only comparable when these fields match.
+                "workload": {
+                    "seeds": n_seeds,
+                    "blocks": n_blocks,
+                    "reps": reps,
+                    "block_steps": cfg.block_steps,
+                },
             }
         )
     )

@@ -159,18 +159,29 @@ def per_seed_final_returns(raw_data_dir, window: int = 500) -> pd.DataFrame:
 
 
 def parity_table(
-    mine_dir, ref_dir, window: int = 500, tolerance: float = 0.05
+    mine_dir,
+    ref_dir,
+    window: int = 500,
+    tolerance: float = 0.05,
+    mine: Optional[pd.DataFrame] = None,
+    ref: Optional[pd.DataFrame] = None,
 ) -> pd.DataFrame:
     """Cell-by-cell convergence comparison of two experiment trees with
     identical layout (ours vs the reference's shipped
     ``simulation_results/raw_data``) — the reference numbers are computed
     from its artifacts by the SAME pipeline, not transcribed by hand.
 
+    ``mine``/``ref`` accept precomputed :func:`per_seed_final_returns`
+    frames so callers that also emit the per-seed summary parse each
+    pickle tree only once.
+
     Columns: reference/mine team returns (seed mean), seed std-devs,
     delta, relative delta, and a within-``tolerance`` verdict.
     """
-    mine = per_seed_final_returns(mine_dir, window)
-    ref = per_seed_final_returns(ref_dir, window)
+    if mine is None:
+        mine = per_seed_final_returns(mine_dir, window)
+    if ref is None:
+        ref = per_seed_final_returns(ref_dir, window)
     # Union of cells from BOTH trees: a cell we trained that the reference
     # never shipped must still appear (as 'no reference'), and a reference
     # cell we haven't run yet appears as 'missing'.

@@ -17,6 +17,7 @@ import argparse
 import contextlib
 import dataclasses
 import json
+import os
 import sys
 import time
 from datetime import datetime
@@ -91,6 +92,14 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--H", type=int, default=0)
     p.add_argument("--common_reward", action="store_true")
     p.add_argument("--eps", type=float, default=0.1, help="exploration mix")
+    p.add_argument("--nrow", type=int, default=5, help="grid rows")
+    p.add_argument("--ncol", type=int, default=5, help="grid columns")
+    p.add_argument(
+        "--reference_clip",
+        action="store_true",
+        help="reference-exact move clipping (both coordinates bounded by "
+        "nrow-1, reference grid_world.py:55); only matters when nrow != ncol",
+    )
     p.add_argument(
         "--scenario",
         type=str,
@@ -150,6 +159,9 @@ def config_from_args(args) -> Config:
         H=args.H,
         common_reward=common,
         eps_explore=args.eps,
+        nrow=args.nrow,
+        ncol=args.ncol,
+        reference_clip=args.reference_clip,
         seed=getattr(args, "random_seed", 300),
         consensus_impl=args.consensus_impl,
     )
@@ -467,6 +479,17 @@ def cmd_bench(argv) -> int:
     p.add_argument("--blocks", type=int, default=3, help="timed blocks per rep")
     p.add_argument("--reps", type=int, default=3)
     p.add_argument(
+        "--shard_agents",
+        nargs="+",
+        type=int,
+        default=None,
+        choices=(0, 1),
+        help="run on an all-devices ('seed'=1, 'agent'=D) mesh with the "
+        "agent axis unsharded (0) and/or sharded (1) — the wall-clock A/B "
+        "behind PARALLELISM.md's halo-exchange traffic numbers. Default: "
+        "single-device path, no mesh.",
+    )
+    p.add_argument(
         "--out",
         type=str,
         default=None,
@@ -482,37 +505,80 @@ def cmd_bench(argv) -> int:
     from rcmarl_tpu.training.trainer import init_train_state, train_scanned
     from rcmarl_tpu.utils.profiling import Timer
 
+    shard_modes = [None] if args.shard_agents is None else args.shard_agents
     for name in args.configs:
         for impl in args.impl:
-            cfg = _bench_config(name, impl, args.n_ep_fixed)
-            state = init_train_state(cfg, jax.random.PRNGKey(0))
-            run = jax.jit(lambda s, cfg=cfg: train_scanned(cfg, s, args.blocks))
-            state, metrics = run(state)  # compile + warm
-            jax.device_get(metrics.true_team_returns)
-            best = float("inf")
-            for _ in range(args.reps):
-                t = Timer().start()
-                state, metrics = run(state)
-                best = min(best, t.stop(metrics.true_team_returns))
-            steps = args.blocks * cfg.block_steps
-            row = json.dumps(
-                {
-                    "config": name,
-                    "impl": impl,
-                    "n_agents": cfg.n_agents,
-                    "n_in": cfg.n_in,
-                    "hidden": list(cfg.hidden),
-                    "H": cfg.H,
-                    "env_steps_per_sec": round(steps / best, 1),
-                    "sec_per_block": round(best / args.blocks, 4),
-                    "platform": jax.devices()[0].platform,
-                    "timestamp": datetime.now().isoformat(timespec="seconds"),
-                }
-            )
-            print(row)
-            if args.out:
-                with open(args.out, "a") as f:
-                    f.write(row + "\n")
+            for shard in shard_modes:
+                cfg = _bench_config(name, impl, args.n_ep_fixed)
+                if shard is None:
+                    state = init_train_state(cfg, jax.random.PRNGKey(0))
+                    run = jax.jit(
+                        lambda s, cfg=cfg: train_scanned(cfg, s, args.blocks)
+                    )
+                else:
+                    from rcmarl_tpu.parallel.seeds import make_mesh, train_parallel
+
+                    mesh = make_mesh(seed_axis=1)
+                    if shard and cfg.n_agents % mesh.shape["agent"] != 0:
+                        print(
+                            f"# skip {name} shard_agents=1: {cfg.n_agents} "
+                            f"agents do not tile over {mesh.shape['agent']} "
+                            "devices",
+                            file=sys.stderr,
+                        )
+                        continue
+                    state = None
+
+                    def run(s, cfg=cfg, mesh=mesh, shard=shard):
+                        st, metrics = train_parallel(
+                            cfg,
+                            seeds=[0] if s is None else None,
+                            states=s,
+                            n_blocks=args.blocks,
+                            mesh=mesh,
+                            shard_agents=bool(shard),
+                        )
+                        return st, metrics
+
+                state, metrics = run(state)  # compile + warm
+                jax.device_get(metrics.true_team_returns)
+                best = float("inf")
+                for _ in range(args.reps):
+                    t = Timer().start()
+                    state, metrics = run(state)
+                    best = min(best, t.stop(metrics.true_team_returns))
+                steps = args.blocks * cfg.block_steps
+                row = json.dumps(
+                    {
+                        "config": name,
+                        "impl": impl,
+                        "n_agents": cfg.n_agents,
+                        "n_in": cfg.n_in,
+                        "hidden": list(cfg.hidden),
+                        "H": cfg.H,
+                        **(
+                            {}
+                            if shard is None
+                            else {
+                                "shard_agents": bool(shard),
+                                "mesh_devices": len(jax.devices()),
+                            }
+                        ),
+                        "env_steps_per_sec": round(steps / best, 1),
+                        "sec_per_block": round(best / args.blocks, 4),
+                        "workload": {
+                            "blocks": args.blocks,
+                            "reps": args.reps,
+                            "block_steps": cfg.block_steps,
+                        },
+                        "platform": jax.devices()[0].platform,
+                        "timestamp": datetime.now().isoformat(timespec="seconds"),
+                    }
+                )
+                print(row)
+                if args.out:
+                    with open(args.out, "a") as f:
+                        f.write(row + "\n")
     return 0
 
 
@@ -569,14 +635,34 @@ def cmd_parity(argv) -> int:
     p.add_argument("--raw_data", type=str, default="./simulation_results/raw_data")
     p.add_argument("--ref_raw_data", type=str, default=DEFAULT_REF_RAW_DATA)
     p.add_argument("--out", type=str, default="./PARITY.md")
+    p.add_argument(
+        "--summary_out",
+        type=str,
+        default="./simulation_results/summary.json",
+        help="recomputable per-seed summary artifact (the committed "
+        "evidence behind PARITY.md's aggregated rows)",
+    )
     p.add_argument("--window", type=int, default=500)
     p.add_argument("--tolerance", type=float, default=0.05)
     args = p.parse_args(argv)
 
-    from rcmarl_tpu.analysis.plots import parity_table, write_parity_md
+    from rcmarl_tpu.analysis.plots import (
+        parity_table,
+        per_seed_final_returns,
+        write_parity_md,
+    )
 
+    # Parse each sim_data tree once; the table and the summary artifact are
+    # both derived from these frames.
+    mine_seeds = per_seed_final_returns(args.raw_data, args.window)
+    ref_seeds = per_seed_final_returns(args.ref_raw_data, args.window)
     table = parity_table(
-        args.raw_data, args.ref_raw_data, args.window, args.tolerance
+        args.raw_data,
+        args.ref_raw_data,
+        args.window,
+        args.tolerance,
+        mine=mine_seeds,
+        ref=ref_seeds,
     )
     write_parity_md(
         table,
@@ -586,12 +672,67 @@ def cmd_parity(argv) -> int:
         mine_dir=args.raw_data,
         ref_dir=args.ref_raw_data,
     )
+    if args.summary_out:
+        def records(df):
+            # NaN (e.g. adv_return of all-cooperative cells) -> null so the
+            # artifact is strict JSON, not Python-only NaN literals.
+            return [
+                {
+                    k: (None if isinstance(v, float) and math.isnan(v) else v)
+                    for k, v in row.items()
+                }
+                for row in df.to_dict(orient="records")
+            ]
+
+        # No timestamp: identical inputs must produce a byte-identical
+        # artifact, so re-running `parity` on unchanged raw_data leaves the
+        # committed evidence file untouched.
+        summary = {
+            "generated_by": "python -m rcmarl_tpu parity",
+            "window": args.window,
+            "tolerance": args.tolerance,
+            "raw_data": args.raw_data,
+            "ref_raw_data": args.ref_raw_data,
+            "per_seed": {
+                "mine": records(mine_seeds),
+                "reference": records(ref_seeds),
+            },
+            "cells": records(table),
+        }
+        out = Path(args.summary_out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(summary, indent=1, default=float) + "\n")
+        print(f"wrote {args.summary_out}")
     print(table.to_string(index=False))
     print(f"wrote {args.out}")
     return 0
 
 
+def _honor_platform_env() -> None:
+    """Make an explicit ``JAX_PLATFORMS=cpu`` stick.
+
+    This machine's sitecustomize registers the axon TPU tunnel plugin and
+    re-sets jax's platform config at interpreter start, silently overriding
+    the user's environment choice — so ``JAX_PLATFORMS=cpu python -m
+    rcmarl_tpu bench`` (e.g. the virtual 8-device mesh A/B with
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``) would still
+    dial the TPU. Deregister the plugin and restore the requested platform,
+    exactly as tests/conftest.py does for the test suite.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip() != "cpu":
+        return
+    try:
+        import jax
+        import jax._src.xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # jax internals moved; the env var still applies
+        pass
+
+
 def main(argv=None) -> int:
+    _honor_platform_env()
     argv = sys.argv[1:] if argv is None else argv
     cmds = {
         "train": cmd_train,

@@ -105,6 +105,10 @@ class Config:
     collision_physics: bool = False  # opt-in *intended* collision semantics
     scaling: bool = True
     randomize_state: bool = True
+    #: Reference-exact move clipping (both coordinates bounded by nrow-1,
+    #: reference grid_world.py:55) — only differs from the default
+    #: per-axis clip on non-square grids; see envs/grid_world.py.
+    reference_clip: bool = False
     # --- adversary fit schedule (reference adversarial_CAC_agents.py:133,150,163,239,251) ---
     adv_fit_epochs: int = 10
     adv_fit_batch: int = 32

@@ -46,6 +46,18 @@ class GridWorld(NamedTuple):
     n_agents: int = 5
     scaling: bool = True
     collision_physics: bool = False
+    #: Reference-exact clipping: the reference clips BOTH coordinates by
+    #: nrow-1 (``grid_world.py:55``), which differs from per-axis bounds
+    #: only on non-square grids. Default False = evidently-intended
+    #: per-axis clip; True reproduces the reference bit-for-bit (needed
+    #: for golden parity on nrow != ncol).
+    reference_clip: bool = False
+
+    @property
+    def clip_hi(self) -> np.ndarray:
+        if self.reference_clip:
+            return np.array([self.nrow - 1, self.nrow - 1], dtype=np.int32)
+        return np.array([self.nrow - 1, self.ncol - 1], dtype=np.int32)
 
     @property
     def mean_state(self) -> np.ndarray:
@@ -81,10 +93,9 @@ def _step_observed(
     """
     move = jnp.asarray(MOVES)[actions]  # (N, 2)
     dist_before = jnp.sum(jnp.abs(pos - desired), axis=1)  # (N,)
-    # Per-axis clip. NOTE: the reference clips BOTH coordinates by nrow-1
-    # (grid_world.py:55) — identical on its square default grid; we use the
-    # evidently-intended per-axis bound for non-square grids.
-    npos = jnp.clip(pos + move, 0, jnp.array([env.nrow - 1, env.ncol - 1]))
+    # Per-axis clip by default; env.reference_clip reproduces the
+    # reference's both-axes-nrow bound (grid_world.py:55) exactly.
+    npos = jnp.clip(pos + move, 0, jnp.asarray(env.clip_hi))
     at_goal_stay = (dist_before == 0) & (actions == 0)
     reward = jnp.where(at_goal_stay, 0.0, -(dist_before.astype(jnp.float32)) - 1.0)
     return npos, reward
@@ -100,7 +111,7 @@ def _step_collision(
     clipped to the grid)."""
     move = jnp.asarray(MOVES)[actions]
     dist_before = jnp.sum(jnp.abs(pos - desired), axis=1)
-    npos = jnp.clip(pos + move, 0, jnp.array([env.nrow - 1, env.ncol - 1]))
+    npos = jnp.clip(pos + move, 0, jnp.asarray(env.clip_hi))
     dist_next = jnp.sum(jnp.abs(npos - desired), axis=1)
     # pairwise L1 distances after the move, self excluded
     pair = jnp.sum(jnp.abs(npos[:, None, :] - npos[None, :, :]), axis=-1)

@@ -52,6 +52,7 @@ def make_env(cfg: Config) -> GridWorld:
         n_agents=cfg.n_agents,
         scaling=cfg.scaling,
         collision_physics=cfg.collision_physics,
+        reference_clip=cfg.reference_clip,
     )
 
 

@@ -119,6 +119,60 @@ def test_golden_vs_reference_trajectories():
             )
 
 
+@pytest.mark.skipif(REF_ENV is None, reason="reference env not importable")
+def test_golden_nonsquare_reference_clip():
+    """The divergent clip branch, pinned against the reference.
+
+    On non-square grids the reference clips BOTH coordinates by nrow-1
+    (grid_world.py:55). ``reference_clip=True`` must reproduce that
+    trajectory exactly; the default per-axis clip must differ from it
+    precisely where a column move crosses the nrow bound.
+    """
+    rng = np.random.default_rng(7)
+    nrow, ncol = 3, 7  # ncol > nrow so the reference bound truncates cols
+    for trial in range(5):
+        n_agents = int(rng.integers(1, 6))
+        desired = rng.integers(0, [nrow, ncol], size=(n_agents, 2))
+        initial = rng.integers(0, [nrow, ncol], size=(n_agents, 2))
+        ref = REF_ENV(
+            nrow=nrow,
+            ncol=ncol,
+            n_agents=n_agents,
+            desired_state=desired,
+            initial_state=initial,
+            randomize_state=False,
+            scaling=True,
+        )
+        ref.reset()
+        env = GridWorld(nrow=nrow, ncol=ncol, n_agents=n_agents, reference_clip=True)
+        pos = jnp.asarray(initial, dtype=jnp.int32)
+        des = jnp.asarray(desired, dtype=jnp.int32)
+        for step in range(30):
+            actions = rng.integers(0, 5, size=n_agents)
+            ref.step(actions)
+            ref_state, ref_reward = ref.get_data()
+            pos, r = env_step(env, pos, des, jnp.asarray(actions, dtype=jnp.int32))
+            np.testing.assert_allclose(
+                np.asarray(scale_state(env, pos)), ref_state, rtol=1e-6
+            )
+            np.testing.assert_allclose(
+                np.asarray(scale_reward(env, r)), ref_reward, rtol=1e-6
+            )
+
+
+def test_nonsquare_default_clip_is_per_axis():
+    # Default (reference_clip=False): a +col move from col nrow-1 on a wide
+    # grid proceeds; the reference bound would have frozen it at nrow-1.
+    env = GridWorld(nrow=3, ncol=7, n_agents=1)
+    desired = jnp.array([[0, 6]], dtype=jnp.int32)
+    pos = jnp.array([[0, 2]], dtype=jnp.int32)
+    npos, _ = env_step(env, pos, desired, jnp.array([4]))  # +col
+    np.testing.assert_array_equal(np.asarray(npos), [[0, 3]])
+    ref_env = GridWorld(nrow=3, ncol=7, n_agents=1, reference_clip=True)
+    npos_ref, _ = env_step(ref_env, pos, desired, jnp.array([4]))
+    np.testing.assert_array_equal(np.asarray(npos_ref), [[0, 2]])
+
+
 def test_collision_physics_optin():
     # Two agents colliding on the same cell: with collision_physics the
     # lander is NOT rewarded with -dist_next; the lone agent is.
@@ -132,6 +186,17 @@ def test_collision_physics_optin():
     assert float(r[0]) == -5.0
     # agent1: also on shared cell -> penalty -( |2-0|+|3-0| )-1 = -6
     assert float(r[1]) == -6.0
+
+
+def test_reference_clip_plumbed_through_config():
+    from rcmarl_tpu.config import Config
+    from rcmarl_tpu.training.trainer import make_env
+
+    cfg = Config(nrow=3, ncol=7, reference_clip=True)
+    env = make_env(cfg)
+    assert env.reference_clip and env.nrow == 3 and env.ncol == 7
+    np.testing.assert_array_equal(env.clip_hi, [2, 2])
+    assert not make_env(Config()).reference_clip
 
 
 def test_vmap_over_batch():
