@@ -304,6 +304,65 @@ def write_parity_md(
     Path(path).write_text("\n".join(lines) + "\n")
 
 
+def _phase_boundaries(scenario_dir, H: int) -> List[int]:
+    """Episode indices where a new phase starts (first seed run's phase
+    lengths, cumulative, excluding 0 and the end) — where the restart
+    protocol's Adam/buffer/RNG reset happened."""
+    for _, phases in _seed_runs(Path(scenario_dir) / f"H={H}"):
+        bounds, total = [], 0
+        for df in phases[:-1]:
+            total += len(df)
+            bounds.append(total)
+        return bounds
+    return []
+
+
+def plot_drift_comparison(
+    mine_dir,
+    ref_dir,
+    out_path,
+    scenario: str = "coop",
+    H: int = 0,
+    rolling: int = 200,
+    mine_label: str = "this framework",
+    ref_label: str = "reference artifacts",
+) -> str:
+    """Overlay OUR seed-mean curve with the reference artifacts' for one
+    cell, actual phase boundaries marked per tree — the visual evidence
+    behind DRIFT.md (phase-1 agreement, phase-2 divergence). Uses drop=0
+    so the curves stay episode-aligned. Labels are parameters: the caller
+    knows what protocol (e.g. which eps) each tree was run with."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    mine = aggregate_scenario(Path(mine_dir) / scenario, H, drop=0, rolling=rolling)
+    ref = aggregate_scenario(Path(ref_dir) / scenario, H, drop=0, rolling=rolling)
+    if mine is None or ref is None:
+        raise FileNotFoundError(
+            f"cell {scenario}/H={H} missing under {mine_dir} or {ref_dir}"
+        )
+    fig, ax = plt.subplots(figsize=(7, 4))
+    (ref_line,) = ax.plot(ref["True_team_returns"], label=ref_label)
+    (mine_line,) = ax.plot(mine["True_team_returns"], label=mine_label)
+    # Mark each tree's ACTUAL restart boundaries (from its phase files) in
+    # that tree's color; single-phase trees get no line.
+    for tree_dir, line in ((ref_dir, ref_line), (mine_dir, mine_line)):
+        for b in _phase_boundaries(Path(tree_dir) / scenario, H):
+            ax.axvline(b, color=line.get_color(), linestyle=":", alpha=0.6)
+    ax.set_xlabel("Episode (dotted = phase restart)")
+    ax.set_ylabel(f"True team return (rolling {rolling})")
+    ax.set_title(f"{scenario}, H={H}: ours vs shipped artifacts")
+    ax.legend(fontsize=8)
+    fig.tight_layout()
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return str(out_path)
+
+
 def plot_returns(
     raw_data_dir,
     out_dir,

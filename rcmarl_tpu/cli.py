@@ -330,6 +330,14 @@ def cmd_sweep(argv) -> int:
     p.add_argument("--buffer_size", type=int, default=2000)
     p.add_argument("--slow_lr", type=float, default=0.002)
     p.add_argument("--fast_lr", type=float, default=0.01)
+    p.add_argument(
+        "--eps",
+        type=float,
+        default=0.1,
+        help="exploration mix (snapshot value 0.1; the published artifact "
+        "logs record eps: 0.05 from a newer reference revision — see "
+        "DRIFT.md)",
+    )
     p.add_argument("--out", type=str, default="./simulation_results/raw_data")
     p.add_argument("--phase", type=int, default=1, help="sim_data<phase>.pkl")
     p.add_argument(
@@ -375,6 +383,7 @@ def cmd_sweep(argv) -> int:
                 buffer_size=args.buffer_size,
                 slow_lr=args.slow_lr,
                 fast_lr=args.fast_lr,
+                eps_explore=args.eps,
                 consensus_impl=args.consensus_impl,
             )
             n_blocks = args.n_episodes // cfg.n_ep_fixed
@@ -605,12 +614,46 @@ def cmd_plot(argv) -> int:
         help="H cells to plot (default: every H=* directory found)",
     )
     p.add_argument("--summary", action="store_true", help="print final-return table")
+    p.add_argument(
+        "--drift",
+        nargs="*",
+        default=None,
+        metavar="SCENARIO:H",
+        help="also render ours-vs-reference-artifact overlay figures "
+        "(DRIFT.md evidence); no args = coop:0, or pass cells like "
+        "'greedy:1 malicious:1'",
+    )
+    from rcmarl_tpu.analysis.plots import DEFAULT_REF_RAW_DATA as _REF_DEFAULT
+
+    p.add_argument(
+        "--ref_raw_data",
+        type=str,
+        default=_REF_DEFAULT,
+        help="reference artifact tree for --drift overlays "
+        "(same convention as `parity`)",
+    )
     args = p.parse_args(argv)
 
-    from rcmarl_tpu.analysis.plots import final_returns, plot_returns
+    from rcmarl_tpu.analysis.plots import (
+        final_returns,
+        plot_drift_comparison,
+        plot_returns,
+    )
 
     if args.summary:
         print(final_returns(args.raw_data).to_string(index=False))
+    if args.drift is not None:
+        cells = args.drift or ["coop:0"]
+        for cell in cells:
+            scen, _, h = cell.partition(":")
+            path = plot_drift_comparison(
+                args.raw_data,
+                args.ref_raw_data,
+                Path(args.out) / f"drift_{scen}_h{h or 0}.png",
+                scenario=scen,
+                H=int(h or 0),
+            )
+            print(path)
     written = plot_returns(
         args.raw_data,
         args.out,

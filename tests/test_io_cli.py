@@ -264,6 +264,36 @@ class TestAnalysis:
         assert table.iloc[0]["scenario"] == "toy"
         assert abs(table.iloc[0]["team_return"] + 5) < 0.2
 
+    def test_drift_comparison_marks_actual_phase_boundaries(self, tmp_path):
+        """The DRIFT.md overlay figure: boundaries come from each tree's
+        own phase files, and asymmetric protocols don't invent one."""
+        from rcmarl_tpu.analysis.plots import (
+            _phase_boundaries,
+            plot_drift_comparison,
+        )
+
+        rng = np.random.default_rng(1)
+
+        def write(root, phases):
+            d = root / "coop" / "H=0" / "seed=1"
+            d.mkdir(parents=True)
+            for i, n in enumerate(phases, 1):
+                pd.DataFrame({
+                    "True_team_returns": rng.normal(-5, 0.1, n),
+                    "True_adv_returns": np.zeros(n),
+                    "Estimated_team_returns": rng.normal(-5, 0.1, n),
+                }).to_pickle(d / f"sim_data{i}.pkl")
+
+        mine, ref = tmp_path / "mine", tmp_path / "ref"
+        write(mine, [30])          # single phase: no boundary
+        write(ref, [30, 30])       # two-phase: boundary at 30
+        assert _phase_boundaries(mine / "coop", 0) == []
+        assert _phase_boundaries(ref / "coop", 0) == [30]
+        out = plot_drift_comparison(
+            mine, ref, tmp_path / "fig.png", scenario="coop", H=0, rolling=2
+        )
+        assert Path(out).exists()
+
     def test_reads_real_reference_sim_data(self):
         """Our loader consumes the reference's shipped pickles unchanged."""
         from rcmarl_tpu.analysis.plots import load_run
