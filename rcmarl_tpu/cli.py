@@ -646,12 +646,20 @@ def cmd_plot(argv) -> int:
         cells = args.drift or ["coop:0"]
         for cell in cells:
             scen, _, h = cell.partition(":")
+            try:
+                h_val = int(h) if h else 0
+            except ValueError:
+                raise SystemExit(
+                    f"--drift: bad cell spec {cell!r}; expected SCENARIO:H "
+                    "like 'coop:0' or 'malicious:1'"
+                )
             path = plot_drift_comparison(
                 args.raw_data,
                 args.ref_raw_data,
-                Path(args.out) / f"drift_{scen}_h{h or 0}.png",
+                Path(args.out) / f"drift_{scen}_h{h_val}.png",
                 scenario=scen,
-                H=int(h or 0),
+                H=h_val,
+                rolling=args.rolling,
             )
             print(path)
     written = plot_returns(

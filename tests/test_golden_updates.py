@@ -1,0 +1,235 @@
+"""Composite golden tests: our update primitives vs the reference's
+ACTUAL TF classes, under identical fixed weights and batches.
+
+The primitive pieces (forward pass, Adam, SGD, losses, Keras fit
+semantics) are golden-pinned in test_models_ops.py; the aggregation
+kernel in test_aggregation.py. These tests pin the COMPOSITES — the four
+RPBCAC update primitives (SURVEY.md §2 C4) end to end:
+
+  - critic/TR local fit message (resilient_CAC_agents.py:103-140):
+    TD target with pre-fit weights, 5 full-batch SGD steps, restore.
+  - full Phase II (train_agents.py:125-145 ordering): hidden trunk
+    consensus -> head projection on the NEW trunk -> normalized team
+    head update (resilient_CAC_agents.py:60-84,142-206).
+  - cooperative actor step (resilient_CAC_agents.py:86-101): global-TD
+    sample-weighted sparse CE, one Adam train_on_batch.
+
+Keras 3 compatibility shim for the REFERENCE side (not ours): the
+reference reuses one SGD instance across models and trainable-set
+changes, which Keras 3 rejects. Plain SGD is stateless, so a fresh
+instance per compile reproduces the Keras-2 behavior exactly (same shim
+the DRIFT.md snapshot runs use).
+"""
+
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from rcmarl_tpu.agents.updates import (
+    Batch,
+    consensus_update_one,
+    coop_actor_update,
+    coop_local_critic_fit,
+    coop_local_tr_fit,
+)
+from rcmarl_tpu.config import Config
+from rcmarl_tpu.ops.optim import adam_init
+
+tf = pytest.importorskip("tensorflow")
+keras = tf.keras
+
+
+def _load_reference_agent():
+    sys.path.insert(0, "/root/reference")
+    try:
+        from agents.resilient_CAC_agents import RPBCAC_agent  # type: ignore
+
+        return RPBCAC_agent
+    except Exception:
+        return None
+    finally:
+        sys.path.remove("/root/reference")
+
+
+REF_AGENT = _load_reference_agent()
+
+pytestmark = pytest.mark.skipif(
+    REF_AGENT is None, reason="reference agent not importable"
+)
+
+N_AGENTS, N_STATES, N_ACTIONS, HIDDEN = 5, 2, 5, (20, 20)
+GAMMA, FAST_LR, SLOW_LR = 0.9, 0.01, 0.002
+
+
+def _keras_model(in_feats, out_dim, softmax):
+    """The reference's model family (main.py:60-82)."""
+    return keras.Sequential(
+        [
+            keras.Input(shape=(N_AGENTS, in_feats)),
+            keras.layers.Flatten(),
+            keras.layers.Dense(20, activation=keras.layers.LeakyReLU(alpha=0.1)),
+            keras.layers.Dense(20, activation=keras.layers.LeakyReLU(alpha=0.1)),
+            keras.layers.Dense(out_dim, activation="softmax" if softmax else None),
+        ]
+    )
+
+
+def _stateless_sgd(cls):
+    """Keras-2-equivalent shim (see module docstring)."""
+    cls.optimizer_fast = property(
+        lambda self: keras.optimizers.SGD(learning_rate=self.fast_lr),
+        lambda self, v: None,
+    )
+
+
+if REF_AGENT is not None:
+    _stateless_sgd(REF_AGENT)
+
+
+def _make_agent(H=1, seed=0):
+    keras.utils.set_random_seed(seed)
+    actor = _keras_model(N_STATES, N_ACTIONS, softmax=True)
+    critic = _keras_model(N_STATES, 1, softmax=False)
+    tr = _keras_model(N_STATES + 1, 1, softmax=False)
+    return REF_AGENT(
+        actor, critic, tr, slow_lr=SLOW_LR, fast_lr=FAST_LR, gamma=GAMMA, H=H
+    )
+
+
+def _to_params(keras_weights):
+    """Keras [W1,b1,W2,b2,W3,b3] -> our ((W,b), (W,b), (W,b))."""
+    w = [jnp.asarray(a) for a in keras_weights]
+    return tuple((w[2 * i], w[2 * i + 1]) for i in range(len(w) // 2))
+
+
+def _to_keras(params):
+    return [np.asarray(a) for wb in params for a in wb]
+
+
+def _stack_msgs(msgs):
+    """List of per-neighbor param tuples -> leaves with leading n_in."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *msgs)
+
+
+def _batch(rng, B=16):
+    s = rng.normal(size=(B, N_AGENTS, N_STATES)).astype(np.float32)
+    ns = rng.normal(size=(B, N_AGENTS, N_STATES)).astype(np.float32)
+    a = rng.integers(0, N_ACTIONS, size=(B, N_AGENTS, 1)).astype(np.float32)
+    r = rng.normal(size=(B, 1)).astype(np.float32)
+    return s, ns, a, r
+
+
+def _cfg(H=1):
+    return Config(H=H, fast_lr=FAST_LR, slow_lr=SLOW_LR, gamma=GAMMA)
+
+
+def test_local_critic_fit_message_golden():
+    """The transmitted message of critic_update_local, and its restore."""
+    rng = np.random.default_rng(0)
+    agent = _make_agent()
+    s, ns, _, r = _batch(rng)
+    before = agent.critic.get_weights()
+
+    msg_ref, _ = agent.critic_update_local(
+        tf.constant(s), tf.constant(ns), tf.constant(r)
+    )
+    # restore semantics: the agent's own net is unchanged
+    for a, b in zip(agent.critic.get_weights(), before):
+        np.testing.assert_array_equal(a, b)
+
+    mine = coop_local_critic_fit(
+        _to_params(before),
+        jnp.asarray(s),
+        jnp.asarray(ns),
+        jnp.asarray(r),
+        jnp.ones((len(s),), jnp.float32),
+        _cfg(),
+    )
+    for ref_a, my_a in zip(msg_ref, _to_keras(mine)):
+        np.testing.assert_allclose(my_a, ref_a, rtol=1e-4, atol=1e-5)
+
+
+def test_local_tr_fit_message_golden():
+    rng = np.random.default_rng(1)
+    agent = _make_agent()
+    s, _, a, r = _batch(rng)
+    sa = np.concatenate([s, a], axis=-1)
+    before = agent.TR.get_weights()
+
+    msg_ref, _ = agent.TR_update_local(tf.constant(sa), tf.constant(r))
+
+    mine = coop_local_tr_fit(
+        _to_params(before),
+        jnp.asarray(sa),
+        jnp.asarray(r),
+        jnp.ones((len(s),), jnp.float32),
+        _cfg(),
+    )
+    for ref_a, my_a in zip(msg_ref, _to_keras(mine)):
+        np.testing.assert_allclose(my_a, ref_a, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("H", [0, 1])
+def test_phase2_consensus_golden(H):
+    """Hidden consensus + projection + team update, trainer ordering
+    (train_agents.py:125-145), against the reference agent end to end."""
+    rng = np.random.default_rng(2 + H)
+    agent = _make_agent(H=H)
+    s, _, _, _ = _batch(rng)
+    own_weights = agent.critic.get_weights()
+
+    # Four messages: own (index 0) + three perturbed neighbors.
+    msgs = [own_weights]
+    for k in range(3):
+        msgs.append([a + rng.normal(scale=0.05, size=a.shape).astype(np.float32)
+                     for a in own_weights])
+
+    agent.resilient_consensus_critic_hidden(msgs)
+    agg = agent.resilient_consensus_critic(tf.constant(s), msgs)
+    agent.critic_update_team(tf.constant(s), agg)
+    ref_final = agent.critic.get_weights()
+
+    mine = consensus_update_one(
+        _to_params(own_weights),
+        _stack_msgs([_to_params(m) for m in msgs]),
+        jnp.asarray(s),
+        jnp.ones((len(s),), jnp.float32),
+        _cfg(H=H),
+    )
+    for ref_a, my_a in zip(ref_final, _to_keras(mine)):
+        np.testing.assert_allclose(my_a, ref_a, rtol=1e-4, atol=1e-5)
+
+
+def test_coop_actor_update_golden():
+    """Sample-weighted sparse-CE Adam step with the global TD error."""
+    rng = np.random.default_rng(4)
+    agent = _make_agent()
+    s, ns, a, _ = _batch(rng)
+    sa = np.concatenate([s, a], axis=-1)
+    a_own = a[:, 0, :]  # this agent's own actions, (B, 1)
+    actor_before = agent.actor.get_weights()
+    critic_w = agent.critic.get_weights()
+    tr_w = agent.TR.get_weights()
+
+    agent.actor_update(
+        tf.constant(s), tf.constant(ns), tf.constant(sa), tf.constant(a_own)
+    )
+    ref_final = agent.actor.get_weights()
+
+    actor_p = _to_params(actor_before)
+    new_actor, _ = coop_actor_update(
+        actor_p,
+        adam_init(actor_p),
+        _to_params(critic_w),
+        _to_params(tr_w),
+        jnp.asarray(s),
+        jnp.asarray(ns),
+        jnp.asarray(sa),
+        jnp.asarray(a_own[:, 0]),
+        _cfg(),
+    )
+    for ref_a, my_a in zip(ref_final, _to_keras(new_actor)):
+        np.testing.assert_allclose(my_a, ref_a, rtol=1e-4, atol=1e-5)
