@@ -30,6 +30,9 @@ import pytest
 
 from rcmarl_tpu.agents.updates import (
     Batch,
+    adv_actor_update,
+    adv_critic_fit,
+    adv_tr_fit,
     consensus_update_one,
     coop_actor_update,
     coop_local_critic_fit,
@@ -42,19 +45,32 @@ tf = pytest.importorskip("tensorflow")
 keras = tf.keras
 
 
-def _load_reference_agent():
+def _load_reference_agents():
+    """Import each reference module independently so a broken adversarial
+    module only skips the adversary tests, not the cooperative ones."""
     sys.path.insert(0, "/root/reference")
+    coop = greedy = malicious = None
     try:
         from agents.resilient_CAC_agents import RPBCAC_agent  # type: ignore
 
-        return RPBCAC_agent
+        coop = RPBCAC_agent
     except Exception:
-        return None
+        pass
+    try:
+        from agents.adversarial_CAC_agents import (  # type: ignore
+            Greedy_CAC_agent,
+            Malicious_CAC_agent,
+        )
+
+        greedy, malicious = Greedy_CAC_agent, Malicious_CAC_agent
+    except Exception:
+        pass
     finally:
         sys.path.remove("/root/reference")
+    return coop, greedy, malicious
 
 
-REF_AGENT = _load_reference_agent()
+REF_AGENT, REF_GREEDY, REF_MALICIOUS = _load_reference_agents()
 
 pytestmark = pytest.mark.skipif(
     REF_AGENT is None, reason="reference agent not importable"
@@ -89,14 +105,23 @@ if REF_AGENT is not None:
     _stateless_sgd(REF_AGENT)
 
 
-def _make_agent(H=1, seed=0):
+def _models(seed):
+    """The reference's model family (main.py:60-82) at seeded weights."""
     keras.utils.set_random_seed(seed)
-    actor = _keras_model(N_STATES, N_ACTIONS, softmax=True)
-    critic = _keras_model(N_STATES, 1, softmax=False)
-    tr = _keras_model(N_STATES + 1, 1, softmax=False)
-    return REF_AGENT(
-        actor, critic, tr, slow_lr=SLOW_LR, fast_lr=FAST_LR, gamma=GAMMA, H=H
+    return (
+        _keras_model(N_STATES, N_ACTIONS, softmax=True),
+        _keras_model(N_STATES, 1, softmax=False),
+        _keras_model(N_STATES + 1, 1, softmax=False),
     )
+
+
+def _make_agent(H=1, seed=0):
+    return REF_AGENT(*_models(seed), slow_lr=SLOW_LR, fast_lr=FAST_LR,
+                     gamma=GAMMA, H=H)
+
+
+def _make_adversary(cls, seed):
+    return cls(*_models(seed), slow_lr=SLOW_LR, fast_lr=FAST_LR, gamma=GAMMA)
 
 
 def _to_params(keras_weights):
@@ -200,6 +225,140 @@ def test_phase2_consensus_golden(H):
         _cfg(H=H),
     )
     for ref_a, my_a in zip(ref_final, _to_keras(mine)):
+        np.testing.assert_allclose(my_a, ref_a, rtol=1e-4, atol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# Adversary composites (C5-C7). The reference's adversary fits are
+# SHUFFLED minibatch runs (fit(epochs=10, batch_size=32)) whose exact
+# trajectory depends on Keras's private shuffle RNG — but with B <=
+# batch_size every epoch is one full batch, the shuffle is a no-op, and
+# the composite becomes exactly comparable. B=16 below.
+# ----------------------------------------------------------------------
+
+
+adversarial = pytest.mark.skipif(
+    REF_GREEDY is None, reason="reference adversarial agents not importable"
+)
+
+
+@adversarial
+def test_greedy_critic_and_tr_fit_golden():
+    """Greedy local fits PERSIST and are transmitted
+    (adversarial_CAC_agents.py:228-253): 10 single-batch epochs here."""
+    rng = np.random.default_rng(5)
+    agent = _make_adversary(REF_GREEDY, seed=10)
+    s, ns, a, r = _batch(rng)
+    sa = np.concatenate([s, a], axis=-1)
+    critic_before = agent.critic.get_weights()
+    tr_before = agent.TR.get_weights()
+
+    ref_critic, _ = agent.critic_update_local(
+        tf.constant(s), tf.constant(ns), tf.constant(r)
+    )
+    ref_tr, _ = agent.TR_update_local(tf.constant(sa), tf.constant(r))
+
+    cfg = _cfg()
+    mask = jnp.ones((len(s),), jnp.float32)
+    mine_critic = adv_critic_fit(
+        jax.random.PRNGKey(0), _to_params(critic_before),
+        jnp.asarray(s), jnp.asarray(ns), jnp.asarray(r), mask, cfg,
+    )
+    mine_tr = adv_tr_fit(
+        jax.random.PRNGKey(1), _to_params(tr_before),
+        jnp.asarray(sa), jnp.asarray(r), mask, cfg,
+    )
+    for ref_a, my_a in zip(ref_critic, _to_keras(mine_critic)):
+        np.testing.assert_allclose(my_a, ref_a, rtol=1e-4, atol=1e-5)
+    for ref_a, my_a in zip(ref_tr, _to_keras(mine_tr)):
+        np.testing.assert_allclose(my_a, ref_a, rtol=1e-4, atol=1e-5)
+
+
+def test_malicious_compromised_fits_golden():
+    """The Byzantine poisoning path (adversarial_CAC_agents.py:121-165):
+    compromised critic/TR trained toward the NEGATED cooperative reward."""
+    rng = np.random.default_rng(6)
+    agent = _make_adversary(REF_MALICIOUS, seed=11)
+    s, ns, a, r_coop = _batch(rng)
+    sa = np.concatenate([s, a], axis=-1)
+    r_comp = -r_coop
+    critic_before = agent.critic.get_weights()
+    tr_before = agent.TR.get_weights()
+
+    ref_critic, _ = agent.critic_update_compromised(
+        tf.constant(s), tf.constant(ns), tf.constant(r_comp)
+    )
+    ref_tr, _ = agent.TR_update_compromised(tf.constant(sa), tf.constant(r_comp))
+
+    cfg = _cfg()
+    mask = jnp.ones((len(s),), jnp.float32)
+    mine_critic = adv_critic_fit(
+        jax.random.PRNGKey(0), _to_params(critic_before),
+        jnp.asarray(s), jnp.asarray(ns), jnp.asarray(r_comp), mask, cfg,
+    )
+    mine_tr = adv_tr_fit(
+        jax.random.PRNGKey(1), _to_params(tr_before),
+        jnp.asarray(sa), jnp.asarray(r_comp), mask, cfg,
+    )
+    for ref_a, my_a in zip(ref_critic, _to_keras(mine_critic)):
+        np.testing.assert_allclose(my_a, ref_a, rtol=1e-4, atol=1e-5)
+    for ref_a, my_a in zip(ref_tr, _to_keras(mine_tr)):
+        np.testing.assert_allclose(my_a, ref_a, rtol=1e-4, atol=1e-5)
+
+
+def test_malicious_private_critic_fit_golden():
+    """The malicious agent's PRIVATE local critic (adversarial_CAC_agents
+    .py:137-152): trained on its own reward via a weight swap, persisted
+    to critic_local_weights, compromised critic untouched."""
+    rng = np.random.default_rng(7)
+    agent = _make_adversary(REF_MALICIOUS, seed=11)
+    s, ns, _, r = _batch(rng)
+    local_before = [np.array(a) for a in agent.critic_local_weights]
+    compromised_before = agent.critic.get_weights()
+
+    agent.critic_update_local(tf.constant(s), tf.constant(ns), tf.constant(r))
+    # compromised critic restored after the swap
+    for a, b in zip(agent.critic.get_weights(), compromised_before):
+        np.testing.assert_array_equal(a, b)
+
+    mine = adv_critic_fit(
+        jax.random.PRNGKey(0), _to_params(local_before),
+        jnp.asarray(s), jnp.asarray(ns), jnp.asarray(r),
+        jnp.ones((len(s),), jnp.float32), _cfg(),
+    )
+    for ref_a, my_a in zip(agent.critic_local_weights, _to_keras(mine)):
+        np.testing.assert_allclose(my_a, ref_a, rtol=1e-4, atol=1e-5)
+
+
+def test_adversary_actor_update_golden():
+    """Adversary actor: local-TD sample weights, fit(batch_size=200,
+    epochs=1) — a single Adam batch at B=16 (adversarial_CAC_agents.py:
+    211-226; the malicious variant drives it off the private critic)."""
+    rng = np.random.default_rng(8)
+    agent = _make_adversary(REF_GREEDY, seed=10)
+    s, ns, a, r = _batch(rng)
+    a_own = a[:, 0, :]
+    actor_before = agent.actor.get_weights()
+    critic_w = agent.critic.get_weights()
+
+    agent.actor_update(
+        tf.constant(s), tf.constant(ns), tf.constant(r), tf.constant(a_own)
+    )
+    ref_final = agent.actor.get_weights()
+
+    actor_p = _to_params(actor_before)
+    new_actor, _ = adv_actor_update(
+        jax.random.PRNGKey(0),
+        actor_p,
+        adam_init(actor_p),
+        _to_params(critic_w),
+        jnp.asarray(s),
+        jnp.asarray(ns),
+        jnp.asarray(r),
+        jnp.asarray(a_own[:, 0]),
+        _cfg(),
+    )
+    for ref_a, my_a in zip(ref_final, _to_keras(new_actor)):
         np.testing.assert_allclose(my_a, ref_a, rtol=1e-4, atol=1e-5)
 
 
