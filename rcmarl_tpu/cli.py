@@ -356,6 +356,13 @@ def cmd_sweep(argv) -> int:
         choices=list(CONSENSUS_IMPLS),
         help="consensus aggregation backend (pallas = fused TPU kernel)",
     )
+    p.add_argument(
+        "--skip_existing",
+        action="store_true",
+        help="skip cells whose sim_data files are all already on disk, so "
+        "a crashed or interrupted matrix run can be re-issued verbatim and "
+        "only computes what is missing",
+    )
     args = p.parse_args(argv)
     if args.n_episodes <= 0 or args.n_episodes % args.n_ep_fixed != 0:
         raise SystemExit(
@@ -372,6 +379,16 @@ def cmd_sweep(argv) -> int:
     for scen in args.scenarios:
         labels, is_global = scenario_labels(scen)
         for H in args.H:
+            if args.skip_existing and all(
+                (
+                    out_root / scen / f"H={H}" / f"seed={seed}"
+                    / f"sim_data{args.phase + ph}.pkl"
+                ).exists()
+                for seed in args.seeds
+                for ph in range(args.phases)
+            ):
+                print(f"{scen} H={H}: complete on disk, skipping")
+                continue
             cfg = Config.from_labels(
                 labels,
                 H=H,

@@ -29,7 +29,6 @@ import jax.numpy as jnp
 import pytest
 
 from rcmarl_tpu.agents.updates import (
-    Batch,
     adv_actor_update,
     adv_critic_fit,
     adv_tr_fit,
@@ -274,6 +273,7 @@ def test_greedy_critic_and_tr_fit_golden():
         np.testing.assert_allclose(my_a, ref_a, rtol=1e-4, atol=1e-5)
 
 
+@adversarial
 def test_malicious_compromised_fits_golden():
     """The Byzantine poisoning path (adversarial_CAC_agents.py:121-165):
     compromised critic/TR trained toward the NEGATED cooperative reward."""
@@ -306,6 +306,7 @@ def test_malicious_compromised_fits_golden():
         np.testing.assert_allclose(my_a, ref_a, rtol=1e-4, atol=1e-5)
 
 
+@adversarial
 def test_malicious_private_critic_fit_golden():
     """The malicious agent's PRIVATE local critic (adversarial_CAC_agents
     .py:137-152): trained on its own reward via a weight swap, persisted
@@ -330,6 +331,7 @@ def test_malicious_private_critic_fit_golden():
         np.testing.assert_allclose(my_a, ref_a, rtol=1e-4, atol=1e-5)
 
 
+@adversarial
 def test_adversary_actor_update_golden():
     """Adversary actor: local-TD sample weights, fit(batch_size=200,
     epochs=1) — a single Adam batch at B=16 (adversarial_CAC_agents.py:
