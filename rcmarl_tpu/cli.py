@@ -691,6 +691,34 @@ def cmd_plot(argv) -> int:
     return 0
 
 
+def _related_artifacts_section(summary_out) -> str:
+    """Cross-reference block for the generated PARITY.md, listing only
+    artifacts that actually exist on disk at generation time — a
+    regenerated evidence document must not point at dead files."""
+    candidates = [
+        (
+            summary_out,
+            "the per-seed numbers behind every row above, regenerated by "
+            "the same command",
+        ),
+        (
+            "DRIFT.md",
+            "root-cause analysis of the private-reward cells' "
+            "late-training delta (the reference's shipped artifacts come "
+            "from a newer revision with `eps: 0.05` exploration)",
+        ),
+        ("simulation_results/figures", "curve figures incl. `drift_*.png` overlays"),
+        ("BENCH_SHARD.jsonl", "agent-sharding wall-clock A/B (PARALLELISM.md)"),
+        ("BENCH_SCALING.jsonl", "scaling matrix incl. xla-vs-pallas consensus"),
+    ]
+    lines = [
+        f"- `{p}` — {desc}" for p, desc in candidates if p and Path(p).exists()
+    ]
+    if not lines:
+        return ""
+    return "## Related artifacts\n\n" + "\n".join(lines) + "\n"
+
+
 def cmd_parity(argv) -> int:
     p = argparse.ArgumentParser(
         prog="rcmarl_tpu parity",
@@ -732,14 +760,8 @@ def cmd_parity(argv) -> int:
         mine=mine_seeds,
         ref=ref_seeds,
     )
-    write_parity_md(
-        table,
-        args.out,
-        args.window,
-        args.tolerance,
-        mine_dir=args.raw_data,
-        ref_dir=args.ref_raw_data,
-    )
+    # Summary artifact first: the PARITY.md cross-reference section lists
+    # only files that exist at generation time, and this is one of them.
     if args.summary_out:
         def records(df):
             # NaN (e.g. adv_return of all-cooperative cells) -> null so the
@@ -771,6 +793,15 @@ def cmd_parity(argv) -> int:
         out.parent.mkdir(parents=True, exist_ok=True)
         out.write_text(json.dumps(summary, indent=1, default=float) + "\n")
         print(f"wrote {args.summary_out}")
+    write_parity_md(
+        table,
+        args.out,
+        args.window,
+        args.tolerance,
+        mine_dir=args.raw_data,
+        ref_dir=args.ref_raw_data,
+        extra_sections=_related_artifacts_section(args.summary_out),
+    )
     print(table.to_string(index=False))
     print(f"wrote {args.out}")
     return 0
