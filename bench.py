@@ -27,7 +27,11 @@ subprocesses with hard wall-clock timeouts, orchestrated by this parent:
    ``"platform": "cpu"`` — honest, not a fake TPU claim).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
-"platform", "attempts"}.
+"platform", "workload", "attempts"} plus, on TPU, "candidates" — the
+replica-count sweep (one isolated child per count) whose best aggregate
+throughput is the headline "value"; "workload" records the winning
+shape, and numbers are cross-round comparable only when workloads match.
+The CPU fallback adds "note".
 """
 
 import json
@@ -46,10 +50,15 @@ BACKOFF_S = 30.0
 
 
 def _measure(n_seeds: int, n_blocks: int, reps: int) -> None:
-    """Child: run the measurement on whatever backend JAX_PLATFORMS says.
+    """Child: run ONE measurement on whatever backend JAX_PLATFORMS says.
 
-    Prints one JSON line with the raw measurement; the parent re-emits it
-    with orchestration metadata attached.
+    One replica count per child process: a candidate that OOMs, hangs, or
+    trips the finite-checksum assert must not be able to destroy another
+    candidate's already-finished measurement (the parent holds each
+    result as soon as the child prints it).
+
+    Prints one JSON line with the raw measurement; the parent re-emits
+    the best candidate with orchestration metadata attached.
     """
     import jax
     import jax.numpy as jnp
@@ -62,12 +71,12 @@ def _measure(n_seeds: int, n_blocks: int, reps: int) -> None:
     # Published-run hyperparameters (job.sh: slow_lr=0.002; BASELINE.md)
     cfg = Config(slow_lr=0.002, fast_lr=0.01, seed=100)
 
-    states = init_states(cfg, list(range(100, 100 + n_seeds)))
-    run = jax.jit(jax.vmap(lambda s: train_scanned(cfg, s, n_blocks)))
-
     def fetch(states, metrics):
         """Force completion: pull a scalar depending on every replica."""
         return float(jnp.sum(metrics.true_team_returns) + jnp.sum(states.block))
+
+    states = init_states(cfg, list(range(100, 100 + n_seeds)))
+    run = jax.jit(jax.vmap(lambda s: train_scanned(cfg, s, n_blocks)))
 
     # Warmup: compile + one full execution (buffers reach steady state).
     states, metrics = run(states)
@@ -160,15 +169,29 @@ def main() -> int:
             time.sleep(BACKOFF_S * (2**i))
 
     if tpu_ok:
-        res = _run_child(
-            ["--child", "--seeds", "32", "--blocks", "10", "--reps", "3"],
-            {},
-            TPU_TIMEOUT_S,
-        )
-        attempts.append({"stage": "tpu_measure", **res})
-        if "value" in res:
-            res["attempts"] = len(attempts)
-            print(json.dumps(res))
+        # Replica-count sweep, ONE CHILD EACH: aggregate throughput grows
+        # with replica batching until the chip saturates, and a candidate
+        # that OOMs or hangs must not cost the others' results. The first
+        # (smallest) candidate is the proven-safe round-2 workload.
+        candidates = []
+        for n_seeds in (32, 128):
+            res = _run_child(
+                ["--child", "--seeds", str(n_seeds), "--blocks", "10",
+                 "--reps", "3"],
+                {},
+                TPU_TIMEOUT_S,
+            )
+            attempts.append({"stage": f"tpu_measure_{n_seeds}", **res})
+            if "value" in res:
+                candidates.append(res)
+        if candidates:
+            best = max(candidates, key=lambda c: c["value"])
+            best["candidates"] = [
+                {"value": c["value"], "workload": c["workload"]}
+                for c in candidates
+            ]
+            best["attempts"] = len(attempts)
+            print(json.dumps(best))
             return 0
 
     # Fallback: a smaller CPU measurement — still a real end-to-end number

@@ -691,10 +691,15 @@ def cmd_plot(argv) -> int:
     return 0
 
 
-def _related_artifacts_section(summary_out) -> str:
+def _related_artifacts_section(summary_out, out_dir) -> str:
     """Cross-reference block for the generated PARITY.md, listing only
     artifacts that actually exist on disk at generation time — a
-    regenerated evidence document must not point at dead files."""
+    regenerated evidence document must not point at dead files.
+
+    Relative candidates resolve against ``out_dir`` (where PARITY.md is
+    written, i.e. where its links are relative to when read), not the
+    process CWD."""
+    out_dir = Path(out_dir)
     candidates = [
         (
             summary_out,
@@ -712,7 +717,10 @@ def _related_artifacts_section(summary_out) -> str:
         ("BENCH_SCALING.jsonl", "scaling matrix incl. xla-vs-pallas consensus"),
     ]
     lines = [
-        f"- `{p}` — {desc}" for p, desc in candidates if p and Path(p).exists()
+        f"- `{p}` — {desc}"
+        for p, desc in candidates
+        if p
+        and (Path(p) if Path(p).is_absolute() else out_dir / p).exists()
     ]
     if not lines:
         return ""
@@ -800,7 +808,9 @@ def cmd_parity(argv) -> int:
         args.tolerance,
         mine_dir=args.raw_data,
         ref_dir=args.ref_raw_data,
-        extra_sections=_related_artifacts_section(args.summary_out),
+        extra_sections=_related_artifacts_section(
+            args.summary_out, Path(args.out).parent
+        ),
     )
     print(table.to_string(index=False))
     print(f"wrote {args.out}")
