@@ -71,6 +71,25 @@ class TestSeedParallel:
         with pytest.raises(ValueError):
             make_mesh(8, seed_axis=3)
 
+    @pytest.mark.slow
+    def test_repeated_calls_reuse_compiled_program(self):
+        """Resume calls (sweep phase 2, timed bench reps) must hit the
+        compiled-program cache instead of re-tracing a fresh closure, and
+        resumed execution must stay correct (blocks advance, finite)."""
+        from rcmarl_tpu.parallel import seeds as seeds_mod
+
+        cfg = TINY
+        mesh = make_mesh(2)
+        seeds_mod._JIT_CACHE.clear()
+        states, _ = train_parallel(cfg, seeds=[1, 2], n_blocks=1, mesh=mesh)
+        assert len(seeds_mod._JIT_CACHE) == 1
+        fn_first = next(iter(seeds_mod._JIT_CACHE.values()))
+        states, m = train_parallel(cfg, states=states, n_blocks=1, mesh=mesh)
+        assert len(seeds_mod._JIT_CACHE) == 1
+        assert next(iter(seeds_mod._JIT_CACHE.values())) is fn_first
+        assert np.all(np.asarray(states.block) == 2)
+        assert np.all(np.isfinite(np.asarray(m.true_team_returns)))
+
 
 class TestAgentSharding:
     @pytest.mark.slow
