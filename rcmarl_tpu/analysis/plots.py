@@ -239,6 +239,66 @@ def parity_table(
     )
 
 
+def qualitative_claims_section(table: pd.DataFrame) -> str:
+    """The reference README's headline claims, computed from the SAME
+    parity table for both sides (reference README.md:22-29): adversaries
+    degrade H=0 training, and H=1 trimming recovers near-cooperative
+    returns. Reported as deltas vs the all-cooperative cell of the same
+    H, so any uniform late-training offset (DRIFT.md) cancels."""
+
+    def cell(scen, H, col):
+        r = table[(table.scenario == scen) & (table.H == H)]
+        return float(r[col].iloc[0]) if len(r) else np.nan
+
+    def fmt(x):
+        return f"{x:+.2f}" if np.isfinite(x) else "—"
+
+    #: An H=1 run "recovers" when trimming undoes at least this fraction
+    #: of the same adversary's H=0 degradation (reference recoveries are
+    #: 87-95% by this measure; ours 88-92%).
+    RECOVERY_FRACTION = 0.75
+    #: H=0 "degrades" when the adversary costs at least this much return.
+    DEGRADE_THRESHOLD = 0.5
+
+    lines = [
+        "## Qualitative claims (reference README)",
+        "",
+        "Attack impact = adversary-cell team return minus the coop cell at",
+        "the same H (0 = no impact; more negative = more damage). Both",
+        "columns computed from the table above. Verdicts are measured, not",
+        f"asserted: H=0 'degrades' needs ≥{DEGRADE_THRESHOLD} return cost;",
+        f"H=1 'recovers' needs ≥{RECOVERY_FRACTION:.0%} of that cell's own",
+        "H=0 degradation undone by trimming.",
+        "",
+        "| Scenario | H | reference impact | ours | claim | verdict |",
+        "|---|---|---|---|---|---|",
+    ]
+    for scen in ("greedy", "faulty", "malicious"):
+        imp = {
+            (side, H): cell(scen, H, col) - cell("coop", H, col)
+            for side, col in (("ref", "ref_mean"), ("mine", "mine_mean"))
+            for H in (0, 1)
+        }
+        for H in (0, 1):
+            ref, mine = imp[("ref", H)], imp[("mine", H)]
+            if H == 0:
+                claim = "degrades training (H=0, no defense)"
+                ok = mine <= -DEGRADE_THRESHOLD
+            else:
+                claim = "trimming recovers near-coop returns"
+                ok = abs(mine) <= (1 - RECOVERY_FRACTION) * abs(imp[("mine", 0)])
+            verdict = (
+                "missing"
+                if not np.isfinite(mine)
+                else ("holds" if ok else "**FAILS**")
+            )
+            lines.append(
+                f"| {scen} | {H} | {fmt(ref)} | {fmt(mine)} | {claim} "
+                f"| {verdict} |"
+            )
+    return "\n".join(lines) + "\n"
+
+
 def write_parity_md(
     table: pd.DataFrame,
     path,

@@ -753,6 +753,7 @@ def cmd_parity(argv) -> int:
     from rcmarl_tpu.analysis.plots import (
         parity_table,
         per_seed_final_returns,
+        qualitative_claims_section,
         write_parity_md,
     )
 
@@ -808,8 +809,10 @@ def cmd_parity(argv) -> int:
         args.tolerance,
         mine_dir=args.raw_data,
         ref_dir=args.ref_raw_data,
-        extra_sections=_related_artifacts_section(
-            args.summary_out, Path(args.out).parent
+        extra_sections=(
+            qualitative_claims_section(table)
+            + "\n"
+            + _related_artifacts_section(args.summary_out, Path(args.out).parent)
         ),
     )
     print(table.to_string(index=False))

@@ -294,6 +294,36 @@ class TestAnalysis:
         )
         assert Path(out).exists()
 
+    def test_qualitative_claims_section_verdicts(self):
+        """Measured verdicts, not asserted ones: holds / FAILS / missing,
+        and NaN cells render as dashes, never 'nan'."""
+        from rcmarl_tpu.analysis.plots import qualitative_claims_section
+
+        def row(scen, H, ref, mine):
+            return {"scenario": scen, "H": H, "ref_mean": ref, "mine_mean": mine}
+
+        table = pd.DataFrame([
+            row("coop", 0, -5.0, -5.0),
+            row("coop", 1, -5.2, -5.2),
+            # greedy: degrades at H=0, trimming recovers 90% -> holds twice
+            row("greedy", 0, -7.0, -7.0),
+            row("greedy", 1, -5.4, -5.4),
+            # faulty: H=1 impact as bad as H=0 -> recovery claim FAILS
+            row("faulty", 0, -7.0, -7.0),
+            row("faulty", 1, -5.4, -7.2),
+            # malicious: our cells absent -> missing (ref NaN must not
+            # print as 'nan')
+            row("malicious", 0, np.nan, np.nan),
+        ])
+        md = qualitative_claims_section(table)
+        lines = {l.split("|")[1].strip() + l.split("|")[2].strip(): l
+                 for l in md.splitlines() if l.startswith("| ")}
+        assert "holds" in lines["greedy0"] and "holds" in lines["greedy1"]
+        assert "FAILS" in lines["faulty1"] and "holds" in lines["faulty0"]
+        assert "missing" in lines["malicious0"] and "missing" in lines["malicious1"]
+        assert "nan" not in md
+        assert "—" in lines["malicious0"]
+
     def test_reads_real_reference_sim_data(self):
         """Our loader consumes the reference's shipped pickles unchanged."""
         from rcmarl_tpu.analysis.plots import load_run
