@@ -284,14 +284,21 @@ def qualitative_claims_section(table: pd.DataFrame) -> str:
             if H == 0:
                 claim = "degrades training (H=0, no defense)"
                 ok = mine <= -DEGRADE_THRESHOLD
+                testable = True
             else:
                 claim = "trimming recovers near-coop returns"
-                ok = abs(mine) <= (1 - RECOVERY_FRACTION) * abs(imp[("mine", 0)])
-            verdict = (
-                "missing"
-                if not np.isfinite(mine)
-                else ("holds" if ok else "**FAILS**")
-            )
+                # Recovery is relative to this adversary's own measured
+                # H=0 damage; without a material H=0 degradation on our
+                # side there is nothing to recover from.
+                base = imp[("mine", 0)]
+                testable = np.isfinite(base) and abs(base) >= DEGRADE_THRESHOLD
+                ok = testable and abs(mine) <= (1 - RECOVERY_FRACTION) * abs(base)
+            if not np.isfinite(mine):
+                verdict = "missing"
+            elif not testable:
+                verdict = "untestable (no measured H=0 degradation)"
+            else:
+                verdict = "holds" if ok else "**FAILS**"
             lines.append(
                 f"| {scen} | {H} | {fmt(ref)} | {fmt(mine)} | {claim} "
                 f"| {verdict} |"

@@ -532,6 +532,7 @@ def cmd_bench(argv) -> int:
     from rcmarl_tpu.utils.profiling import Timer
 
     shard_modes = [None] if args.shard_agents is None else args.shard_agents
+    n_failed = 0
     for name in args.configs:
         for impl in args.impl:
             for shard in shard_modes:
@@ -566,13 +567,32 @@ def cmd_bench(argv) -> int:
                         )
                         return st, metrics
 
-                state, metrics = run(state)  # compile + warm
-                jax.device_get(metrics.true_team_returns)
-                best = float("inf")
-                for _ in range(args.reps):
-                    t = Timer().start()
-                    state, metrics = run(state)
-                    best = min(best, t.stop(metrics.true_team_returns))
+                try:
+                    state, metrics = run(state)  # compile + warm
+                    jax.device_get(metrics.true_team_returns)
+                    best = float("inf")
+                    for _ in range(args.reps):
+                        t = Timer().start()
+                        state, metrics = run(state)
+                        best = min(best, t.stop(metrics.true_team_returns))
+                except Exception as e:  # noqa: BLE001
+                    # One cell must not cost the rest of the matrix (e.g.
+                    # a pallas lowering failure on new hardware while the
+                    # xla rows are still to come). Record it and move on.
+                    err = json.dumps(
+                        {
+                            "config": name,
+                            "impl": impl,
+                            **({} if shard is None else {"shard_agents": bool(shard)}),
+                            "error": f"{type(e).__name__}: {e}"[:300],
+                        }
+                    )
+                    print(err, file=sys.stderr)
+                    if args.out:
+                        with open(args.out, "a") as f:
+                            f.write(err + "\n")
+                    n_failed += 1
+                    continue
                 steps = args.blocks * cfg.block_steps
                 row = json.dumps(
                     {
@@ -605,7 +625,10 @@ def cmd_bench(argv) -> int:
                 if args.out:
                     with open(args.out, "a") as f:
                         f.write(row + "\n")
-    return 0
+    # Completed rows are already flushed; a nonzero rc signals that some
+    # cells failed so drivers judging by exit code don't record a clean
+    # benchmark over missing measurements.
+    return 1 if n_failed else 0
 
 
 # --------------------------------------------------------------------------

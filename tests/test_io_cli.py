@@ -324,6 +324,18 @@ class TestAnalysis:
         assert "nan" not in md
         assert "—" in lines["malicious0"]
 
+        # A negligible H=0 degradation makes the H=1 recovery claim
+        # untestable, never FAILS.
+        table2 = pd.DataFrame([
+            row("coop", 0, -5.0, -5.0),
+            row("coop", 1, -5.2, -5.2),
+            row("greedy", 0, -7.0, -5.1),
+            row("greedy", 1, -5.4, -5.3),
+        ])
+        md2 = qualitative_claims_section(table2)
+        g1 = [l for l in md2.splitlines() if l.startswith("| greedy | 1")][0]
+        assert "untestable" in g1 and "FAILS" not in g1
+
     def test_reads_real_reference_sim_data(self):
         """Our loader consumes the reference's shipped pickles unchanged."""
         from rcmarl_tpu.analysis.plots import load_run
