@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import pytest
 
 from rcmarl_tpu.agents.updates import (
+    Batch,
     adv_actor_update,
     adv_critic_fit,
     adv_tr_fit,
@@ -362,6 +363,107 @@ def test_adversary_actor_update_golden():
     )
     for ref_a, my_a in zip(ref_final, _to_keras(new_actor)):
         np.testing.assert_allclose(my_a, ref_a, rtol=1e-4, atol=1e-5)
+
+
+def test_full_update_block_composition_golden():
+    """The ENTIRE update block — n_epochs x (phase I local fits -> phase
+    II consensus, in the trainer's exact per-node order,
+    train_agents.py:100-145) followed by the phase III actor step — for a
+    5-agent all-cooperative network on the reference topology, reference
+    objects vs our single fused ``update_block``. Pins the composition
+    (message wiring, epoch chaining, actor window), not just the
+    per-primitive math."""
+    from rcmarl_tpu.config import Roles, circulant_in_nodes
+    from rcmarl_tpu.training.update import init_agent_params, update_block
+
+    rng = np.random.default_rng(9)
+    n_epochs, B, B_fresh = 3, 50, 20
+    in_nodes = circulant_in_nodes(N_AGENTS, 4)
+    cfg = Config(
+        n_agents=N_AGENTS,
+        agent_roles=(Roles.COOPERATIVE,) * N_AGENTS,
+        in_nodes=in_nodes,
+        H=1,
+        n_epochs=n_epochs,
+        fast_lr=FAST_LR,
+        slow_lr=SLOW_LR,
+        gamma=GAMMA,
+    )
+    agents = [_make_agent(H=1, seed=20 + i) for i in range(N_AGENTS)]
+    init_ws = [
+        (ag.actor.get_weights(), ag.critic.get_weights(), ag.TR.get_weights())
+        for ag in agents
+    ]
+
+    s = rng.normal(size=(B, N_AGENTS, N_STATES)).astype(np.float32)
+    ns = rng.normal(size=(B, N_AGENTS, N_STATES)).astype(np.float32)
+    a = rng.integers(0, N_ACTIONS, size=(B, N_AGENTS, 1)).astype(np.float32)
+    r = rng.normal(size=(B, N_AGENTS, 1)).astype(np.float32)
+    sa = np.concatenate([s, a], axis=-1)
+    ts, tns, tsa = tf.constant(s), tf.constant(ns), tf.constant(sa)
+
+    # ---- reference side: the trainer's exact loop ----
+    for _ in range(n_epochs):
+        critic_ws, tr_ws = [], []
+        for node in range(N_AGENTS):
+            r_node = tf.constant(r[:, node])
+            x, _ = agents[node].TR_update_local(tsa, r_node)
+            y, _ = agents[node].critic_update_local(ts, tns, r_node)
+            tr_ws.append(x)
+            critic_ws.append(y)
+        for node in range(N_AGENTS):
+            c_in = [critic_ws[i] for i in in_nodes[node]]
+            t_in = [tr_ws[i] for i in in_nodes[node]]
+            agents[node].resilient_consensus_critic_hidden(c_in)
+            agents[node].resilient_consensus_TR_hidden(t_in)
+            c_agg = agents[node].resilient_consensus_critic(ts, c_in)
+            t_agg = agents[node].resilient_consensus_TR(tsa, t_in)
+            agents[node].critic_update_team(ts, c_agg)
+            agents[node].TR_update_team(tsa, t_agg)
+    fs, fns, fsa = s[-B_fresh:], ns[-B_fresh:], sa[-B_fresh:]
+    for node in range(N_AGENTS):
+        agents[node].actor_update(
+            tf.constant(fs),
+            tf.constant(fns),
+            tf.constant(fsa),
+            tf.constant(a[-B_fresh:, node]),
+        )
+
+    # ---- our side: one fused block over the pre-loop weights ----
+    stack = lambda ws: _stack_msgs([_to_params(w) for w in ws])
+    actor0 = stack([w[0] for w in init_ws])
+    critic0 = stack([w[1] for w in init_ws])
+    tr0 = stack([w[2] for w in init_ws])
+    params = init_agent_params(jax.random.PRNGKey(0), cfg)._replace(
+        actor=actor0, critic=critic0, tr=tr0, critic_local=critic0
+    )
+    params = params._replace(actor_opt=jax.vmap(adam_init)(params.actor))
+
+    mk = lambda lo: Batch(
+        s=jnp.asarray(s[lo:]),
+        ns=jnp.asarray(ns[lo:]),
+        a=jnp.asarray(a[lo:]),
+        r=jnp.asarray(r[lo:]),
+        mask=jnp.ones((B - lo,), jnp.float32),
+    )
+    out = update_block(cfg, params, mk(0), mk(B - B_fresh), jax.random.PRNGKey(1))
+
+    for node in range(N_AGENTS):
+        for ref_a, my_a in zip(
+            agents[node].critic.get_weights(),
+            _to_keras(jax.tree.map(lambda l: l[node], out.critic)),
+        ):
+            np.testing.assert_allclose(my_a, ref_a, rtol=2e-3, atol=2e-5)
+        for ref_a, my_a in zip(
+            agents[node].TR.get_weights(),
+            _to_keras(jax.tree.map(lambda l: l[node], out.tr)),
+        ):
+            np.testing.assert_allclose(my_a, ref_a, rtol=2e-3, atol=2e-5)
+        for ref_a, my_a in zip(
+            agents[node].actor.get_weights(),
+            _to_keras(jax.tree.map(lambda l: l[node], out.actor)),
+        ):
+            np.testing.assert_allclose(my_a, ref_a, rtol=2e-3, atol=2e-5)
 
 
 def test_coop_actor_update_golden():
