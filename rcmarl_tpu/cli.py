@@ -459,6 +459,14 @@ BENCH_CONFIGS = {
 }
 
 
+def _emit(line: str, out_path: str | None, *, err: bool = False) -> None:
+    """Print one JSONL row and append it to the artifact file, if any."""
+    print(line, file=sys.stderr if err else sys.stdout)
+    if out_path:
+        with open(out_path, "a") as f:
+            f.write(line + "\n")
+
+
 def _bench_config(name: str, impl: str, n_ep_fixed: int) -> Config:
     spec = BENCH_CONFIGS[name]
     n = spec["n_agents"]
@@ -587,10 +595,7 @@ def cmd_bench(argv) -> int:
                             "error": f"{type(e).__name__}: {e}"[:300],
                         }
                     )
-                    print(err, file=sys.stderr)
-                    if args.out:
-                        with open(args.out, "a") as f:
-                            f.write(err + "\n")
+                    _emit(err, args.out, err=True)
                     n_failed += 1
                     continue
                 steps = args.blocks * cfg.block_steps
@@ -621,13 +626,99 @@ def cmd_bench(argv) -> int:
                         "timestamp": datetime.now().isoformat(timespec="seconds"),
                     }
                 )
-                print(row)
-                if args.out:
-                    with open(args.out, "a") as f:
-                        f.write(row + "\n")
+                _emit(row, args.out)
     # Completed rows are already flushed; a nonzero rc signals that some
     # cells failed so drivers judging by exit code don't record a clean
     # benchmark over missing measurements.
+    return 1 if n_failed else 0
+
+
+# --------------------------------------------------------------------------
+# profile
+# --------------------------------------------------------------------------
+
+
+def cmd_profile(argv) -> int:
+    p = argparse.ArgumentParser(
+        prog="rcmarl_tpu profile",
+        description="Per-phase timing breakdown of the training block "
+        "(utils/profiling.py) over BASELINE.json's config matrix — the "
+        "regenerable artifact behind PERF.md",
+    )
+    p.add_argument(
+        "--configs",
+        nargs="+",
+        default=["ref5_ring"],
+        choices=list(BENCH_CONFIGS),
+    )
+    p.add_argument(
+        "--impl",
+        nargs="+",
+        default=["xla"],
+        choices=list(CONSENSUS_IMPLS),
+    )
+    p.add_argument("--n_ep_fixed", type=int, default=10)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument(
+        "--out",
+        type=str,
+        default=None,
+        help="append each breakdown as a JSON line to this file",
+    )
+    args = p.parse_args(argv)
+    if args.reps < 1 or args.n_ep_fixed < 1:
+        raise SystemExit("--reps and --n_ep_fixed must be >= 1")
+
+    import jax
+
+    from rcmarl_tpu.utils.profiling import profile_phases
+
+    n_failed = 0
+    for name in args.configs:
+        for impl in args.impl:
+            cfg = _bench_config(name, impl, args.n_ep_fixed)
+            try:
+                phases = profile_phases(cfg, reps=args.reps)
+            except Exception as e:  # noqa: BLE001 — same fault isolation as bench
+                err = json.dumps(
+                    {"config": name, "impl": impl, "error": f"{type(e).__name__}: {e}"[:300]}
+                )
+                _emit(err, args.out, err=True)
+                n_failed += 1
+                continue
+            # The un-fused sub-programs (utils/profiling.py) vs the fused
+            # production block. full_block additionally contains the buffer
+            # push, so fusion_speedup slightly UNDERSTATES the pure
+            # fusion/dispatch savings — a conservative lower bound.
+            unfused = (
+                phases["rollout_block"]
+                + cfg.n_epochs * phases["critic_tr_epoch"]
+                + phases["actor_phase"]
+            )
+            row = json.dumps(
+                {
+                    "config": name,
+                    "impl": impl,
+                    "n_agents": cfg.n_agents,
+                    "hidden": list(cfg.hidden),
+                    "H": cfg.H,
+                    "ms": {k: round(v * 1e3, 3) for k, v in phases.items()},
+                    "ms_epochs_total": round(
+                        cfg.n_epochs * phases["critic_tr_epoch"] * 1e3, 3
+                    ),
+                    "ms_unfused_sum": round(unfused * 1e3, 3),
+                    "fusion_speedup": round(unfused / phases["full_block"], 3),
+                    "workload": {
+                        "n_ep_fixed": args.n_ep_fixed,
+                        "reps": args.reps,
+                        "n_epochs": cfg.n_epochs,
+                        "block_steps": cfg.block_steps,
+                    },
+                    "platform": jax.devices()[0].platform,
+                    "timestamp": datetime.now().isoformat(timespec="seconds"),
+                }
+            )
+            _emit(row, args.out)
     return 1 if n_failed else 0
 
 
@@ -874,6 +965,7 @@ def main(argv=None) -> int:
         "sweep": cmd_sweep,
         "plot": cmd_plot,
         "bench": cmd_bench,
+        "profile": cmd_profile,
         "parity": cmd_parity,
     }
     if not argv or argv[0] in ("-h", "--help"):

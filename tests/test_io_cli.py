@@ -219,6 +219,23 @@ class TestCLI:
         assert rows[0]["env_steps_per_sec"] > 0
 
     @pytest.mark.slow
+    def test_profile_reports_phase_breakdown(self, tmp_path, capsys):
+        import json as _json
+
+        out = tmp_path / "perf.jsonl"
+        assert main([
+            "profile", "--configs", "ref5_ring", "--impl", "xla",
+            "--n_ep_fixed", "2", "--reps", "1", "--out", str(out),
+        ]) == 0
+        row = _json.loads(capsys.readouterr().out.strip().splitlines()[0])
+        assert set(row["ms"]) == {
+            "rollout_block", "critic_tr_epoch", "actor_phase", "full_block",
+        }
+        assert all(v > 0 for v in row["ms"].values())
+        # the appended artifact parses back to the same row
+        assert _json.loads(out.read_text().strip()) == row
+
+    @pytest.mark.slow
     def test_sweep_plot_summary(self, tmp_path, capsys):
         raw = tmp_path / "raw_data"
         assert main([
