@@ -174,7 +174,7 @@ def main() -> int:
         # that OOMs or hangs must not cost the others' results. The first
         # (smallest) candidate is the proven-safe round-2 workload.
         candidates = []
-        for n_seeds in (32, 128):
+        for n_seeds in (32, 128, 512):
             res = _run_child(
                 ["--child", "--seeds", str(n_seeds), "--blocks", "10",
                  "--reps", "3"],

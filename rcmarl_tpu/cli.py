@@ -536,6 +536,7 @@ def cmd_bench(argv) -> int:
 
     import jax
 
+    from rcmarl_tpu.ops.aggregation import resolve_impl
     from rcmarl_tpu.training.trainer import init_train_state, train_scanned
     from rcmarl_tpu.utils.profiling import Timer
 
@@ -603,6 +604,7 @@ def cmd_bench(argv) -> int:
                     {
                         "config": name,
                         "impl": impl,
+                        "impl_resolved": resolve_impl(impl, cfg.n_in),
                         "n_agents": cfg.n_agents,
                         "n_in": cfg.n_in,
                         "hidden": list(cfg.hidden),
@@ -671,6 +673,7 @@ def cmd_profile(argv) -> int:
 
     import jax
 
+    from rcmarl_tpu.ops.aggregation import resolve_impl
     from rcmarl_tpu.utils.profiling import profile_phases
 
     n_failed = 0
@@ -699,6 +702,7 @@ def cmd_profile(argv) -> int:
                 {
                     "config": name,
                     "impl": impl,
+                    "impl_resolved": resolve_impl(impl, cfg.n_in),
                     "n_agents": cfg.n_agents,
                     "hidden": list(cfg.hidden),
                     "H": cfg.H,

@@ -19,7 +19,7 @@ from typing import Tuple
 
 
 #: Valid consensus aggregation backends (see ops/aggregation.py).
-CONSENSUS_IMPLS = ("xla", "pallas", "pallas_interpret")
+CONSENSUS_IMPLS = ("xla", "pallas", "pallas_interpret", "auto")
 
 
 class Roles:
@@ -120,6 +120,8 @@ class Config:
     # 'pallas': fused VMEM-resident kernel (ops/pallas_aggregation.py),
     # for large-N/large-model scale-out on TPU.
     # 'pallas_interpret': pallas in interpreter mode (CPU tests only).
+    # 'auto': measured-crossover choice — pallas on TPU from n_in >= 16
+    # up, xla otherwise (ops/aggregation.py:resolve_impl, BENCH_SCALING.md).
     consensus_impl: str = "xla"
 
     def __post_init__(self):

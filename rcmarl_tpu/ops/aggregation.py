@@ -30,6 +30,14 @@ import jax.numpy as jnp
 from rcmarl_tpu.config import CONSENSUS_IMPLS
 
 
+#: Measured TPU crossover (BENCH_SCALING.jsonl, v5e): XLA's fused sort
+#: wins at reference-scale neighborhoods (n_in 4-5, ~1.7x faster), the
+#: fused Pallas kernel overtakes it once the gathered block grows
+#: (n_in=16 full graph: pallas 1.09x faster, and the margin is projected
+#: to widen with n_in and parameter volume — ops/pallas_aggregation.py).
+PALLAS_CROSSOVER_N_IN = 16
+
+
 def _check_impl(impl: str) -> None:
     """Reject unknown impl strings up front: anything not in
     CONSENSUS_IMPLS would otherwise be routed to the Pallas kernel with
@@ -38,6 +46,28 @@ def _check_impl(impl: str) -> None:
         raise ValueError(
             f"unknown consensus impl {impl!r}; expected one of {CONSENSUS_IMPLS}"
         )
+
+
+def resolve_impl(impl: str, n_in: int, dtype=None) -> str:
+    """Resolve ``'auto'`` to a concrete implementation at trace time.
+
+    ``'auto'`` picks the Pallas kernel exactly where hardware
+    measurement says it wins — on a TPU backend with a neighborhood of
+    at least :data:`PALLAS_CROSSOVER_N_IN` — and the XLA sort everywhere
+    else: small neighborhoods, CPU/interpreter platforms where the
+    kernel cannot lower, and f64 inputs (the kernel computes in f32, a
+    silent precision loss the XLA path doesn't have — see
+    ``fused_resilient_aggregate``). Concrete impl strings pass through
+    unchanged, so explicit choices always stick.
+    """
+    _check_impl(impl)
+    if impl != "auto":
+        return impl
+    if dtype is not None and jnp.dtype(dtype) == jnp.float64:
+        return "xla"
+    if jax.default_backend() == "tpu" and n_in >= PALLAS_CROSSOVER_N_IN:
+        return "pallas"
+    return "xla"
 
 
 def resilient_aggregate(
@@ -52,7 +82,8 @@ def resilient_aggregate(
       values: (n_in, ...) stacked neighbor values, own value at index 0.
       H: max number of adversaries tolerated in the neighborhood (static).
       impl: 'xla' (default), 'pallas' (fused TPU kernel,
-        :mod:`rcmarl_tpu.ops.pallas_aggregation`), or 'pallas_interpret'.
+        :mod:`rcmarl_tpu.ops.pallas_aggregation`), 'pallas_interpret',
+        or 'auto' (measured-crossover choice, :func:`resolve_impl`).
       valid: optional (n_in,) edge-validity mask for heterogeneous
         in-degree graphs (reference ``main.py:28`` accepts arbitrary
         adjacency lists): neighborhoods are padded to the graph's max
@@ -65,7 +96,7 @@ def resilient_aggregate(
     Returns:
       (...) aggregated values.
     """
-    _check_impl(impl)
+    impl = resolve_impl(impl, values.shape[0], values.dtype)
     if valid is not None:
         return _masked_aggregate(values, H, valid)
     if impl != "xla":
@@ -133,7 +164,11 @@ def resilient_aggregate_tree(
     flattened into ONE fused kernel launch instead of one sort per leaf.
     ``valid`` masks padded neighbor slots (see :func:`resilient_aggregate`;
     masked trees take the XLA path)."""
-    _check_impl(impl)
+    leaves = jax.tree.leaves(tree)
+    if not leaves:  # e.g. the trunk tree of a head-only (hidden=()) net
+        _check_impl(impl)
+        return tree
+    impl = resolve_impl(impl, leaves[0].shape[0], leaves[0].dtype)
     if valid is not None:
         return jax.tree.map(lambda v: _masked_aggregate(v, H, valid), tree)
     if impl != "xla":

@@ -203,3 +203,53 @@ def test_unknown_impl_rejected():
         resilient_aggregate(vals, H=1, impl="Pallas")
     with pytest.raises(ValueError, match="unknown consensus impl"):
         resilient_aggregate_tree({"w": vals}, H=1, impl="palas")
+
+
+class TestAutoImpl:
+    """'auto' = the measured-crossover choice (BENCH_SCALING.jsonl)."""
+
+    def test_resolution_rules(self, monkeypatch):
+        from rcmarl_tpu.ops import aggregation as agg
+
+        # non-TPU backend: always the XLA sort, any neighborhood size
+        monkeypatch.setattr(agg.jax, "default_backend", lambda: "cpu")
+        assert agg.resolve_impl("auto", 4) == "xla"
+        assert agg.resolve_impl("auto", 64) == "xla"
+        # TPU backend: pallas from the measured crossover up
+        monkeypatch.setattr(agg.jax, "default_backend", lambda: "tpu")
+        assert agg.resolve_impl("auto", agg.PALLAS_CROSSOVER_N_IN - 1) == "xla"
+        assert agg.resolve_impl("auto", agg.PALLAS_CROSSOVER_N_IN) == "pallas"
+        # f64 never routes to the f32-computing kernel
+        assert agg.resolve_impl("auto", 64, np.float64) == "xla"
+        # explicit impls pass through untouched on every backend
+        assert agg.resolve_impl("xla", 64) == "xla"
+        assert agg.resolve_impl("pallas", 4) == "pallas"
+
+    def test_auto_matches_xla_on_cpu(self):
+        vals = jnp.asarray(np.random.default_rng(0).normal(size=(5, 3, 7)))
+        np.testing.assert_allclose(
+            np.asarray(resilient_aggregate(vals, H=1, impl="auto")),
+            np.asarray(resilient_aggregate(vals, H=1, impl="xla")),
+            rtol=1e-12,
+        )
+
+    def test_auto_trains_end_to_end(self):
+        from rcmarl_tpu.config import Config
+        from rcmarl_tpu.training.trainer import init_train_state, train_block
+
+        cfg = Config(
+            n_agents=3,
+            agent_roles=(0, 0, 0),
+            in_nodes=((0, 1, 2), (1, 2, 0), (2, 0, 1)),
+            n_episodes=2,
+            max_ep_len=4,
+            n_ep_fixed=2,
+            n_epochs=1,
+            buffer_size=16,
+            batch_size=4,
+            H=1,
+            consensus_impl="auto",
+        )
+        state = init_train_state(cfg, jax.random.PRNGKey(0))
+        state, metrics = train_block(cfg, state)
+        assert np.isfinite(np.asarray(metrics.true_team_returns)).all()
