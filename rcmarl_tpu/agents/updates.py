@@ -42,6 +42,7 @@ from rcmarl_tpu.config import Config, Roles
 from rcmarl_tpu.models.mlp import (
     MLPParams,
     actor_probs,
+    einsum,
     head_forward,
     mlp_forward,
     trunk_forward,
@@ -100,11 +101,11 @@ def coop_local_critic_fit(
     (resilient_CAC_agents.py:103-122): TD target computed ONCE with
     current weights, then ``coop_fit_steps`` full-batch SGD steps; the
     caller keeps the agent's own critic unchanged (restore semantics)."""
-    target = r + cfg.gamma * mlp_forward(critic, ns)
+    target = r + cfg.gamma * mlp_forward(critic, ns, dtype=cfg.dot_dtype)
     target = jax.lax.stop_gradient(target)
 
     def loss(p):
-        return weighted_mse(mlp_forward(p, s), target, mask=mask)
+        return weighted_mse(mlp_forward(p, s, dtype=cfg.dot_dtype), target, mask=mask)
 
     msg, _ = fit_full_batch(critic, loss, cfg.coop_fit_steps, cfg.fast_lr)
     return msg
@@ -115,7 +116,7 @@ def coop_local_tr_fit(tr: MLPParams, sa, r, mask, cfg: Config) -> MLPParams:
     same 5-step full-batch SGD, target = local reward (no bootstrap)."""
 
     def loss(p):
-        return weighted_mse(mlp_forward(p, sa), r, mask=mask)
+        return weighted_mse(mlp_forward(p, sa, dtype=cfg.dot_dtype), r, mask=mask)
 
     msg, _ = fit_full_batch(tr, loss, cfg.coop_fit_steps, cfg.fast_lr)
     return msg
@@ -128,11 +129,11 @@ def adv_critic_fit(
     TD target with pre-fit weights, then fit(epochs=10, batch_size=32)
     shuffled minibatch SGD (adversarial_CAC_agents.py:131-133,146-151,
     237-239). The update PERSISTS (no restore)."""
-    target = r_target + cfg.gamma * mlp_forward(critic, ns)
+    target = r_target + cfg.gamma * mlp_forward(critic, ns, dtype=cfg.dot_dtype)
     target = jax.lax.stop_gradient(target)
 
     def batch_loss(p, idx, bval):
-        return weighted_mse(mlp_forward(p, s[idx]), target[idx], mask=bval)
+        return weighted_mse(mlp_forward(p, s[idx], dtype=cfg.dot_dtype), target[idx], mask=bval)
 
     out, _, _ = fit_minibatch(
         key,
@@ -153,7 +154,7 @@ def adv_tr_fit(key, tr: MLPParams, sa, r_target, mask, cfg: Config) -> MLPParams
     243-253)."""
 
     def batch_loss(p, idx, bval):
-        return weighted_mse(mlp_forward(p, sa[idx]), r_target[idx], mask=bval)
+        return weighted_mse(mlp_forward(p, sa[idx], dtype=cfg.dot_dtype), r_target[idx], mask=bval)
 
     out, _, _ = fit_minibatch(
         key,
@@ -214,14 +215,10 @@ def consensus_update_one(
     )
     new_params: MLPParams = tuple(trunk_agg) + (own[-1],)
     # c) projection: phi with aggregated trunk, all neighbor heads at once
-    phi = trunk_forward(new_params, x, cfg.leaky_alpha)  # (B, h)
+    phi = trunk_forward(new_params, x, cfg.leaky_alpha, cfg.dot_dtype)  # (B, h)
     W_nbr, b_nbr = nbr_msgs[-1]  # (n_in, h, 1), (n_in, 1)
-    vals = (
-        jnp.einsum(
-            "bh,nho->nbo", phi, W_nbr, precision=jax.lax.Precision.HIGHEST
-        )
-        + b_nbr[:, None, :]
-    )  # (n_in, B, 1)
+    proj = einsum("bh,nho->nbo", phi, W_nbr, dtype=cfg.dot_dtype)
+    vals = proj + b_nbr[:, None, :]  # (n_in, B, 1)
     agg = resilient_aggregate(vals, cfg.H, cfg.consensus_impl, valid=valid)  # (B, 1)
     agg = jax.lax.stop_gradient(agg)
     # d) normalized team update of the head only
@@ -230,7 +227,7 @@ def consensus_update_one(
     weights = 1.0 / (2.0 * cfg.fast_lr * phi_norm)
 
     def head_loss(head_params):
-        pred = head_forward(head_params, phi_sg)
+        pred = head_forward(head_params, phi_sg, cfg.dot_dtype)
         return weighted_mse(pred, agg, sample_weight=weights, mask=mask)
 
     g = jax.grad(head_loss)(new_params[-1])
@@ -259,12 +256,16 @@ def coop_actor_update(
     post-consensus), ONE full-batch Adam step of weighted sparse CE over
     the fresh on-policy window (always fully valid)."""
     delta = (
-        mlp_forward(tr, sa) + cfg.gamma * mlp_forward(critic, ns) - mlp_forward(critic, s)
+        mlp_forward(tr, sa, dtype=cfg.dot_dtype)
+        + cfg.gamma * mlp_forward(critic, ns, dtype=cfg.dot_dtype)
+        - mlp_forward(critic, s, dtype=cfg.dot_dtype)
     )
     delta = jax.lax.stop_gradient(delta[:, 0])  # (B,)
 
     def loss(p):
-        return weighted_sparse_ce(actor_probs(p, s, cfg.leaky_alpha), a_own, delta)
+        return weighted_sparse_ce(
+            actor_probs(p, s, cfg.leaky_alpha, cfg.dot_dtype), a_own, delta
+        )
 
     g = jax.grad(loss)(actor)
     return adam_update(actor, g, opt, cfg.slow_lr)
@@ -285,14 +286,19 @@ def adv_actor_update(
     211-226): sample weights = LOCAL TD error from own reward and own
     critic (malicious: its private local critic), then
     fit(batch_size=200, epochs=1) = shuffled minibatch Adam steps."""
-    delta = r_own + cfg.gamma * mlp_forward(critic, ns) - mlp_forward(critic, s)
+    delta = (
+        r_own
+        + cfg.gamma * mlp_forward(critic, ns, dtype=cfg.dot_dtype)
+        - mlp_forward(critic, s, dtype=cfg.dot_dtype)
+    )
     delta = jax.lax.stop_gradient(delta[:, 0])  # (B,)
     B = s.shape[0]
     mask = jnp.ones((B,), jnp.float32)
 
     def batch_loss(p, idx, bval):
         return weighted_sparse_ce(
-            actor_probs(p, s[idx], cfg.leaky_alpha), a_own[idx], delta[idx], mask=bval
+            actor_probs(p, s[idx], cfg.leaky_alpha, cfg.dot_dtype),
+            a_own[idx], delta[idx], mask=bval,
         )
 
     new_actor, new_opt, _ = fit_minibatch(

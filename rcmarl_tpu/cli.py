@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import contextlib
 import dataclasses
+import itertools
 import json
 import os
 import sys
@@ -113,6 +114,14 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         choices=list(CONSENSUS_IMPLS),
         help="consensus aggregation backend (pallas = fused TPU kernel)",
     )
+    p.add_argument(
+        "--compute_dtype",
+        type=str,
+        default="float32",
+        choices=["float32", "bfloat16"],
+        help="matmul compute precision: float32 = reference-parity, "
+        "bfloat16 = MXU-native inputs with f32 accumulation (scale-out)",
+    )
 
 
 def config_from_args(args) -> Config:
@@ -164,6 +173,7 @@ def config_from_args(args) -> Config:
         reference_clip=args.reference_clip,
         seed=getattr(args, "random_seed", 300),
         consensus_impl=args.consensus_impl,
+        compute_dtype=args.compute_dtype,
     )
 
 
@@ -467,7 +477,9 @@ def _emit(line: str, out_path: str | None, *, err: bool = False) -> None:
             f.write(line + "\n")
 
 
-def _bench_config(name: str, impl: str, n_ep_fixed: int) -> Config:
+def _bench_config(
+    name: str, impl: str, n_ep_fixed: int, compute_dtype: str = "float32"
+) -> Config:
     spec = BENCH_CONFIGS[name]
     n = spec["n_agents"]
     side = max(3, int(round(math.sqrt(n))))  # BASELINE: sqrt(N) x sqrt(N) grid
@@ -487,6 +499,7 @@ def _bench_config(name: str, impl: str, n_ep_fixed: int) -> Config:
         n_ep_fixed=n_ep_fixed,
         slow_lr=0.002,
         consensus_impl=impl,
+        compute_dtype=compute_dtype,
     )
 
 
@@ -524,6 +537,14 @@ def cmd_bench(argv) -> int:
         "single-device path, no mesh.",
     )
     p.add_argument(
+        "--compute_dtype",
+        nargs="+",
+        default=["float32"],
+        choices=["float32", "bfloat16"],
+        help="matmul compute precision(s) to compare (bfloat16 = "
+        "MXU-native inputs, f32 accumulation)",
+    )
+    p.add_argument(
         "--out",
         type=str,
         default=None,
@@ -542,93 +563,95 @@ def cmd_bench(argv) -> int:
 
     shard_modes = [None] if args.shard_agents is None else args.shard_agents
     n_failed = 0
-    for name in args.configs:
-        for impl in args.impl:
-            for shard in shard_modes:
-                cfg = _bench_config(name, impl, args.n_ep_fixed)
-                if shard is None:
-                    state = init_train_state(cfg, jax.random.PRNGKey(0))
-                    run = jax.jit(
-                        lambda s, cfg=cfg: train_scanned(cfg, s, args.blocks)
-                    )
-                else:
-                    from rcmarl_tpu.parallel.seeds import make_mesh, train_parallel
+    for name, dtype, impl, shard in itertools.product(
+        args.configs, args.compute_dtype, args.impl, shard_modes
+    ):
+        cfg = _bench_config(name, impl, args.n_ep_fixed, dtype)
+        if shard is None:
+            state = init_train_state(cfg, jax.random.PRNGKey(0))
+            run = jax.jit(
+                lambda s, cfg=cfg: train_scanned(cfg, s, args.blocks)
+            )
+        else:
+            from rcmarl_tpu.parallel.seeds import make_mesh, train_parallel
 
-                    mesh = make_mesh(seed_axis=1)
-                    if shard and cfg.n_agents % mesh.shape["agent"] != 0:
-                        print(
-                            f"# skip {name} shard_agents=1: {cfg.n_agents} "
-                            f"agents do not tile over {mesh.shape['agent']} "
-                            "devices",
-                            file=sys.stderr,
-                        )
-                        continue
-                    state = None
-
-                    def run(s, cfg=cfg, mesh=mesh, shard=shard):
-                        st, metrics = train_parallel(
-                            cfg,
-                            seeds=[0] if s is None else None,
-                            states=s,
-                            n_blocks=args.blocks,
-                            mesh=mesh,
-                            shard_agents=bool(shard),
-                        )
-                        return st, metrics
-
-                try:
-                    state, metrics = run(state)  # compile + warm
-                    jax.device_get(metrics.true_team_returns)
-                    best = float("inf")
-                    for _ in range(args.reps):
-                        t = Timer().start()
-                        state, metrics = run(state)
-                        best = min(best, t.stop(metrics.true_team_returns))
-                except Exception as e:  # noqa: BLE001
-                    # One cell must not cost the rest of the matrix (e.g.
-                    # a pallas lowering failure on new hardware while the
-                    # xla rows are still to come). Record it and move on.
-                    err = json.dumps(
-                        {
-                            "config": name,
-                            "impl": impl,
-                            **({} if shard is None else {"shard_agents": bool(shard)}),
-                            "error": f"{type(e).__name__}: {e}"[:300],
-                        }
-                    )
-                    _emit(err, args.out, err=True)
-                    n_failed += 1
-                    continue
-                steps = args.blocks * cfg.block_steps
-                row = json.dumps(
-                    {
-                        "config": name,
-                        "impl": impl,
-                        "impl_resolved": resolve_impl(impl, cfg.n_in),
-                        "n_agents": cfg.n_agents,
-                        "n_in": cfg.n_in,
-                        "hidden": list(cfg.hidden),
-                        "H": cfg.H,
-                        **(
-                            {}
-                            if shard is None
-                            else {
-                                "shard_agents": bool(shard),
-                                "mesh_devices": len(jax.devices()),
-                            }
-                        ),
-                        "env_steps_per_sec": round(steps / best, 1),
-                        "sec_per_block": round(best / args.blocks, 4),
-                        "workload": {
-                            "blocks": args.blocks,
-                            "reps": args.reps,
-                            "block_steps": cfg.block_steps,
-                        },
-                        "platform": jax.devices()[0].platform,
-                        "timestamp": datetime.now().isoformat(timespec="seconds"),
-                    }
+            mesh = make_mesh(seed_axis=1)
+            if shard and cfg.n_agents % mesh.shape["agent"] != 0:
+                print(
+                    f"# skip {name} shard_agents=1: {cfg.n_agents} "
+                    f"agents do not tile over {mesh.shape['agent']} "
+                    "devices",
+                    file=sys.stderr,
                 )
-                _emit(row, args.out)
+                continue
+            state = None
+
+            def run(s, cfg=cfg, mesh=mesh, shard=shard):
+                st, metrics = train_parallel(
+                    cfg,
+                    seeds=[0] if s is None else None,
+                    states=s,
+                    n_blocks=args.blocks,
+                    mesh=mesh,
+                    shard_agents=bool(shard),
+                )
+                return st, metrics
+
+        try:
+            state, metrics = run(state)  # compile + warm
+            jax.device_get(metrics.true_team_returns)
+            best = float("inf")
+            for _ in range(args.reps):
+                t = Timer().start()
+                state, metrics = run(state)
+                best = min(best, t.stop(metrics.true_team_returns))
+        except Exception as e:  # noqa: BLE001
+            # One cell must not cost the rest of the matrix (e.g.
+            # a pallas lowering failure on new hardware while the
+            # xla rows are still to come). Record it and move on.
+            err = json.dumps(
+                {
+                    "config": name,
+                    "impl": impl,
+                    "compute_dtype": dtype,
+                    **({} if shard is None else {"shard_agents": bool(shard)}),
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+            )
+            _emit(err, args.out, err=True)
+            n_failed += 1
+            continue
+        steps = args.blocks * cfg.block_steps
+        row = json.dumps(
+            {
+                "config": name,
+                "impl": impl,
+                "impl_resolved": resolve_impl(impl, cfg.n_in),
+                "compute_dtype": cfg.compute_dtype,
+                "n_agents": cfg.n_agents,
+                "n_in": cfg.n_in,
+                "hidden": list(cfg.hidden),
+                "H": cfg.H,
+                **(
+                    {}
+                    if shard is None
+                    else {
+                        "shard_agents": bool(shard),
+                        "mesh_devices": len(jax.devices()),
+                    }
+                ),
+                "env_steps_per_sec": round(steps / best, 1),
+                "sec_per_block": round(best / args.blocks, 4),
+                "workload": {
+                    "blocks": args.blocks,
+                    "reps": args.reps,
+                    "block_steps": cfg.block_steps,
+                },
+                "platform": jax.devices()[0].platform,
+                "timestamp": datetime.now().isoformat(timespec="seconds"),
+            }
+        )
+        _emit(row, args.out)
     # Completed rows are already flushed; a nonzero rc signals that some
     # cells failed so drivers judging by exit code don't record a clean
     # benchmark over missing measurements.
@@ -659,6 +682,13 @@ def cmd_profile(argv) -> int:
         default=["xla"],
         choices=list(CONSENSUS_IMPLS),
     )
+    p.add_argument(
+        "--compute_dtype",
+        nargs="+",
+        default=["float32"],
+        choices=["float32", "bfloat16"],
+        help="matmul compute precision(s) to profile",
+    )
     p.add_argument("--n_ep_fixed", type=int, default=10)
     p.add_argument("--reps", type=int, default=3)
     p.add_argument(
@@ -677,52 +707,59 @@ def cmd_profile(argv) -> int:
     from rcmarl_tpu.utils.profiling import profile_phases
 
     n_failed = 0
-    for name in args.configs:
-        for impl in args.impl:
-            cfg = _bench_config(name, impl, args.n_ep_fixed)
-            try:
-                phases = profile_phases(cfg, reps=args.reps)
-            except Exception as e:  # noqa: BLE001 — same fault isolation as bench
-                err = json.dumps(
-                    {"config": name, "impl": impl, "error": f"{type(e).__name__}: {e}"[:300]}
-                )
-                _emit(err, args.out, err=True)
-                n_failed += 1
-                continue
-            # The un-fused sub-programs (utils/profiling.py) vs the fused
-            # production block. full_block additionally contains the buffer
-            # push, so fusion_speedup slightly UNDERSTATES the pure
-            # fusion/dispatch savings — a conservative lower bound.
-            unfused = (
-                phases["rollout_block"]
-                + cfg.n_epochs * phases["critic_tr_epoch"]
-                + phases["actor_phase"]
-            )
-            row = json.dumps(
+    for name, dtype, impl in itertools.product(
+        args.configs, args.compute_dtype, args.impl
+    ):
+        cfg = _bench_config(name, impl, args.n_ep_fixed, dtype)
+        try:
+            phases = profile_phases(cfg, reps=args.reps)
+        except Exception as e:  # noqa: BLE001 — same fault isolation as bench
+            err = json.dumps(
                 {
                     "config": name,
                     "impl": impl,
-                    "impl_resolved": resolve_impl(impl, cfg.n_in),
-                    "n_agents": cfg.n_agents,
-                    "hidden": list(cfg.hidden),
-                    "H": cfg.H,
-                    "ms": {k: round(v * 1e3, 3) for k, v in phases.items()},
-                    "ms_epochs_total": round(
-                        cfg.n_epochs * phases["critic_tr_epoch"] * 1e3, 3
-                    ),
-                    "ms_unfused_sum": round(unfused * 1e3, 3),
-                    "fusion_speedup": round(unfused / phases["full_block"], 3),
-                    "workload": {
-                        "n_ep_fixed": args.n_ep_fixed,
-                        "reps": args.reps,
-                        "n_epochs": cfg.n_epochs,
-                        "block_steps": cfg.block_steps,
-                    },
-                    "platform": jax.devices()[0].platform,
-                    "timestamp": datetime.now().isoformat(timespec="seconds"),
+                    "compute_dtype": dtype,
+                    "error": f"{type(e).__name__}: {e}"[:300],
                 }
             )
-            _emit(row, args.out)
+            _emit(err, args.out, err=True)
+            n_failed += 1
+            continue
+        # The un-fused sub-programs (utils/profiling.py) vs the fused
+        # production block. full_block additionally contains the buffer
+        # push, so fusion_speedup slightly UNDERSTATES the pure
+        # fusion/dispatch savings — a conservative lower bound.
+        unfused = (
+            phases["rollout_block"]
+            + cfg.n_epochs * phases["critic_tr_epoch"]
+            + phases["actor_phase"]
+        )
+        row = json.dumps(
+            {
+                "config": name,
+                "impl": impl,
+                "impl_resolved": resolve_impl(impl, cfg.n_in),
+                "compute_dtype": cfg.compute_dtype,
+                "n_agents": cfg.n_agents,
+                "hidden": list(cfg.hidden),
+                "H": cfg.H,
+                "ms": {k: round(v * 1e3, 3) for k, v in phases.items()},
+                "ms_epochs_total": round(
+                    cfg.n_epochs * phases["critic_tr_epoch"] * 1e3, 3
+                ),
+                "ms_unfused_sum": round(unfused * 1e3, 3),
+                "fusion_speedup": round(unfused / phases["full_block"], 3),
+                "workload": {
+                    "n_ep_fixed": args.n_ep_fixed,
+                    "reps": args.reps,
+                    "n_epochs": cfg.n_epochs,
+                    "block_steps": cfg.block_steps,
+                },
+                "platform": jax.devices()[0].platform,
+                "timestamp": datetime.now().isoformat(timespec="seconds"),
+            }
+        )
+        _emit(row, args.out)
     return 1 if n_failed else 0
 
 

@@ -123,6 +123,13 @@ class Config:
     # 'auto': measured-crossover choice — pallas on TPU from n_in >= 16
     # up, xla otherwise (ops/aggregation.py:resolve_impl, BENCH_SCALING.md).
     consensus_impl: str = "xla"
+    # --- matmul compute precision ---
+    # 'float32' (default): true-fp32 dots, the reference-parity path.
+    # 'bfloat16': opt-in scale-out mode — matmul inputs in the MXU's
+    # native bf16, f32 accumulation; params/activations/optimizer stay
+    # f32 (models/mlp.py:dot). For the 256-wide BASELINE config, not for
+    # parity runs.
+    compute_dtype: str = "float32"
 
     def __post_init__(self):
         if len(self.agent_roles) != self.n_agents:
@@ -147,6 +154,11 @@ class Config:
             raise ValueError(
                 f"consensus_impl={self.consensus_impl!r}: expected one of "
                 f"{CONSENSUS_IMPLS}"
+            )
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"compute_dtype={self.compute_dtype!r}: expected "
+                "'float32' or 'bfloat16'"
             )
 
     # ---- derived (static) quantities ----
@@ -213,6 +225,14 @@ class Config:
             for nbrs in self.in_nodes
         )
         return in_arr, valid
+
+    @property
+    def dot_dtype(self) -> "str | None":
+        """Matmul compute dtype for :func:`rcmarl_tpu.models.mlp.dot`:
+        ``None`` = exact f32 (parity default), ``'bfloat16'`` = MXU-native
+        inputs with f32 accumulation (kept a string so Config stays
+        jax-free and hashable)."""
+        return "bfloat16" if self.compute_dtype == "bfloat16" else None
 
     @property
     def obs_dim(self) -> int:

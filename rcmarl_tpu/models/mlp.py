@@ -35,9 +35,35 @@ MLPParams = Tuple[Tuple[jnp.ndarray, jnp.ndarray], ...]
 PRECISION = jax.lax.Precision.HIGHEST
 
 
-def dot(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """fp32-precision matmul used for every model contraction."""
-    return jnp.matmul(a, b, precision=PRECISION)
+def dot(a: jnp.ndarray, b: jnp.ndarray, dtype=None) -> jnp.ndarray:
+    """Model contraction at the configured compute precision.
+
+    ``dtype=None`` (the default everywhere): true-fp32 matmul — the
+    reference-parity path. With ``dtype=jnp.bfloat16``
+    (``Config(compute_dtype='bfloat16')``, the opt-in scale-out mode for
+    the 256-wide BASELINE config): both operands are cast to bf16 — the
+    MXU's native input width — and accumulated in f32
+    (``preferred_element_type``), the standard mixed-precision recipe.
+    Parameters, activations, and optimizer state stay f32 either way;
+    only the matmul inputs narrow.
+    """
+    if dtype is None:
+        return jnp.matmul(a, b, precision=PRECISION)
+    return jnp.matmul(
+        a.astype(dtype), b.astype(dtype), preferred_element_type=jnp.float32
+    )
+
+
+def einsum(spec: str, *operands: jnp.ndarray, dtype=None) -> jnp.ndarray:
+    """General contraction under the same precision policy as :func:`dot`
+    (one place owns the mixed-precision recipe)."""
+    if dtype is None:
+        return jnp.einsum(spec, *operands, precision=PRECISION)
+    return jnp.einsum(
+        spec,
+        *(o.astype(dtype) for o in operands),
+        preferred_element_type=jnp.float32,
+    )
 
 
 def glorot_uniform(key: jax.Array, fan_in: int, fan_out: int) -> jnp.ndarray:
@@ -90,35 +116,42 @@ def flatten_input(x: jnp.ndarray) -> jnp.ndarray:
     return x.reshape(x.shape[0], -1)
 
 
-def trunk_forward(params: MLPParams, x: jnp.ndarray, alpha: float = 0.1) -> jnp.ndarray:
+def trunk_forward(
+    params: MLPParams, x: jnp.ndarray, alpha: float = 0.1, dtype=None
+) -> jnp.ndarray:
     """Features phi(x) after the last hidden layer (the reference's
     ``critic_features`` / ``TR_features`` sub-models).
 
     Args:
       params: single-agent MLP pytree (no agent axis).
       x: (batch, ...) input; flattened internally.
+      dtype: matmul compute dtype (see :func:`dot`).
     """
     h = flatten_input(x)
     for W, b in params[:-1]:
-        h = leaky_relu(dot(h, W) + b, alpha)
+        h = leaky_relu(dot(h, W, dtype) + b, alpha)
     return h
 
 
 def head_forward(
-    head_params: Tuple[jnp.ndarray, jnp.ndarray], phi: jnp.ndarray
+    head_params: Tuple[jnp.ndarray, jnp.ndarray], phi: jnp.ndarray, dtype=None
 ) -> jnp.ndarray:
     W, b = head_params
-    return dot(phi, W) + b
+    return dot(phi, W, dtype) + b
 
 
-def mlp_forward(params: MLPParams, x: jnp.ndarray, alpha: float = 0.1) -> jnp.ndarray:
+def mlp_forward(
+    params: MLPParams, x: jnp.ndarray, alpha: float = 0.1, dtype=None
+) -> jnp.ndarray:
     """Full forward pass -> (batch, out_dim) linear output."""
-    return head_forward(params[-1], trunk_forward(params, x, alpha))
+    return head_forward(params[-1], trunk_forward(params, x, alpha, dtype), dtype)
 
 
-def actor_probs(params: MLPParams, x: jnp.ndarray, alpha: float = 0.1) -> jnp.ndarray:
+def actor_probs(
+    params: MLPParams, x: jnp.ndarray, alpha: float = 0.1, dtype=None
+) -> jnp.ndarray:
     """Softmax policy probabilities (reference actor, ``main.py:65``)."""
-    return jax.nn.softmax(mlp_forward(params, x, alpha), axis=-1)
+    return jax.nn.softmax(mlp_forward(params, x, alpha, dtype), axis=-1)
 
 
 def agent_slice(params: MLPParams, i) -> MLPParams:

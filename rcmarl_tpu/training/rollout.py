@@ -50,7 +50,9 @@ def sample_actions(
     n = cfg.n_agents
     # (N, batch=1, N, n_states): same global state for every agent
     obs = jnp.broadcast_to(state_scaled[None], (n, *state_scaled.shape))[:, None]
-    probs = jax.vmap(lambda p, x: actor_probs(p, x, cfg.leaky_alpha))(actor, obs)
+    probs = jax.vmap(lambda p, x: actor_probs(p, x, cfg.leaky_alpha, cfg.dot_dtype))(
+        actor, obs
+    )
     k_pol, k_rand, k_mix = jax.random.split(key, 3)
     policy_a = jax.vmap(jax.random.categorical)(
         jax.random.split(k_pol, n), jnp.log(probs[:, 0, :])

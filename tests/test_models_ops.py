@@ -321,3 +321,68 @@ def test_fit_minibatch_with_adam_state_advances_once_per_real_batch():
         opt_update=lambda p, g, s: adam_update(p, g, s, lr=0.01),
     )
     assert int(state.count) == 6  # 2 batches x 3 epochs
+
+
+class TestBF16Compute:
+    """compute_dtype='bfloat16': MXU-native matmul inputs, f32 accumulation
+    (models/mlp.py:dot). Opt-in only — the f32 default stays golden-pinned
+    by the tests above."""
+
+    def test_dot_bf16_output_is_f32_and_close(self):
+        from rcmarl_tpu.models.mlp import dot
+
+        rng = np.random.default_rng(0)
+        a = jnp.asarray(rng.normal(size=(16, 32)).astype(np.float32))
+        b = jnp.asarray(rng.normal(size=(32, 8)).astype(np.float32))
+        out = dot(a, b, "bfloat16")
+        assert out.dtype == jnp.float32  # accumulation/output stays f32
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(dot(a, b)), rtol=2e-2, atol=2e-2
+        )
+
+    def test_forward_bf16_close_to_f32(self):
+        from rcmarl_tpu.models.mlp import init_mlp, mlp_forward
+
+        params = init_mlp(jax.random.PRNGKey(0), 10, (20, 20), 1)
+        x = jnp.asarray(
+            np.random.default_rng(1).normal(size=(32, 10)).astype(np.float32)
+        )
+        f32 = np.asarray(mlp_forward(params, x))
+        bf16 = np.asarray(mlp_forward(params, x, dtype="bfloat16"))
+        assert bf16.dtype == np.float32
+        np.testing.assert_allclose(bf16, f32, rtol=5e-2, atol=5e-2)
+
+    def test_config_rejects_unknown_dtype(self):
+        from rcmarl_tpu.config import Config
+
+        with pytest.raises(ValueError, match="compute_dtype"):
+            Config(compute_dtype="float16")
+
+    def test_bf16_trains_end_to_end(self):
+        from rcmarl_tpu.config import Config
+        from rcmarl_tpu.training.trainer import init_train_state, train_block
+
+        cfg = Config(
+            n_agents=3,
+            agent_roles=(0, 1, 3),  # include adversary branches
+            in_nodes=((0, 1, 2), (1, 2, 0), (2, 0, 1)),
+            n_episodes=2,
+            max_ep_len=4,
+            n_ep_fixed=2,
+            n_epochs=1,
+            buffer_size=16,
+            batch_size=4,
+            H=1,
+            compute_dtype="bfloat16",
+        )
+        state = init_train_state(cfg, jax.random.PRNGKey(0))
+        state, metrics = train_block(cfg, state)
+        # model weights stay f32 end-to-end (opt state holds an int count)
+        for tree in (
+            state.params.actor,
+            state.params.critic,
+            state.params.tr,
+            state.params.critic_local,
+        ):
+            assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(tree))
+        assert np.isfinite(np.asarray(metrics.true_team_returns)).all()
