@@ -76,6 +76,11 @@ def initialize(
     )
     if no_cluster_config and not auto:
         return  # single host, nothing to coordinate
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # CPU clusters (tests, laptops, CI) need an explicit cross-process
+        # collectives backend; gloo ships in jaxlib. Must be set before
+        # the backend initializes — i.e. exactly here.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

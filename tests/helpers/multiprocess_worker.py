@@ -1,0 +1,74 @@
+"""Worker process for the TRUE two-process distributed test.
+
+Launched (twice) by ``tests/test_distributed.py::test_true_two_process_
+training`` with the standard cluster env vars (JAX_COORDINATOR_ADDRESS /
+JAX_NUM_PROCESSES / JAX_PROCESS_ID) and 2 virtual CPU devices per
+process. Exercises the REAL multi-process path end-to-end — cluster
+join via :func:`rcmarl_tpu.parallel.initialize` (which selects the gloo
+CPU collectives backend), a cross-process ``multihost_mesh``, sharded
+``train_parallel``, and the ``gather_metrics`` DCN all-gather — the
+parts the in-process virtual-mesh tests cannot reach.
+
+Process 0 writes the gathered metrics to ``sys.argv[1]`` (.npz); the
+parent test compares them against a single-process run of the same
+config and seeds.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+#: Replica seeds, shared with the parent test's single-process reference.
+SEEDS = [5, 6, 7, 8]
+
+
+def worker_config():
+    """The one config BOTH the workers and the parent's single-process
+    reference run (imported by the test, so the two sides cannot drift).
+    Import is deferred so loading this module never touches jax."""
+    from rcmarl_tpu.config import Config
+
+    return Config(
+        n_agents=3,
+        agent_roles=(0, 0, 0),
+        in_nodes=((0, 1, 2), (1, 2, 0), (2, 0, 1)),
+        n_episodes=2,
+        max_ep_len=4,
+        n_ep_fixed=2,
+        n_epochs=1,
+        buffer_size=16,
+        batch_size=4,
+        H=1,
+    )
+
+
+def main() -> int:
+    out_path = sys.argv[1]
+
+    from rcmarl_tpu.parallel import (
+        gather_metrics,
+        initialize,
+        multihost_mesh,
+        train_parallel,
+    )
+
+    initialize()  # env-driven cluster join; must precede any device query
+
+    import jax
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 2 * jax.local_device_count()
+
+    cfg = worker_config()
+    mesh = multihost_mesh(agent_axis=1)  # (4, 1): seed axis spans processes
+    _, metrics = train_parallel(cfg, seeds=SEEDS, mesh=mesh, n_blocks=1)
+    gathered = gather_metrics(metrics)
+
+    if jax.process_index() == 0:
+        np.savez(out_path, **gathered._asdict())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

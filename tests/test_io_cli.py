@@ -219,6 +219,25 @@ class TestCLI:
         assert rows[0]["env_steps_per_sec"] > 0
 
     @pytest.mark.slow
+    def test_bench_dtype_axis_rows_self_describing(self, capsys):
+        import json as _json
+
+        assert main([
+            "bench", "--configs", "ref5_ring", "--impl", "xla",
+            "--compute_dtype", "float32", "bfloat16",
+            "--n_ep_fixed", "2", "--blocks", "1", "--reps", "1",
+        ]) == 0
+        rows = [
+            _json.loads(line)
+            for line in capsys.readouterr().out.strip().splitlines()
+        ]
+        assert [r["compute_dtype"] for r in rows] == ["float32", "bfloat16"]
+        assert all(
+            r["impl_resolved"] == "xla" and r["env_steps_per_sec"] > 0
+            for r in rows
+        )
+
+    @pytest.mark.slow
     def test_profile_reports_phase_breakdown(self, tmp_path, capsys):
         import json as _json
 
