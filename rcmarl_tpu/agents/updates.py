@@ -32,13 +32,12 @@ shapes stay static under jit (see ops/losses.py).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 
-from rcmarl_tpu.config import Config, Roles
+from rcmarl_tpu.config import Config
 from rcmarl_tpu.models.mlp import (
     MLPParams,
     actor_probs,

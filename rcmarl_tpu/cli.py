@@ -558,6 +558,7 @@ def cmd_bench(argv) -> int:
     import jax
 
     from rcmarl_tpu.ops.aggregation import resolve_impl
+    from rcmarl_tpu.parallel.seeds import make_mesh, train_parallel
     from rcmarl_tpu.training.trainer import init_train_state, train_scanned
     from rcmarl_tpu.utils.profiling import Timer
 
@@ -573,8 +574,6 @@ def cmd_bench(argv) -> int:
                 lambda s, cfg=cfg: train_scanned(cfg, s, args.blocks)
             )
         else:
-            from rcmarl_tpu.parallel.seeds import make_mesh, train_parallel
-
             mesh = make_mesh(seed_axis=1)
             if shard and cfg.n_agents % mesh.shape["agent"] != 0:
                 print(
