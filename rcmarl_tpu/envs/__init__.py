@@ -5,3 +5,4 @@ from rcmarl_tpu.envs.grid_world import (  # noqa: F401
     scale_state,
     scale_reward,
 )
+from rcmarl_tpu.envs.reference_api import ReferenceGridWorld  # noqa: F401

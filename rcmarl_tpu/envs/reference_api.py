@@ -1,0 +1,128 @@
+"""Drop-in twin of the reference's stateful environment object.
+
+The reference exposes a gym-style mutable object (``Grid_World`` at
+``environments/grid_world.py:5-75``) whose surface — ``reset()``,
+``step(action)``, ``get_data()``, ``close()`` and the ``state`` /
+``reward`` / ``desired_state`` attributes — user scripts drive directly
+(e.g. the reference's ``env_test.py:9-23``). This framework's native
+environment is the pure-functional :mod:`rcmarl_tpu.envs.grid_world`
+(one ``lax.scan``-able step for the whole team); this module wraps those
+same pure functions in the reference's object protocol so existing
+scripts migrate without rewrites.
+
+Fidelity notes:
+
+- ``reset`` draws from the GLOBAL NumPy RNG exactly like the reference
+  (``grid_world.py:41``), so a script that seeds ``np.random`` gets the
+  reference's layouts.
+- Dynamics route through :func:`rcmarl_tpu.envs.grid_world.env_step`
+  with ``reference_clip=True`` by default — bit-identical transitions
+  and rewards to the reference loop, including its both-axes-``nrow``
+  clip on non-square grids and the dead collision branch's observed
+  semantics. There is one deliberate divergence available: pass
+  ``collision_physics=True`` for the docstring-*intended* collision
+  rule the reference never executes.
+- ``get_data`` applies the reference's scaling contract: state
+  standardized only when ``scaling=True`` (mean/std of ``arange``),
+  reward ALWAYS divided by 5 (``grid_world.py:66-72``).
+
+No gym dependency: the reference only inherits ``gym.Env`` for the
+interface convention, which duck typing provides.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from rcmarl_tpu.envs.grid_world import GridWorld, env_step
+
+__all__ = ["ReferenceGridWorld"]
+
+
+class ReferenceGridWorld:
+    """Stateful reference-protocol shell over the functional grid world.
+
+    Constructor signature mirrors the reference ``Grid_World.__init__``
+    (``grid_world.py:19``): ``nrow, ncol, n_agents, desired_state,
+    initial_state, randomize_state, scaling``.
+    """
+
+    def __init__(
+        self,
+        nrow: int = 5,
+        ncol: int = 5,
+        n_agents: int = 1,
+        desired_state=None,
+        initial_state=None,
+        randomize_state: bool = True,
+        scaling: bool = False,
+        *,
+        collision_physics: bool = False,
+        reference_clip: bool = True,
+    ):
+        self.nrow = nrow
+        self.ncol = ncol
+        self.n_agents = n_agents
+        self.n_states = 2
+        self.desired_state = (
+            None if desired_state is None else np.asarray(desired_state)
+        )
+        self.initial_state = (
+            None if initial_state is None else np.asarray(initial_state)
+        )
+        self.randomize_state = randomize_state
+        self.scaling = scaling
+        self._env = GridWorld(
+            nrow=nrow,
+            ncol=ncol,
+            n_agents=n_agents,
+            scaling=scaling,
+            collision_physics=collision_physics,
+            reference_clip=reference_clip,
+        )
+        self.reset()
+
+    def reset(self) -> np.ndarray:
+        """Reference ``reset`` (``grid_world.py:37-45``): randomized
+        positions from the global NumPy stream, or the fixed
+        ``initial_state``; zero rewards."""
+        if self.randomize_state:
+            self.state = np.random.randint(
+                [0, 0], [self.nrow, self.ncol], size=(self.n_agents, self.n_states)
+            )
+        else:
+            self.state = np.array(self.initial_state)
+        self.reward = np.zeros(self.n_agents)
+        return self.state
+
+    def step(self, action) -> None:
+        """Reference ``step`` (``grid_world.py:47-64``): apply the global
+        action vector, update ``state`` and ``reward`` IN PLACE — scripts
+        may hold aliases to these arrays, exactly as with the reference
+        object (which writes ``state[node]``/``reward[node]`` per agent)."""
+        pos, rew = env_step(
+            self._env,
+            np.asarray(self.state, dtype=np.int32),
+            np.asarray(self.desired_state, dtype=np.int32),
+            np.asarray(action, dtype=np.int32),
+        )
+        self.state[...] = np.asarray(pos)
+        self.reward[...] = np.asarray(rew)
+
+    def get_data(self):
+        """Reference ``get_data`` (``grid_world.py:66-72``): standardized
+        state when ``scaling`` was requested, reward unconditionally /5.
+        Statistics in float64, matching the reference's NumPy-default
+        precision (``grid_world.py:31-33``)."""
+        if self.scaling:
+            x, y = np.arange(self.nrow), np.arange(self.ncol)
+            mean = np.array([np.mean(x), np.mean(y)])  # float64
+            std = np.array([np.std(x), np.std(y)])
+            state_scaled = (self.state - mean) / std
+        else:
+            state_scaled = self.state / 1
+        reward_scaled = self.reward / 5
+        return state_scaled, reward_scaled
+
+    def close(self) -> None:
+        """Reference no-op ``close`` (``grid_world.py:74-75``)."""

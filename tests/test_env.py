@@ -207,3 +207,68 @@ def test_vmap_over_batch():
     actions = jnp.zeros((4, 3), dtype=jnp.int32)
     npos, r = jax.vmap(lambda p, a: env_step(env, p, desired[0], a))(pos, actions)
     assert npos.shape == (4, 3, 2) and r.shape == (4, 3)
+
+
+class TestReferenceAPIAdapter:
+    """ReferenceGridWorld: the drop-in stateful twin of the reference's
+    Grid_World object protocol, golden-diffed against the real thing."""
+
+    @pytest.mark.skipif(REF_ENV is None, reason="reference env unavailable")
+    def test_golden_trajectory_vs_reference(self):
+        from rcmarl_tpu.envs import ReferenceGridWorld
+
+        desired = np.array([[0, 1], [2, 2], [4, 0]])
+        rng_actions = np.random.default_rng(7)
+        for scaling in (False, True):
+            # identical global-RNG draws for both resets
+            np.random.seed(123)
+            ref = REF_ENV(
+                nrow=4, ncol=6, n_agents=3, desired_state=desired,
+                randomize_state=True, scaling=scaling,
+            )
+            np.random.seed(123)
+            ours = ReferenceGridWorld(
+                nrow=4, ncol=6, n_agents=3, desired_state=desired,
+                randomize_state=True, scaling=scaling,
+            )
+            np.testing.assert_array_equal(ours.state, ref.state)
+            for _ in range(25):
+                a = rng_actions.integers(0, 5, size=3)
+                ref.step(a)
+                ours.step(a)
+                np.testing.assert_array_equal(ours.state, ref.state)
+                np.testing.assert_allclose(ours.reward, ref.reward)
+                rs, rr = ref.get_data()
+                os_, or_ = ours.get_data()
+                np.testing.assert_allclose(os_, rs)
+                np.testing.assert_allclose(or_, rr)
+
+    def test_step_mutates_in_place_like_reference(self):
+        """Scripts may alias env.state/env.reward once and read them after
+        every step — the reference mutates in place, so must we."""
+        from rcmarl_tpu.envs import ReferenceGridWorld
+
+        np.random.seed(5)
+        env = ReferenceGridWorld(
+            nrow=5, ncol=5, n_agents=2,
+            desired_state=np.array([[0, 0], [4, 4]]),
+        )
+        state_alias, reward_alias = env.state, env.reward
+        env.step([2, 2])
+        assert state_alias is env.state and reward_alias is env.reward
+        np.testing.assert_array_equal(state_alias, env.state)
+        assert (reward_alias != 0).any()  # alias sees the new rewards
+
+    def test_fixed_initial_state_and_close(self):
+        from rcmarl_tpu.envs import ReferenceGridWorld
+
+        init = np.array([[1, 1], [2, 3]])
+        env = ReferenceGridWorld(
+            nrow=5, ncol=5, n_agents=2,
+            desired_state=np.array([[0, 0], [4, 4]]),
+            initial_state=init, randomize_state=False,
+        )
+        np.testing.assert_array_equal(env.state, init)
+        env.step([0, 0])
+        assert env.reward.shape == (2,)
+        env.close()  # reference no-op protocol
