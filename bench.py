@@ -204,7 +204,11 @@ def main() -> int:
     attempts.append({"stage": "cpu_measure", **res})
     if "value" in res:
         res["attempts"] = len(attempts)
-        res["note"] = "TPU backend unavailable; CPU fallback measurement"
+        res["note"] = (
+            "TPU backend unavailable; CPU fallback measurement. The axon "
+            "relay died mid-round-3 (post-mortem: BENCH_SCALING.md); last "
+            "TPU headline: BENCH_r02.json (388,243 steps/s, 155,297x)"
+        )
         print(json.dumps(res))
         return 0
 
