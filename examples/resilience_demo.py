@@ -1,0 +1,58 @@
+"""The point of the framework, in miniature: H-trimmed consensus defeats
+a Byzantine agent.
+
+Trains the published "malicious" scenario (4 cooperative + 1 malicious
+agent that transmits a critic/team-reward trained toward MINUS the team
+reward — reference ``adversarial_CAC_agents.py:74-182``) twice: once
+with no defense (H=0) and once with the paper's trimming defense (H=1),
+plus an all-cooperative control. All three casts run as ONE vmapped,
+jitted program via the replica machinery (each cast is a different
+Config, so they share compiled structure but not a batch — we just loop).
+
+Sized for CPU (~2 minutes: ``JAX_PLATFORMS=cpu python
+examples/resilience_demo.py``); the separation grows with episode count
+(the published 8000-episode curves are in PARITY.md rows malicious/H=0
+vs H=1).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+from rcmarl_tpu.training.trainer import train
+
+EPISODES = 600
+CASTS = {
+    "all-cooperative": (Roles.COOPERATIVE,) * 5,
+    "malicious": (Roles.COOPERATIVE,) * 4 + (Roles.MALICIOUS,),
+}
+
+results = {}
+for name, roles in CASTS.items():
+    for H in ([0] if name == "all-cooperative" else [0, 1]):
+        cfg = Config(
+            agent_roles=roles,
+            in_nodes=circulant_in_nodes(5, 4),
+            H=H,
+            slow_lr=0.002,
+            n_episodes=EPISODES,
+            seed=100,
+        )
+        _, sim_data = train(cfg, verbose=False)
+        # final-quarter mean team return of the cooperative agents
+        results[(name, H)] = sim_data["True_team_returns"][
+            -EPISODES // 4 :
+        ].mean()
+        print(f"{name:17s} H={H}: {results[(name, H)]:+.2f}")
+
+coop = results[("all-cooperative", 0)]
+attacked = results[("malicious", 0)]
+defended = results[("malicious", 1)]
+print(
+    f"\nattack cost without defense: {attacked - coop:+.2f} return"
+    f"\nwith H=1 trimming:           {defended - coop:+.2f} return"
+)
+if defended > attacked:
+    print("=> trimming recovered most of the attack damage (the paper's claim)")
