@@ -1,0 +1,1 @@
+from rcmarl_tpu.agents.reference_api import ReferenceRPBCACAgent  # noqa: F401

@@ -1,0 +1,251 @@
+"""Drop-in twin of the reference's cooperative agent object.
+
+The reference's primary plugin boundary is its agent classes — user
+code drives ``RPBCAC_agent`` (``agents/resilient_CAC_agents.py:5-223``)
+method-by-method: local fits that RETURN transmitted weights, the
+hidden/projection consensus pair fed with neighbors' Keras weight
+lists, team head updates, the weighted-CE actor step, and ε-mixed
+action sampling. This module exposes that exact protocol over this
+framework's pure functions (:mod:`rcmarl_tpu.agents.updates`), so
+custom training loops written against the reference class migrate
+without rewrites.
+
+Weight format at the boundary is the reference's: a flat Keras-style
+list ``[W1, b1, W2, b2, ..., Wk, bk]`` per network (what ``np.load`` of
+its ``pretrained_weights.npy`` holds), converted internally to this
+framework's ``((W, b), ...)`` pytrees by the same helpers the
+checkpoint interop uses. ``get_action`` draws from the GLOBAL NumPy
+RNG in the reference's exact order (random candidate, policy sample,
+ε-mix — ``resilient_CAC_agents.py:214-217``), so seeded scripts
+reproduce its action streams modulo actor weights.
+
+Everything runs eagerly (op-by-op) — this shell exists for API
+fidelity and interactive use; the fused, vmapped trainer
+(:mod:`rcmarl_tpu.training`) is the performance path.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from rcmarl_tpu.agents.updates import (
+    coop_actor_update,
+    coop_local_critic_fit,
+    coop_local_tr_fit,
+    team_head_update,
+)
+from rcmarl_tpu.models.mlp import (
+    MLPParams,
+    actor_probs,
+    einsum,
+    trunk_forward,
+)
+from rcmarl_tpu.ops.aggregation import (
+    resilient_aggregate,
+    resilient_aggregate_tree,
+)
+from rcmarl_tpu.ops.optim import adam_init
+
+__all__ = ["ReferenceRPBCACAgent"]
+
+
+def _layers(flat: Sequence[np.ndarray]) -> MLPParams:
+    """Keras flat [W1,b1,...] -> ((W,b), ...) pytree (float32)."""
+    return tuple(
+        (jnp.asarray(flat[i], jnp.float32), jnp.asarray(flat[i + 1], jnp.float32))
+        for i in range(0, len(flat), 2)
+    )
+
+
+def _flat(params: MLPParams) -> List[np.ndarray]:
+    """((W,b), ...) pytree -> Keras flat [W1,b1,...] (numpy)."""
+    out: List[np.ndarray] = []
+    for W, b in params:
+        out.append(np.asarray(W))
+        out.append(np.asarray(b))
+    return out
+
+
+def _stack_neighbors(weights_innodes: Sequence[Sequence[np.ndarray]]) -> MLPParams:
+    """List of neighbors' flat weight lists (own first) -> one pytree with
+    leaves (n_in, ...) — the stacked-message layout the aggregation
+    kernels consume."""
+    layered = [_layers(w) for w in weights_innodes]
+    return tuple(
+        (
+            jnp.stack([l[i][0] for l in layered]),
+            jnp.stack([l[i][1] for l in layered]),
+        )
+        for i in range(len(layered[0]))
+    )
+
+
+class ReferenceRPBCACAgent:
+    """Reference-protocol cooperative RPBCAC agent over pure-JAX internals.
+
+    Constructor mirrors ``RPBCAC_agent.__init__(actor, critic,
+    team_reward, slow_lr, fast_lr, gamma, H)``
+    (``resilient_CAC_agents.py:28``), taking each network as a Keras-style
+    flat weight list instead of a compiled Keras model.
+    """
+
+    def __init__(
+        self,
+        actor: Sequence[np.ndarray],
+        critic: Sequence[np.ndarray],
+        team_reward: Sequence[np.ndarray],
+        slow_lr: float,
+        fast_lr: float,
+        gamma: float = 0.95,
+        H: int = 0,
+    ):
+        self.actor = _layers(actor)
+        self.critic = _layers(critic)
+        self.TR = _layers(team_reward)
+        self.n_actions = int(self.actor[-1][1].shape[0])
+        self.gamma = gamma
+        self.H = H
+        # the attribute subset the shared update primitives read
+        self._cfg = SimpleNamespace(
+            gamma=gamma,
+            fast_lr=fast_lr,
+            slow_lr=slow_lr,
+            coop_fit_steps=5,  # reference resilient_CAC_agents.py:118
+            leaky_alpha=0.1,
+            H=H,
+            consensus_impl="xla",
+            dot_dtype=None,
+        )
+        self._actor_opt = adam_init(self.actor)
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _full_mask(x) -> jnp.ndarray:
+        return jnp.ones((np.asarray(x).shape[0],), jnp.float32)
+
+    def _resilient_aggregation(self, values_innodes):
+        """The trimmed clip-and-average kernel, own value at index 0
+        (``resilient_CAC_agents.py:42-58``)."""
+        return np.asarray(
+            resilient_aggregate(jnp.asarray(values_innodes), self.H)
+        )
+
+    # -- phase I: local fits -> transmitted weights ------------------------
+
+    def critic_update_local(self, s, ns, r_local):
+        """5-step full-batch fit toward the pre-fit TD target; own net
+        RESTORED — returns (weights_to_transmit, first_step_loss), like
+        the reference's ``history['loss'][0]``
+        (``resilient_CAC_agents.py:103-122``)."""
+        msg, loss = coop_local_critic_fit(
+            self.critic,
+            jnp.asarray(s),
+            jnp.asarray(ns),
+            jnp.asarray(r_local),
+            self._full_mask(s),
+            self._cfg,
+        )
+        return _flat(msg), float(loss)
+
+    def TR_update_local(self, sa, r_local):
+        """Team-reward twin of :meth:`critic_update_local`
+        (``resilient_CAC_agents.py:124-140``)."""
+        msg, loss = coop_local_tr_fit(
+            self.TR,
+            jnp.asarray(sa),
+            jnp.asarray(r_local),
+            self._full_mask(sa),
+            self._cfg,
+        )
+        return _flat(msg), float(loss)
+
+    # -- phase II: resilient consensus ------------------------------------
+
+    def resilient_consensus_critic_hidden(self, critic_weights_innodes):
+        """Clip-mean each TRUNK array over neighbors and write it to the
+        own net; head untouched (``resilient_CAC_agents.py:142-153``)."""
+        self.critic = self._hidden(self.critic, critic_weights_innodes)
+
+    def resilient_consensus_TR_hidden(self, TR_weights_innodes):
+        """(``resilient_CAC_agents.py:155-166``)"""
+        self.TR = self._hidden(self.TR, TR_weights_innodes)
+
+    def _hidden(self, own: MLPParams, weights_innodes) -> MLPParams:
+        stacked = _stack_neighbors(weights_innodes)
+        trunk_agg = resilient_aggregate_tree(stacked[:-1], self.H)
+        return tuple(trunk_agg) + (own[-1],)
+
+    def resilient_consensus_critic(self, s, critic_weights_innodes):
+        """Projection: every neighbor's HEAD evaluated on the own
+        (post-hidden-consensus) trunk features, clip-meaned per sample
+        (``resilient_CAC_agents.py:168-186``). Returns (B, 1) targets."""
+        return self._projection(self.critic, jnp.asarray(s), critic_weights_innodes)
+
+    def resilient_consensus_TR(self, sa, TR_weights_innodes):
+        """(``resilient_CAC_agents.py:188-206``)"""
+        return self._projection(self.TR, jnp.asarray(sa), TR_weights_innodes)
+
+    def _projection(self, own: MLPParams, x, weights_innodes) -> np.ndarray:
+        stacked = _stack_neighbors(weights_innodes)
+        phi = trunk_forward(own, x, self._cfg.leaky_alpha)
+        W_nbr, b_nbr = stacked[-1]
+        vals = einsum("bh,nho->nbo", phi, W_nbr) + b_nbr[:, None, :]
+        return np.asarray(resilient_aggregate(vals, self.H))
+
+    def critic_update_team(self, s, critic_agg):
+        """Normalized projected head step toward the aggregated targets
+        (``resilient_CAC_agents.py:60-71``)."""
+        self.critic = self._team(self.critic, jnp.asarray(s), critic_agg)
+
+    def TR_update_team(self, sa, TR_agg):
+        """(``resilient_CAC_agents.py:73-84``)"""
+        self.TR = self._team(self.TR, jnp.asarray(sa), TR_agg)
+
+    def _team(self, own: MLPParams, x, targets) -> MLPParams:
+        phi = trunk_forward(own, x, self._cfg.leaky_alpha)
+        new_head = team_head_update(
+            own[-1], phi, jnp.asarray(targets), self._cfg
+        )
+        return own[:-1] + (new_head,)
+
+    # -- phase III: actor ---------------------------------------------------
+
+    def actor_update(self, s, ns, sa, a_local, pretrain=False):
+        """One Adam step of TD-error-weighted sparse CE
+        (``resilient_CAC_agents.py:86-101``). ``pretrain`` mirrors the
+        reference signature, where it is accepted but unused. Returns the
+        ``train_on_batch``-style loss: the weighted CE at the PRE-update
+        parameters."""
+        del pretrain  # dead parameter in the reference too
+        s, ns, sa = jnp.asarray(s), jnp.asarray(ns), jnp.asarray(sa)
+        a = jnp.asarray(np.asarray(a_local).reshape(-1), jnp.int32)
+        self.actor, self._actor_opt, loss = coop_actor_update(
+            self.actor, self._actor_opt, self.critic, self.TR,
+            s, ns, sa, a, self._cfg,
+        )
+        return float(loss)
+
+    # -- sampling / introspection ------------------------------------------
+
+    def get_action(self, state, mu: float = 0.1):
+        """ε-mixed policy sample with the reference's exact global-NumPy
+        draw order (``resilient_CAC_agents.py:208-219``)."""
+        random_action = np.random.choice(self.n_actions)
+        action_prob = np.asarray(
+            actor_probs(self.actor, jnp.asarray(state), self._cfg.leaky_alpha)
+        ).ravel()
+        action_from_policy = np.random.choice(self.n_actions, p=action_prob)
+        self.action = np.random.choice(
+            [action_from_policy, random_action], p=[1 - mu, mu]
+        )
+        return self.action
+
+    def get_parameters(self):
+        """[actor, critic, TR] Keras-style weight lists
+        (``resilient_CAC_agents.py:221-223``)."""
+        return [_flat(self.actor), _flat(self.critic), _flat(self.TR)]

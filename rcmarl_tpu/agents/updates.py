@@ -95,30 +95,33 @@ class Batch(NamedTuple):
 
 def coop_local_critic_fit(
     critic: MLPParams, s, ns, r, mask, cfg: Config
-) -> MLPParams:
+) -> Tuple[MLPParams, jnp.ndarray]:
     """Cooperative local critic fit -> transmitted message
     (resilient_CAC_agents.py:103-122): TD target computed ONCE with
     current weights, then ``coop_fit_steps`` full-batch SGD steps; the
-    caller keeps the agent's own critic unchanged (restore semantics)."""
+    caller keeps the agent's own critic unchanged (restore semantics).
+    Returns (message_params, first_step_loss) — the loss mirrors the
+    reference's ``history['loss'][0]`` second return value."""
     target = r + cfg.gamma * mlp_forward(critic, ns, dtype=cfg.dot_dtype)
     target = jax.lax.stop_gradient(target)
 
     def loss(p):
         return weighted_mse(mlp_forward(p, s, dtype=cfg.dot_dtype), target, mask=mask)
 
-    msg, _ = fit_full_batch(critic, loss, cfg.coop_fit_steps, cfg.fast_lr)
-    return msg
+    return fit_full_batch(critic, loss, cfg.coop_fit_steps, cfg.fast_lr)
 
 
-def coop_local_tr_fit(tr: MLPParams, sa, r, mask, cfg: Config) -> MLPParams:
+def coop_local_tr_fit(
+    tr: MLPParams, sa, r, mask, cfg: Config
+) -> Tuple[MLPParams, jnp.ndarray]:
     """Cooperative local team-reward fit (resilient_CAC_agents.py:124-140):
-    same 5-step full-batch SGD, target = local reward (no bootstrap)."""
+    same 5-step full-batch SGD, target = local reward (no bootstrap).
+    Returns (message_params, first_step_loss)."""
 
     def loss(p):
         return weighted_mse(mlp_forward(p, sa, dtype=cfg.dot_dtype), r, mask=mask)
 
-    msg, _ = fit_full_batch(tr, loss, cfg.coop_fit_steps, cfg.fast_lr)
-    return msg
+    return fit_full_batch(tr, loss, cfg.coop_fit_steps, cfg.fast_lr)
 
 
 def adv_critic_fit(
@@ -221,17 +224,27 @@ def consensus_update_one(
     agg = resilient_aggregate(vals, cfg.H, cfg.consensus_impl, valid=valid)  # (B, 1)
     agg = jax.lax.stop_gradient(agg)
     # d) normalized team update of the head only
+    new_head = team_head_update(new_params[-1], phi, agg, cfg, mask=mask)
+    return tuple(trunk_agg) + (new_head,)
+
+
+def team_head_update(head, phi, targets, cfg: Config, mask=None):
+    """The paper's normalized projected head step (reference
+    ``critic_update_team``/``TR_update_team``,
+    ``resilient_CAC_agents.py:60-84``): one SGD step of the output layer
+    on frozen trunk features ``phi`` toward the aggregated ``targets``,
+    sample-weighted 1/(2*fast_lr*(||phi||^2+1)) — with Keras MSE's
+    SUM_OVER_BATCH_SIZE reduction the fast_lr cancels."""
     phi_sg = jax.lax.stop_gradient(phi)
     phi_norm = jnp.sum(phi_sg**2, axis=1) + 1.0  # (B,)
     weights = 1.0 / (2.0 * cfg.fast_lr * phi_norm)
 
     def head_loss(head_params):
         pred = head_forward(head_params, phi_sg, cfg.dot_dtype)
-        return weighted_mse(pred, agg, sample_weight=weights, mask=mask)
+        return weighted_mse(pred, targets, sample_weight=weights, mask=mask)
 
-    g = jax.grad(head_loss)(new_params[-1])
-    new_head = jax.tree.map(lambda p, gg: p - cfg.fast_lr * gg, new_params[-1], g)
-    return tuple(trunk_agg) + (new_head,)
+    g = jax.grad(head_loss)(head)
+    return jax.tree.map(lambda p, gg: p - cfg.fast_lr * gg, head, g)
 
 
 # --------------------------------------------------------------------------
@@ -253,7 +266,9 @@ def coop_actor_update(
     """Cooperative actor step (resilient_CAC_agents.py:86-101): sample
     weights = team TD error r_bar(sa) + gamma*V(ns) - V(s) (own TR/critic,
     post-consensus), ONE full-batch Adam step of weighted sparse CE over
-    the fresh on-policy window (always fully valid)."""
+    the fresh on-policy window (always fully valid). Returns
+    (new_actor, new_opt, pre_update_loss) — the loss mirrors the
+    reference's ``train_on_batch`` return value."""
     delta = (
         mlp_forward(tr, sa, dtype=cfg.dot_dtype)
         + cfg.gamma * mlp_forward(critic, ns, dtype=cfg.dot_dtype)
@@ -266,8 +281,9 @@ def coop_actor_update(
             actor_probs(p, s, cfg.leaky_alpha, cfg.dot_dtype), a_own, delta
         )
 
-    g = jax.grad(loss)(actor)
-    return adam_update(actor, g, opt, cfg.slow_lr)
+    loss_val, g = jax.value_and_grad(loss)(actor)
+    new_actor, new_opt = adam_update(actor, g, opt, cfg.slow_lr)
+    return new_actor, new_opt, loss_val
 
 
 def adv_actor_update(

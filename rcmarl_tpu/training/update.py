@@ -125,10 +125,10 @@ def critic_tr_epoch(
             r_applied = jnp.broadcast_to(r_coop[None], (N, *r_coop.shape))
         else:
             r_applied = r_agents
-        coop_c = jax.vmap(
+        coop_c, _ = jax.vmap(
             lambda p, r: coop_local_critic_fit(p, s, ns, r, mask, cfg)
         )(critic, r_applied)
-        coop_t = jax.vmap(lambda p, r: coop_local_tr_fit(p, sa, r, mask, cfg))(
+        coop_t, _ = jax.vmap(lambda p, r: coop_local_tr_fit(p, sa, r, mask, cfg))(
             tr, r_applied
         )
         m = _role_mask(cfg, Roles.COOPERATIVE)
@@ -212,7 +212,7 @@ def actor_phase(
 
     new_actor, new_opt = params.actor, params.actor_opt
     if cfg.n_coop:
-        coop_a, coop_o = jax.vmap(
+        coop_a, coop_o, _ = jax.vmap(
             lambda ac, op, cr, t, a: coop_actor_update(
                 ac, op, cr, t, s, ns, sa, a, cfg
             )

@@ -158,14 +158,14 @@ def test_local_critic_fit_message_golden():
     s, ns, _, r = _batch(rng)
     before = agent.critic.get_weights()
 
-    msg_ref, _ = agent.critic_update_local(
+    msg_ref, ref_loss = agent.critic_update_local(
         tf.constant(s), tf.constant(ns), tf.constant(r)
     )
     # restore semantics: the agent's own net is unchanged
     for a, b in zip(agent.critic.get_weights(), before):
         np.testing.assert_array_equal(a, b)
 
-    mine = coop_local_critic_fit(
+    mine, my_loss = coop_local_critic_fit(
         _to_params(before),
         jnp.asarray(s),
         jnp.asarray(ns),
@@ -175,6 +175,7 @@ def test_local_critic_fit_message_golden():
     )
     for ref_a, my_a in zip(msg_ref, _to_keras(mine)):
         np.testing.assert_allclose(my_a, ref_a, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(my_loss), float(ref_loss), rtol=1e-4)
 
 
 def test_local_tr_fit_message_golden():
@@ -184,9 +185,9 @@ def test_local_tr_fit_message_golden():
     sa = np.concatenate([s, a], axis=-1)
     before = agent.TR.get_weights()
 
-    msg_ref, _ = agent.TR_update_local(tf.constant(sa), tf.constant(r))
+    msg_ref, ref_loss = agent.TR_update_local(tf.constant(sa), tf.constant(r))
 
-    mine = coop_local_tr_fit(
+    mine, my_loss = coop_local_tr_fit(
         _to_params(before),
         jnp.asarray(sa),
         jnp.asarray(r),
@@ -195,6 +196,7 @@ def test_local_tr_fit_message_golden():
     )
     for ref_a, my_a in zip(msg_ref, _to_keras(mine)):
         np.testing.assert_allclose(my_a, ref_a, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(my_loss), float(ref_loss), rtol=1e-4)
 
 
 @pytest.mark.parametrize("H", [0, 1])
@@ -483,7 +485,7 @@ def test_coop_actor_update_golden():
     ref_final = agent.actor.get_weights()
 
     actor_p = _to_params(actor_before)
-    new_actor, _ = coop_actor_update(
+    new_actor, _, _ = coop_actor_update(
         actor_p,
         adam_init(actor_p),
         _to_params(critic_w),

@@ -1,0 +1,197 @@
+"""Golden tests for the reference-protocol agent adapter.
+
+`ReferenceRPBCACAgent` claims drop-in fidelity to the reference's
+`RPBCAC_agent` object; these tests drive BOTH through a full reference
+trainer epoch — local fits, the synchronous weight exchange, hidden +
+projection consensus, team updates, and the actor step — and compare
+weights and returned values at every boundary. Reuses the Keras setup
+conventions of ``test_golden_updates.py`` (TF optional: skipped when
+unavailable).
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+from rcmarl_tpu.agents import ReferenceRPBCACAgent
+
+tf = pytest.importorskip("tensorflow")
+keras = tf.keras
+
+
+def _load_reference_agent():
+    sys.path.insert(0, "/root/reference")
+    try:
+        from agents.resilient_CAC_agents import RPBCAC_agent  # type: ignore
+
+        return RPBCAC_agent
+    except Exception:
+        return None
+    finally:
+        sys.path.remove("/root/reference")
+
+
+REF_AGENT = _load_reference_agent()
+
+pytestmark = pytest.mark.skipif(
+    REF_AGENT is None, reason="reference agent not importable"
+)
+
+N_AGENTS, N_STATES, N_ACTIONS = 5, 2, 5
+GAMMA, FAST_LR, SLOW_LR, H = 0.9, 0.01, 0.002, 1
+N_IN = 4  # reference default neighborhood incl. self (main.py:28)
+
+
+def _keras_model(in_feats, out_dim, softmax):
+    return keras.Sequential(
+        [
+            keras.Input(shape=(N_AGENTS, in_feats)),
+            keras.layers.Flatten(),
+            keras.layers.Dense(20, activation=keras.layers.LeakyReLU(alpha=0.1)),
+            keras.layers.Dense(20, activation=keras.layers.LeakyReLU(alpha=0.1)),
+            keras.layers.Dense(out_dim, activation="softmax" if softmax else None),
+        ]
+    )
+
+
+if REF_AGENT is not None:
+    # Keras-3 compat shim, as in test_golden_updates.py: the reference
+    # reuses one stateless SGD across models/trainable-set changes.
+    REF_AGENT.optimizer_fast = property(
+        lambda self: keras.optimizers.SGD(learning_rate=self.fast_lr),
+        lambda self, v: None,
+    )
+
+
+def _pair(seed=0):
+    """(reference agent, adapter) from IDENTICAL initial weights."""
+    keras.utils.set_random_seed(seed)
+    models = (
+        _keras_model(N_STATES, N_ACTIONS, softmax=True),
+        _keras_model(N_STATES, 1, softmax=False),
+        _keras_model(N_STATES + 1, 1, softmax=False),
+    )
+    ref = REF_AGENT(*models, slow_lr=SLOW_LR, fast_lr=FAST_LR, gamma=GAMMA, H=H)
+    ours = ReferenceRPBCACAgent(
+        models[0].get_weights(),
+        models[1].get_weights(),
+        models[2].get_weights(),
+        slow_lr=SLOW_LR,
+        fast_lr=FAST_LR,
+        gamma=GAMMA,
+        H=H,
+    )
+    return ref, ours
+
+
+def _batch(rng, B=32):
+    s = rng.normal(size=(B, N_AGENTS, N_STATES)).astype(np.float32)
+    ns = rng.normal(size=(B, N_AGENTS, N_STATES)).astype(np.float32)
+    a = rng.integers(0, N_ACTIONS, size=(B, N_AGENTS, 1)).astype(np.float32)
+    r = rng.normal(size=(B, 1)).astype(np.float32) * 0.3 - 0.5
+    return s, ns, a, r
+
+
+def _neighbor_messages(rng, own_weights):
+    """own message first + 3 perturbed copies (the exchange's shape)."""
+    msgs = [own_weights]
+    for k in range(1, N_IN):
+        msgs.append(
+            [w + rng.normal(size=w.shape).astype(np.float32) * 0.05 for w in own_weights]
+        )
+    return msgs
+
+
+def _assert_weights_close(ours_flat, ref_weights, rtol=1e-4, atol=1e-5):
+    for mine, ref in zip(ours_flat, ref_weights):
+        np.testing.assert_allclose(np.asarray(mine), ref, rtol=rtol, atol=atol)
+
+
+class TestFullEpochGolden:
+    def test_local_fit_messages_and_losses(self):
+        ref, ours = _pair()
+        rng = np.random.default_rng(0)
+        s, ns, a, r = _batch(rng)
+        sa = np.concatenate([s, a], axis=-1)
+
+        w_ref, l_ref = ref.critic_update_local(
+            tf.constant(s), tf.constant(ns), tf.constant(r)
+        )
+        w_my, l_my = ours.critic_update_local(s, ns, r)
+        _assert_weights_close(w_my, w_ref)
+        np.testing.assert_allclose(l_my, l_ref, rtol=1e-4)
+
+        w_ref, l_ref = ref.TR_update_local(tf.constant(sa), tf.constant(r))
+        w_my, l_my = ours.TR_update_local(sa, r)
+        _assert_weights_close(w_my, w_ref)
+        np.testing.assert_allclose(l_my, l_ref, rtol=1e-4)
+
+    def test_consensus_and_team_update_golden(self):
+        ref, ours = _pair()
+        rng = np.random.default_rng(1)
+        s, ns, a, r = _batch(rng)
+        sa = np.concatenate([s, a], axis=-1)
+
+        c_msgs = _neighbor_messages(rng, ref.critic.get_weights())
+        t_msgs = _neighbor_messages(rng, ref.TR.get_weights())
+
+        # hidden consensus writes the trunk on both sides
+        ref.resilient_consensus_critic_hidden(c_msgs)
+        ref.resilient_consensus_TR_hidden(t_msgs)
+        ours.resilient_consensus_critic_hidden(c_msgs)
+        ours.resilient_consensus_TR_hidden(t_msgs)
+        _assert_weights_close(
+            [w for pair in ours.critic for w in pair], ref.critic.get_weights()
+        )
+
+        # projection targets over the full batch
+        agg_ref = np.asarray(ref.resilient_consensus_critic(tf.constant(s), c_msgs))
+        agg_my = ours.resilient_consensus_critic(s, c_msgs)
+        np.testing.assert_allclose(agg_my, agg_ref, rtol=1e-4, atol=1e-5)
+        tr_agg_ref = np.asarray(ref.resilient_consensus_TR(tf.constant(sa), t_msgs))
+        tr_agg_my = ours.resilient_consensus_TR(sa, t_msgs)
+        np.testing.assert_allclose(tr_agg_my, tr_agg_ref, rtol=1e-4, atol=1e-5)
+
+        # team head updates
+        ref.critic_update_team(tf.constant(s), tf.constant(agg_ref))
+        ours.critic_update_team(s, agg_my)
+        _assert_weights_close(
+            [w for pair in ours.critic for w in pair], ref.critic.get_weights()
+        )
+        ref.TR_update_team(tf.constant(sa), tf.constant(tr_agg_ref))
+        ours.TR_update_team(sa, tr_agg_my)
+        _assert_weights_close(
+            [w for pair in ours.TR for w in pair], ref.TR.get_weights()
+        )
+
+    def test_actor_update_golden(self):
+        ref, ours = _pair()
+        rng = np.random.default_rng(2)
+        s, ns, a, r = _batch(rng)
+        sa = np.concatenate([s, a], axis=-1)
+        a_local = a[:, 0, 0]
+
+        ref.actor_update(
+            tf.constant(s), tf.constant(ns), tf.constant(sa), tf.constant(a_local)
+        )
+        ours.actor_update(s, ns, sa, a_local)
+        _assert_weights_close(
+            [w for pair in ours.actor for w in pair],
+            ref.actor.get_weights(),
+            rtol=2e-4,
+            atol=2e-5,
+        )
+
+    def test_get_action_stream_and_parameters(self):
+        ref, ours = _pair()
+        state = np.zeros((1, N_AGENTS, N_STATES), np.float32)
+        # identical global-RNG streams => identical ε-mixed action choices
+        np.random.seed(42)
+        a_ref = [int(ref.get_action(state)) for _ in range(10)]
+        np.random.seed(42)
+        a_my = [int(ours.get_action(state)) for _ in range(10)]
+        assert a_my == a_ref
+
+        for mine, ref_w in zip(ours.get_parameters(), ref.get_parameters()):
+            _assert_weights_close(mine, ref_w)
