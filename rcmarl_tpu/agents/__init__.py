@@ -1,1 +1,6 @@
-from rcmarl_tpu.agents.reference_api import ReferenceRPBCACAgent  # noqa: F401
+from rcmarl_tpu.agents.reference_api import (  # noqa: F401
+    ReferenceFaultyAgent,
+    ReferenceGreedyAgent,
+    ReferenceMaliciousAgent,
+    ReferenceRPBCACAgent,
+)

@@ -29,10 +29,14 @@ from __future__ import annotations
 from types import SimpleNamespace
 from typing import List, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from rcmarl_tpu.agents.updates import (
+    adv_actor_update,
+    adv_critic_fit,
+    adv_tr_fit,
     coop_actor_update,
     coop_local_critic_fit,
     coop_local_tr_fit,
@@ -50,7 +54,12 @@ from rcmarl_tpu.ops.aggregation import (
 )
 from rcmarl_tpu.ops.optim import adam_init
 
-__all__ = ["ReferenceRPBCACAgent"]
+__all__ = [
+    "ReferenceRPBCACAgent",
+    "ReferenceFaultyAgent",
+    "ReferenceGreedyAgent",
+    "ReferenceMaliciousAgent",
+]
 
 
 def _layers(flat: Sequence[np.ndarray]) -> MLPParams:
@@ -249,3 +258,172 @@ class ReferenceRPBCACAgent:
         """[actor, critic, TR] Keras-style weight lists
         (``resilient_CAC_agents.py:221-223``)."""
         return [_flat(self.actor), _flat(self.critic), _flat(self.TR)]
+
+
+class _ReferenceAdversaryBase:
+    """Shared shell for the three adversary twins
+    (``adversarial_CAC_agents.py``): nets from Keras weight lists, the
+    local-TD actor fit, and the reference's ε-mixed action sampling.
+
+    The adversaries' ``fit(...)`` calls shuffle minibatches; the twins
+    shuffle with a JAX PRNG stream (seeded per instance) instead of TF's,
+    so multi-batch fits match the reference statistically, exactly as the
+    trainer does (SURVEY.md §7 hard part (c)); single-batch regimes
+    (B <= batch_size) are bit-faithful.
+    """
+
+    def __init__(
+        self, actor, critic, team_reward, slow_lr, fast_lr, gamma,
+        shuffle_seed: int = 0,
+    ):
+        self.actor = _layers(actor)
+        self.critic = _layers(critic)
+        self.TR = _layers(team_reward)
+        self.n_actions = int(self.actor[-1][1].shape[0])
+        self.gamma = gamma
+        self._cfg = SimpleNamespace(
+            gamma=gamma,
+            fast_lr=fast_lr,
+            slow_lr=slow_lr,
+            leaky_alpha=0.1,
+            dot_dtype=None,
+            # fit(epochs=10, batch_size=32): adversarial_CAC_agents.py:133
+            adv_fit_epochs=10,
+            adv_fit_batch=32,
+            # actor fit(batch_size=200, epochs=1): adversarial:41,116,224
+            batch_size=200,
+        )
+        self._actor_opt = adam_init(self.actor)
+        # Deterministic, caller-suppliable shuffle stream: construction
+        # must consume NO global-NumPy draws (the reference constructors
+        # don't), or seeded scripts' get_action streams would shift.
+        self._key = jax.random.PRNGKey(shuffle_seed)
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _actor_fit(self, critic: MLPParams, s, ns, r_local, a_local) -> float:
+        """Local-TD-weighted actor fit shared by all three adversaries
+        (``adversarial_CAC_agents.py:28-43,102-119,211-226``)."""
+        s, ns = jnp.asarray(s), jnp.asarray(ns)
+        r = jnp.asarray(r_local).reshape(-1, 1)
+        a = jnp.asarray(np.asarray(a_local).reshape(-1), jnp.int32)
+        self.actor, self._actor_opt, loss = adv_actor_update(
+            self._next_key(), self.actor, self._actor_opt, critic,
+            s, ns, r, a, self._cfg,
+        )
+        return float(loss)
+
+    def get_action(self, state, mu: float = 0.1):
+        """Identical to the cooperative agent's sampling
+        (``adversarial_CAC_agents.py:57-68``)."""
+        return ReferenceRPBCACAgent.get_action(self, state, mu)
+
+    def get_parameters(self):
+        return [_flat(self.actor), _flat(self.critic), _flat(self.TR)]
+
+
+class ReferenceFaultyAgent(_ReferenceAdversaryBase):
+    """Twin of ``Faulty_CAC_agent`` (``adversarial_CAC_agents.py:5-72``):
+    trains only its actor on its own reward and transmits its FROZEN
+    critic/TR weights — a crash-like fault."""
+
+    def __init__(self, actor, critic, team_reward, slow_lr, gamma=0.95):
+        # the reference's faulty agent takes no fast_lr: nothing fits
+        super().__init__(actor, critic, team_reward, slow_lr, 0.0, gamma)
+
+    def actor_update(self, s, ns, r_local, a_local):
+        return self._actor_fit(self.critic, s, ns, r_local, a_local)
+
+    def get_critic_weights(self):
+        """(``adversarial_CAC_agents.py:45-49``)"""
+        return _flat(self.critic)
+
+    def get_TR_weights(self):
+        """(``adversarial_CAC_agents.py:51-55``)"""
+        return _flat(self.TR)
+
+
+class ReferenceGreedyAgent(_ReferenceAdversaryBase):
+    """Twin of ``Greedy_CAC_agent`` (``adversarial_CAC_agents.py:184-275``):
+    trains critic/TR on its OWN reward (persisting), transmits them, and
+    never applies consensus."""
+
+    def __init__(self, actor, critic, team_reward, slow_lr, fast_lr, gamma=0.95):
+        super().__init__(actor, critic, team_reward, slow_lr, fast_lr, gamma)
+
+    def actor_update(self, s, ns, r_local, a_local):
+        return self._actor_fit(self.critic, s, ns, r_local, a_local)
+
+    def critic_update_local(self, s, ns, r_local):
+        """PERSISTING own-reward critic fit; returns (weights, loss)
+        (``adversarial_CAC_agents.py:228-241``)."""
+        self.critic, loss = adv_critic_fit(
+            self._next_key(), self.critic, jnp.asarray(s), jnp.asarray(ns),
+            jnp.asarray(r_local), ReferenceRPBCACAgent._full_mask(s), self._cfg,
+        )
+        return _flat(self.critic), float(loss)
+
+    def TR_update_local(self, sa, r_local):
+        """(``adversarial_CAC_agents.py:243-253``)"""
+        self.TR, loss = adv_tr_fit(
+            self._next_key(), self.TR, jnp.asarray(sa),
+            jnp.asarray(r_local), ReferenceRPBCACAgent._full_mask(sa), self._cfg,
+        )
+        return _flat(self.TR), float(loss)
+
+
+class ReferenceMaliciousAgent(_ReferenceAdversaryBase):
+    """Twin of ``Malicious_CAC_agent`` (``adversarial_CAC_agents.py:
+    74-182``): a PRIVATE local critic (trained on its own reward) drives
+    its actor, while the transmitted critic/TR are trained toward the
+    NEGATED cooperative reward — Byzantine poisoning."""
+
+    def __init__(self, actor, critic, team_reward, slow_lr, fast_lr, gamma=0.95):
+        super().__init__(actor, critic, team_reward, slow_lr, fast_lr, gamma)
+        # private critic starts as a copy of the compromised one
+        # (adversarial_CAC_agents.py:99)
+        self.critic_local_weights = _flat(self.critic)
+
+    def actor_update(self, s, ns, r_local, a_local):
+        """Actor drives off the PRIVATE critic
+        (``adversarial_CAC_agents.py:102-119``)."""
+        return self._actor_fit(
+            _layers(self.critic_local_weights), s, ns, r_local, a_local
+        )
+
+    def critic_update_local(self, s, ns, r_local):
+        """Own-reward fit of the PRIVATE critic; persists to
+        ``critic_local_weights``, returns nothing — exactly the reference
+        (``adversarial_CAC_agents.py:137-152``)."""
+        new, _ = adv_critic_fit(
+            self._next_key(), _layers(self.critic_local_weights),
+            jnp.asarray(s), jnp.asarray(ns), jnp.asarray(r_local),
+            ReferenceRPBCACAgent._full_mask(s), self._cfg,
+        )
+        self.critic_local_weights = _flat(new)
+
+    def critic_update_compromised(self, s, ns, r_compromised):
+        """Poisoned-critic fit toward the negated team reward; persists
+        and returns (weights, loss) (``adversarial_CAC_agents.py:121-135``)."""
+        self.critic, loss = adv_critic_fit(
+            self._next_key(), self.critic, jnp.asarray(s), jnp.asarray(ns),
+            jnp.asarray(r_compromised), ReferenceRPBCACAgent._full_mask(s),
+            self._cfg,
+        )
+        return _flat(self.critic), float(loss)
+
+    def TR_update_compromised(self, sa, r_compromised):
+        """(``adversarial_CAC_agents.py:154-165``)"""
+        self.TR, loss = adv_tr_fit(
+            self._next_key(), self.TR, jnp.asarray(sa),
+            jnp.asarray(r_compromised), ReferenceRPBCACAgent._full_mask(sa),
+            self._cfg,
+        )
+        return _flat(self.TR), float(loss)
+
+    def get_parameters(self):
+        """Four entries incl. the private critic
+        (``adversarial_CAC_agents.py:180-182``)."""
+        return super().get_parameters() + [list(self.critic_local_weights)]

@@ -126,18 +126,20 @@ def coop_local_tr_fit(
 
 def adv_critic_fit(
     key, critic: MLPParams, s, ns, r_target, mask, cfg: Config
-) -> MLPParams:
+) -> Tuple[MLPParams, jnp.ndarray]:
     """Adversary critic fit (greedy local / malicious local+compromised):
     TD target with pre-fit weights, then fit(epochs=10, batch_size=32)
     shuffled minibatch SGD (adversarial_CAC_agents.py:131-133,146-151,
-    237-239). The update PERSISTS (no restore)."""
+    237-239). The update PERSISTS (no restore). Returns
+    (params, first_epoch_mean_loss) — the reference's
+    ``history['loss'][0]`` second return value."""
     target = r_target + cfg.gamma * mlp_forward(critic, ns, dtype=cfg.dot_dtype)
     target = jax.lax.stop_gradient(target)
 
     def batch_loss(p, idx, bval):
         return weighted_mse(mlp_forward(p, s[idx], dtype=cfg.dot_dtype), target[idx], mask=bval)
 
-    out, _, _ = fit_minibatch(
+    out, _, loss = fit_minibatch(
         key,
         critic,
         batch_loss,
@@ -147,18 +149,20 @@ def adv_critic_fit(
         batch_size=cfg.adv_fit_batch,
         lr=cfg.fast_lr,
     )
-    return out
+    return out, loss
 
 
-def adv_tr_fit(key, tr: MLPParams, sa, r_target, mask, cfg: Config) -> MLPParams:
+def adv_tr_fit(
+    key, tr: MLPParams, sa, r_target, mask, cfg: Config
+) -> Tuple[MLPParams, jnp.ndarray]:
     """Adversary team-reward fit: fit(epochs=10, batch_size=32) toward the
     (possibly compromised) reward (adversarial_CAC_agents.py:154-165,
-    243-253)."""
+    243-253). Returns (params, first_epoch_mean_loss)."""
 
     def batch_loss(p, idx, bval):
         return weighted_mse(mlp_forward(p, sa[idx], dtype=cfg.dot_dtype), r_target[idx], mask=bval)
 
-    out, _, _ = fit_minibatch(
+    out, _, loss = fit_minibatch(
         key,
         tr,
         batch_loss,
@@ -168,7 +172,7 @@ def adv_tr_fit(key, tr: MLPParams, sa, r_target, mask, cfg: Config) -> MLPParams
         batch_size=cfg.adv_fit_batch,
         lr=cfg.fast_lr,
     )
-    return out
+    return out, loss
 
 
 # --------------------------------------------------------------------------
@@ -296,11 +300,12 @@ def adv_actor_update(
     r_own,
     a_own,
     cfg: Config,
-) -> Tuple[MLPParams, AdamState]:
+) -> Tuple[MLPParams, AdamState, jnp.ndarray]:
     """Adversary actor step (adversarial_CAC_agents.py:28-43,102-119,
     211-226): sample weights = LOCAL TD error from own reward and own
     critic (malicious: its private local critic), then
-    fit(batch_size=200, epochs=1) = shuffled minibatch Adam steps."""
+    fit(batch_size=200, epochs=1) = shuffled minibatch Adam steps.
+    Returns (new_actor, new_opt, first_epoch_mean_loss)."""
     delta = (
         r_own
         + cfg.gamma * mlp_forward(critic, ns, dtype=cfg.dot_dtype)
@@ -316,7 +321,7 @@ def adv_actor_update(
             a_own[idx], delta[idx], mask=bval,
         )
 
-    new_actor, new_opt, _ = fit_minibatch(
+    return fit_minibatch(
         key,
         actor,
         batch_loss,
@@ -327,7 +332,6 @@ def adv_actor_update(
         opt_state=opt,
         opt_update=lambda p, g, s_: adam_update(p, g, s_, cfg.slow_lr),
     )
-    return new_actor, new_opt
 
 
 # --------------------------------------------------------------------------

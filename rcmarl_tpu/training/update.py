@@ -139,10 +139,10 @@ def critic_tr_epoch(
     k_gc, k_gt, k_ml, k_mc, k_mt = jax.random.split(ekey, 5)
 
     if cfg.has_role(Roles.GREEDY):
-        greedy_c = jax.vmap(
+        greedy_c, _ = jax.vmap(
             lambda k, p, r: adv_critic_fit(k, p, s, ns, r, mask, cfg)
         )(jax.random.split(k_gc, N), critic, r_agents)
-        greedy_t = jax.vmap(lambda k, p, r: adv_tr_fit(k, p, sa, r, mask, cfg))(
+        greedy_t, _ = jax.vmap(lambda k, p, r: adv_tr_fit(k, p, sa, r, mask, cfg))(
             jax.random.split(k_gt, N), tr, r_agents
         )
         m = _role_mask(cfg, Roles.GREEDY)
@@ -153,15 +153,15 @@ def critic_tr_epoch(
 
     if cfg.has_role(Roles.MALICIOUS):
         # private critic on own reward (adversarial_CAC_agents.py:137-152)
-        mal_local = jax.vmap(
+        mal_local, _ = jax.vmap(
             lambda k, p, r: adv_critic_fit(k, p, s, ns, r, mask, cfg)
         )(jax.random.split(k_ml, N), critic_local, r_agents)
         # compromised critic/TR toward -r_coop (adversarial:121-135,154-165)
         neg = jnp.broadcast_to(-r_coop[None], (N, *r_coop.shape))
-        mal_c = jax.vmap(
+        mal_c, _ = jax.vmap(
             lambda k, p, r: adv_critic_fit(k, p, s, ns, r, mask, cfg)
         )(jax.random.split(k_mc, N), critic, neg)
-        mal_t = jax.vmap(lambda k, p, r: adv_tr_fit(k, p, sa, r, mask, cfg))(
+        mal_t, _ = jax.vmap(lambda k, p, r: adv_tr_fit(k, p, sa, r, mask, cfg))(
             jax.random.split(k_mt, N), tr, neg
         )
         m = _role_mask(cfg, Roles.MALICIOUS)
@@ -227,7 +227,7 @@ def actor_phase(
         critic_in = select_tree(
             _role_mask(cfg, Roles.MALICIOUS), params.critic_local, params.critic
         )
-        adv_a, adv_o = jax.vmap(
+        adv_a, adv_o, _ = jax.vmap(
             lambda k, ac, op, cr, r, a: adv_actor_update(
                 k, ac, op, cr, s, ns, r, a, cfg
             )

@@ -262,11 +262,11 @@ def test_greedy_critic_and_tr_fit_golden():
 
     cfg = _cfg()
     mask = jnp.ones((len(s),), jnp.float32)
-    mine_critic = adv_critic_fit(
+    mine_critic, _ = adv_critic_fit(
         jax.random.PRNGKey(0), _to_params(critic_before),
         jnp.asarray(s), jnp.asarray(ns), jnp.asarray(r), mask, cfg,
     )
-    mine_tr = adv_tr_fit(
+    mine_tr, _ = adv_tr_fit(
         jax.random.PRNGKey(1), _to_params(tr_before),
         jnp.asarray(sa), jnp.asarray(r), mask, cfg,
     )
@@ -295,11 +295,11 @@ def test_malicious_compromised_fits_golden():
 
     cfg = _cfg()
     mask = jnp.ones((len(s),), jnp.float32)
-    mine_critic = adv_critic_fit(
+    mine_critic, _ = adv_critic_fit(
         jax.random.PRNGKey(0), _to_params(critic_before),
         jnp.asarray(s), jnp.asarray(ns), jnp.asarray(r_comp), mask, cfg,
     )
-    mine_tr = adv_tr_fit(
+    mine_tr, _ = adv_tr_fit(
         jax.random.PRNGKey(1), _to_params(tr_before),
         jnp.asarray(sa), jnp.asarray(r_comp), mask, cfg,
     )
@@ -325,7 +325,7 @@ def test_malicious_private_critic_fit_golden():
     for a, b in zip(agent.critic.get_weights(), compromised_before):
         np.testing.assert_array_equal(a, b)
 
-    mine = adv_critic_fit(
+    mine, _ = adv_critic_fit(
         jax.random.PRNGKey(0), _to_params(local_before),
         jnp.asarray(s), jnp.asarray(ns), jnp.asarray(r),
         jnp.ones((len(s),), jnp.float32), _cfg(),
@@ -352,7 +352,7 @@ def test_adversary_actor_update_golden():
     ref_final = agent.actor.get_weights()
 
     actor_p = _to_params(actor_before)
-    new_actor, _ = adv_actor_update(
+    new_actor, _, _ = adv_actor_update(
         jax.random.PRNGKey(0),
         actor_p,
         adam_init(actor_p),

@@ -195,3 +195,190 @@ class TestFullEpochGolden:
 
         for mine, ref_w in zip(ours.get_parameters(), ref.get_parameters()):
             _assert_weights_close(mine, ref_w)
+
+
+def _load_reference_adversaries():
+    sys.path.insert(0, "/root/reference")
+    try:
+        from agents.adversarial_CAC_agents import (  # type: ignore
+            Faulty_CAC_agent,
+            Greedy_CAC_agent,
+            Malicious_CAC_agent,
+        )
+
+        return Faulty_CAC_agent, Greedy_CAC_agent, Malicious_CAC_agent
+    except Exception:
+        return None, None, None
+    finally:
+        sys.path.remove("/root/reference")
+
+
+REF_FAULTY, REF_GREEDY, REF_MALICIOUS = _load_reference_adversaries()
+
+adversarial = pytest.mark.skipif(
+    REF_GREEDY is None, reason="reference adversarial agents not importable"
+)
+
+
+def _adv_pair(ours_cls, ref_cls, seed=3, **extra):
+    from rcmarl_tpu.agents import reference_api  # noqa: F401
+
+    keras.utils.set_random_seed(seed)
+    models = (
+        _keras_model(N_STATES, N_ACTIONS, softmax=True),
+        _keras_model(N_STATES, 1, softmax=False),
+        _keras_model(N_STATES + 1, 1, softmax=False),
+    )
+    ref = ref_cls(*models, slow_lr=SLOW_LR, gamma=GAMMA, **extra)
+    ours = ours_cls(
+        models[0].get_weights(),
+        models[1].get_weights(),
+        models[2].get_weights(),
+        slow_lr=SLOW_LR,
+        gamma=GAMMA,
+        **extra,
+    )
+    return ref, ours
+
+
+@adversarial
+class TestAdversaryTwinsGolden:
+    """B=32 with fit batch_size=32 (and actor batch_size=200 > B) makes
+    every reference fit single-batch, so shuffle order is irrelevant and
+    the twins must match bit-for-bit within float tolerance."""
+
+    def test_faulty_frozen_messages_and_actor(self):
+        from rcmarl_tpu.agents import ReferenceFaultyAgent
+
+        ref, ours = _adv_pair(ReferenceFaultyAgent, REF_FAULTY)
+        rng = np.random.default_rng(4)
+        s, ns, a, r = _batch(rng)
+        a_local = a[:, 0, :]
+
+        _assert_weights_close(ours.get_critic_weights(), ref.get_critic_weights())
+        _assert_weights_close(ours.get_TR_weights(), ref.get_TR_weights())
+
+        ref.actor_update(
+            tf.constant(s), tf.constant(ns), tf.constant(r), tf.constant(a_local)
+        )
+        ours.actor_update(s, ns, r, a_local)
+        _assert_weights_close(
+            [w for pair in ours.actor for w in pair],
+            ref.actor.get_weights(),
+            rtol=2e-4,
+            atol=2e-5,
+        )
+        # messages stay frozen through actor training
+        _assert_weights_close(ours.get_critic_weights(), ref.get_critic_weights())
+
+    def test_greedy_persisting_fits(self):
+        from rcmarl_tpu.agents import ReferenceGreedyAgent
+
+        ref, ours = _adv_pair(
+            ReferenceGreedyAgent, REF_GREEDY, fast_lr=FAST_LR
+        )
+        rng = np.random.default_rng(5)
+        s, ns, a, r = _batch(rng)
+        sa = np.concatenate([s, a], axis=-1)
+
+        w_ref, l_ref = ref.critic_update_local(
+            tf.constant(s), tf.constant(ns), tf.constant(r)
+        )
+        w_my, l_my = ours.critic_update_local(s, ns, r)
+        _assert_weights_close(w_my, w_ref)
+        np.testing.assert_allclose(l_my, l_ref, rtol=1e-3)
+
+        w_ref, l_ref = ref.TR_update_local(tf.constant(sa), tf.constant(r))
+        w_my, l_my = ours.TR_update_local(sa, r)
+        _assert_weights_close(w_my, w_ref)
+        np.testing.assert_allclose(l_my, l_ref, rtol=1e-3)
+
+        # fits PERSISTED on both sides
+        _assert_weights_close(
+            [w for pair in ours.critic for w in pair], ref.critic.get_weights()
+        )
+
+    def test_malicious_private_and_compromised(self):
+        from rcmarl_tpu.agents import ReferenceMaliciousAgent
+
+        ref, ours = _adv_pair(
+            ReferenceMaliciousAgent, REF_MALICIOUS, fast_lr=FAST_LR
+        )
+        rng = np.random.default_rng(6)
+        s, ns, a, r_coop = _batch(rng)
+        sa = np.concatenate([s, a], axis=-1)
+
+        # private critic fit persists to critic_local_weights only
+        ref.critic_update_local(tf.constant(s), tf.constant(ns), tf.constant(r_coop))
+        ours.critic_update_local(s, ns, r_coop)
+        _assert_weights_close(ours.critic_local_weights, ref.critic_local_weights)
+        _assert_weights_close(
+            [w for pair in ours.critic for w in pair], ref.critic.get_weights()
+        )
+
+        # compromised fits toward -r_coop persist and are transmitted
+        w_ref, l_ref = ref.critic_update_compromised(
+            tf.constant(s), tf.constant(ns), tf.constant(-r_coop)
+        )
+        w_my, l_my = ours.critic_update_compromised(s, ns, -r_coop)
+        _assert_weights_close(w_my, w_ref)
+        np.testing.assert_allclose(l_my, l_ref, rtol=1e-3)
+
+        w_ref, l_ref = ref.TR_update_compromised(tf.constant(sa), tf.constant(-r_coop))
+        w_my, l_my = ours.TR_update_compromised(sa, -r_coop)
+        _assert_weights_close(w_my, w_ref)
+        np.testing.assert_allclose(l_my, l_ref, rtol=1e-3)
+
+        # 4-entry parameter export incl. the private critic
+        assert len(ours.get_parameters()) == len(ref.get_parameters()) == 4
+
+    def test_malicious_actor_uses_private_critic(self):
+        from rcmarl_tpu.agents import ReferenceMaliciousAgent
+
+        ref, ours = _adv_pair(
+            ReferenceMaliciousAgent, REF_MALICIOUS, seed=7, fast_lr=FAST_LR
+        )
+        rng = np.random.default_rng(7)
+        s, ns, a, r = _batch(rng)
+        a_local = a[:, 0, :]
+        # diverge the private critic from the compromised one first
+        ref.critic_update_local(tf.constant(s), tf.constant(ns), tf.constant(r))
+        ours.critic_update_local(s, ns, r)
+
+        ref.actor_update(
+            tf.constant(s), tf.constant(ns), tf.constant(r), tf.constant(a_local)
+        )
+        ours.actor_update(s, ns, r, a_local)
+        _assert_weights_close(
+            [w for pair in ours.actor for w in pair],
+            ref.actor.get_weights(),
+            rtol=2e-4,
+            atol=2e-5,
+        )
+
+
+def test_twin_construction_consumes_no_global_numpy_draws():
+    """The reference constructors draw nothing from np.random; the twins
+    must not either, or seeded scripts' get_action streams would shift."""
+    from rcmarl_tpu.agents import (
+        ReferenceFaultyAgent,
+        ReferenceGreedyAgent,
+        ReferenceMaliciousAgent,
+        ReferenceRPBCACAgent,
+    )
+
+    def flat(out_dim):
+        return [
+            np.zeros((N_AGENTS * N_STATES, 20), np.float32), np.zeros(20, np.float32),
+            np.zeros((20, 20), np.float32), np.zeros(20, np.float32),
+            np.zeros((20, out_dim), np.float32), np.zeros(out_dim, np.float32),
+        ]
+
+    np.random.seed(9)
+    expected = np.random.randint(0, 10**6)
+    np.random.seed(9)
+    ReferenceRPBCACAgent(flat(N_ACTIONS), flat(1), flat(1), SLOW_LR, FAST_LR)
+    ReferenceFaultyAgent(flat(N_ACTIONS), flat(1), flat(1), SLOW_LR)
+    ReferenceGreedyAgent(flat(N_ACTIONS), flat(1), flat(1), SLOW_LR, FAST_LR)
+    ReferenceMaliciousAgent(flat(N_ACTIONS), flat(1), flat(1), SLOW_LR, FAST_LR)
+    assert np.random.randint(0, 10**6) == expected
