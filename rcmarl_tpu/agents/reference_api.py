@@ -303,6 +303,12 @@ class _ReferenceAdversaryBase:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def actor_update(self, s, ns, r_local, a_local):
+        """Local-TD actor fit off the agent's own critic
+        (``adversarial_CAC_agents.py:28-43,211-226``); the malicious twin
+        overrides this to use its private critic."""
+        return self._actor_fit(self.critic, s, ns, r_local, a_local)
+
     def _actor_fit(self, critic: MLPParams, s, ns, r_local, a_local) -> float:
         """Local-TD-weighted actor fit shared by all three adversaries
         (``adversarial_CAC_agents.py:28-43,102-119,211-226``)."""
@@ -329,12 +335,13 @@ class ReferenceFaultyAgent(_ReferenceAdversaryBase):
     trains only its actor on its own reward and transmits its FROZEN
     critic/TR weights — a crash-like fault."""
 
-    def __init__(self, actor, critic, team_reward, slow_lr, gamma=0.95):
+    def __init__(self, actor, critic, team_reward, slow_lr, gamma=0.95,
+                 shuffle_seed: int = 0):
         # the reference's faulty agent takes no fast_lr: nothing fits
-        super().__init__(actor, critic, team_reward, slow_lr, 0.0, gamma)
-
-    def actor_update(self, s, ns, r_local, a_local):
-        return self._actor_fit(self.critic, s, ns, r_local, a_local)
+        super().__init__(
+            actor, critic, team_reward, slow_lr, 0.0, gamma,
+            shuffle_seed=shuffle_seed,
+        )
 
     def get_critic_weights(self):
         """(``adversarial_CAC_agents.py:45-49``)"""
@@ -350,11 +357,12 @@ class ReferenceGreedyAgent(_ReferenceAdversaryBase):
     trains critic/TR on its OWN reward (persisting), transmits them, and
     never applies consensus."""
 
-    def __init__(self, actor, critic, team_reward, slow_lr, fast_lr, gamma=0.95):
-        super().__init__(actor, critic, team_reward, slow_lr, fast_lr, gamma)
-
-    def actor_update(self, s, ns, r_local, a_local):
-        return self._actor_fit(self.critic, s, ns, r_local, a_local)
+    def __init__(self, actor, critic, team_reward, slow_lr, fast_lr, gamma=0.95,
+                 shuffle_seed: int = 0):
+        super().__init__(
+            actor, critic, team_reward, slow_lr, fast_lr, gamma,
+            shuffle_seed=shuffle_seed,
+        )
 
     def critic_update_local(self, s, ns, r_local):
         """PERSISTING own-reward critic fit; returns (weights, loss)
@@ -380,8 +388,12 @@ class ReferenceMaliciousAgent(_ReferenceAdversaryBase):
     its actor, while the transmitted critic/TR are trained toward the
     NEGATED cooperative reward — Byzantine poisoning."""
 
-    def __init__(self, actor, critic, team_reward, slow_lr, fast_lr, gamma=0.95):
-        super().__init__(actor, critic, team_reward, slow_lr, fast_lr, gamma)
+    def __init__(self, actor, critic, team_reward, slow_lr, fast_lr, gamma=0.95,
+                 shuffle_seed: int = 0):
+        super().__init__(
+            actor, critic, team_reward, slow_lr, fast_lr, gamma,
+            shuffle_seed=shuffle_seed,
+        )
         # private critic starts as a copy of the compromised one
         # (adversarial_CAC_agents.py:99)
         self.critic_local_weights = _flat(self.critic)

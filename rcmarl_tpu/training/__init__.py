@@ -24,3 +24,4 @@ from rcmarl_tpu.training.update import (  # noqa: F401
     team_average_reward,
     update_block,
 )
+from rcmarl_tpu.training.reference_api import train_RPBCAC  # noqa: F401

@@ -1,0 +1,195 @@
+"""Drop-in twin of the reference's training entry point.
+
+``train_RPBCAC(env, agents, args, exp_buffer=None)`` is the reference's
+only trainer API (``training/train_agents.py:15-184``); together with
+the environment twin (:class:`rcmarl_tpu.envs.ReferenceGridWorld`) and
+the four agent-object twins (:mod:`rcmarl_tpu.agents.reference_api`)
+this completes the compat surface: the reference's ENTIRE program —
+``main.py``'s wiring included — can run unchanged on this framework's
+numerics.
+
+Semantics mirrored exactly (SURVEY.md §3.2-§3.3): per-step ε-mixed
+actions from each agent in node order (global-NumPy draws), growing
+replay lists warm-startable via ``exp_buffer``, the
+``i == n_ep_fixed-1 and j == max_ep_len`` update trigger, the
+I→II→III→IV schedule with synchronous same-epoch weight exchange over
+``in_nodes``, actor updates on the fresh ``max_ep_len * n_ep_fixed``
+on-policy window, FIFO buffer trim AFTER updates, and the reference's
+per-episode metrics row (True/adv/Estimated returns).
+
+This path runs the object protocol eagerly — it exists for migration
+fidelity and is golden-tested against the reference loop run under TF;
+:func:`rcmarl_tpu.training.trainer.train` is the fused TPU path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from rcmarl_tpu.models.mlp import mlp_forward
+
+__all__ = ["train_RPBCAC"]
+
+
+def train_RPBCAC(env, agents, args, exp_buffer=None):
+    """Train a mixed cooperative/adversarial network of agent twins.
+
+    Args:
+      env: a :class:`~rcmarl_tpu.envs.ReferenceGridWorld` (or any object
+        with the reference env protocol).
+      agents: list of agent twins matching ``args['agent_label']``.
+      args: the reference's parameter dict (``train_agents.py:28-33``
+        reads n_states, gamma, in_nodes, max_ep_len, n_episodes,
+        n_ep_fixed, n_epochs, batch_size, buffer_size, agent_label,
+        common_reward).
+      exp_buffer: optional (states, nstates, actions, rewards) lists to
+        warm-start the replay buffer (``train_agents.py:36-40``).
+
+    Returns:
+      (weights, sim_data): per-agent ``get_parameters()`` lists and the
+      reference-layout pandas DataFrame.
+    """
+    labels = args["agent_label"]
+    n_agents = env.n_agents
+    n_coop = labels.count("Cooperative")
+    gamma = args["gamma"]
+    in_nodes = args["in_nodes"]
+    max_ep_len, n_episodes = args["max_ep_len"], args["n_episodes"]
+    n_ep_fixed, n_epochs = args["n_ep_fixed"], args["n_epochs"]
+    buffer_size = args["buffer_size"]
+    common_reward = args.get("common_reward", False)
+    verbose = args.get("verbose", True)
+
+    if exp_buffer:
+        states, nstates, actions, rewards = exp_buffer
+    else:
+        states, nstates, actions, rewards = [], [], [], []
+
+    coop_idx = [i for i, l in enumerate(labels) if l == "Cooperative"]
+    paths = []
+    for t in range(n_episodes):
+        i = t % n_ep_fixed
+        env.reset()
+        state, _ = env.get_data()
+        # cooperative critics' value estimate at s0 (train_agents.py:60-62)
+        est_returns = [
+            float(mlp_forward(agents[node].critic, np.asarray(state)[None])[0, 0])
+            for node in coop_idx
+        ]
+
+        ep_returns = np.zeros(n_agents)
+        action = np.zeros(n_agents)
+        actor_loss = np.zeros(n_agents)
+        critic_loss = np.zeros(n_agents)
+        tr_loss = np.zeros(n_agents)
+        for j in range(max_ep_len):
+            obs = np.asarray(state)[None]
+            for node in range(n_agents):
+                action[node] = agents[node].get_action(obs)
+            env.step(action)
+            nstate, reward = env.get_data()
+            ep_returns = ep_returns + reward * (gamma**j)
+            states.append(np.array(state))
+            nstates.append(np.array(nstate))
+            actions.append(np.array(action).reshape(-1, 1))
+            rewards.append(np.array(reward).reshape(-1, 1))
+            state = np.array(nstate)
+
+        if i == n_ep_fixed - 1:
+            s = np.asarray(states, np.float32)
+            ns = np.asarray(nstates, np.float32)
+            a = np.asarray(actions, np.float32)
+            r = np.asarray(rewards, np.float32)
+            sa = np.concatenate([s, a], axis=-1)
+            # (T, 1) even with zero cooperative agents (the reference
+            # builds tf.zeros and accumulates, train_agents.py:96-98)
+            r_coop = np.zeros((r.shape[0], r.shape[2]), np.float32)
+            for node in coop_idx:
+                r_coop += r[:, node] / n_coop
+
+            for _ in range(n_epochs):
+                # I) local updates -> the transmitted messages
+                critic_weights, tr_weights = [], []
+                for node in range(n_agents):
+                    ag, lab = agents[node], labels[node]
+                    r_applied = r_coop if common_reward else r[:, node]
+                    if lab == "Cooperative":
+                        x, tr_loss[node] = ag.TR_update_local(sa, r_applied)
+                        y, critic_loss[node] = ag.critic_update_local(
+                            s, ns, r_applied
+                        )
+                    elif lab == "Greedy":
+                        x, tr_loss[node] = ag.TR_update_local(sa, r[:, node])
+                        y, critic_loss[node] = ag.critic_update_local(
+                            s, ns, r[:, node]
+                        )
+                    elif lab == "Malicious":
+                        ag.critic_update_local(s, ns, r[:, node])
+                        x, tr_loss[node] = ag.TR_update_compromised(sa, -r_coop)
+                        y, critic_loss[node] = ag.critic_update_compromised(
+                            s, ns, -r_coop
+                        )
+                    else:  # Faulty: frozen messages
+                        x = ag.get_TR_weights()
+                        y = ag.get_critic_weights()
+                    tr_weights.append(x)
+                    critic_weights.append(y)
+                # II) resilient consensus, cooperative agents only —
+                # synchronous exchange of THIS epoch's messages
+                for node in coop_idx:
+                    ag = agents[node]
+                    c_in = [critic_weights[k] for k in in_nodes[node]]
+                    t_in = [tr_weights[k] for k in in_nodes[node]]
+                    ag.resilient_consensus_critic_hidden(c_in)
+                    ag.resilient_consensus_TR_hidden(t_in)
+                    critic_agg = ag.resilient_consensus_critic(s, c_in)
+                    tr_agg = ag.resilient_consensus_TR(sa, t_in)
+                    ag.critic_update_team(s, critic_agg)
+                    ag.TR_update_team(sa, tr_agg)
+
+            # III) actor updates over the fresh on-policy window
+            w = max_ep_len * n_ep_fixed
+            for node in range(n_agents):
+                if labels[node] == "Cooperative":
+                    actor_loss[node] = agents[node].actor_update(
+                        s[-w:], ns[-w:], sa[-w:], a[-w:, node]
+                    )
+                else:
+                    actor_loss[node] = agents[node].actor_update(
+                        s[-w:], ns[-w:], r[-w:, node], a[-w:, node]
+                    )
+
+            # IV) FIFO trim AFTER the updates (train_agents.py:158-163)
+            if len(states) > buffer_size:
+                q = len(states) - buffer_size
+                del states[:q]
+                del nstates[:q]
+                del actions[:q]
+                del rewards[:q]
+
+        n_adv = n_agents - n_coop
+        mean_true = sum(ep_returns[k] for k in coop_idx) / max(n_coop, 1)
+        mean_true_adv = (
+            sum(ep_returns[k] for k in range(n_agents) if k not in coop_idx)
+            / n_adv
+            if n_adv
+            else 0.0
+        )
+        if verbose:
+            print(
+                f"| Episode: {t} | Est. returns: {est_returns} "
+                f"| Returns: {mean_true} | Average critic loss: {critic_loss} "
+                f"| Average TR loss: {tr_loss} | Average actor loss: {actor_loss} "
+            )
+        paths.append(
+            {
+                "True_team_returns": mean_true,
+                "True_adv_returns": mean_true_adv,
+                "Estimated_team_returns": float(np.mean(est_returns)),
+            }
+        )
+
+    sim_data = pd.DataFrame.from_dict(paths)
+    weights = [agent.get_parameters() for agent in agents]
+    return weights, sim_data

@@ -382,3 +382,122 @@ def test_twin_construction_consumes_no_global_numpy_draws():
     ReferenceGreedyAgent(flat(N_ACTIONS), flat(1), flat(1), SLOW_LR, FAST_LR)
     ReferenceMaliciousAgent(flat(N_ACTIONS), flat(1), flat(1), SLOW_LR, FAST_LR)
     assert np.random.randint(0, 10**6) == expected
+
+
+def _load_reference_trainer():
+    """Import the reference train_RPBCAC with gym stubbed (it only
+    imports gym for unused symbols)."""
+    import types
+
+    if "gym" not in sys.modules:
+        gym_stub = types.ModuleType("gym")
+        gym_stub.Env = type("Env", (), {})
+        gym_stub.spaces = types.ModuleType("gym.spaces")
+        sys.modules["gym"] = gym_stub
+        sys.modules["gym.spaces"] = gym_stub.spaces
+    sys.path.insert(0, "/root/reference")
+    try:
+        from training.train_agents import train_RPBCAC  # type: ignore
+
+        return train_RPBCAC
+    except Exception:
+        return None
+    finally:
+        sys.path.remove("/root/reference")
+
+
+REF_TRAIN = _load_reference_trainer()
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    REF_TRAIN is None or REF_GREEDY is None,
+    reason="reference trainer/agents not importable",
+)
+def test_full_program_golden_vs_reference_trainer(capsys):
+    """The capstone: the reference's ENTIRE training program — env,
+    agents, trainer — run twice from identical seeds and weights, once on
+    the reference TF stack and once on this framework's compat twins.
+    Identical global-RNG streams drive resets and action sampling, so the
+    two runs must produce matching sim_data and near-identical weights."""
+    from rcmarl_tpu.agents import ReferenceGreedyAgent
+    from rcmarl_tpu.envs import ReferenceGridWorld
+    from rcmarl_tpu.training import train_RPBCAC as my_train
+
+    sys.path.insert(0, "/root/reference")
+    try:
+        from environments.grid_world import Grid_World  # type: ignore
+    finally:
+        sys.path.remove("/root/reference")
+
+    labels = ["Cooperative"] * 4 + ["Greedy"]
+    args = {
+        "agent_label": labels,
+        "n_states": 2,
+        "gamma": GAMMA,
+        "in_nodes": [[0, 1, 2, 3], [1, 2, 3, 4], [2, 3, 4, 0], [3, 4, 0, 1], [4, 0, 1, 2]],
+        "max_ep_len": 4,
+        "n_episodes": 4,
+        "n_ep_fixed": 2,
+        "n_epochs": 1,
+        "batch_size": 200,
+        "buffer_size": 16,
+        "common_reward": False,
+        "verbose": False,
+    }
+    desired = np.array([[0, 1], [2, 2], [4, 0], [1, 3], [3, 4]])
+
+    def build_agents(twin: bool):
+        keras.utils.set_random_seed(0)
+        out = []
+        for node, lab in enumerate(labels):
+            models = (
+                _keras_model(N_STATES, N_ACTIONS, softmax=True),
+                _keras_model(N_STATES, 1, softmax=False),
+                _keras_model(N_STATES + 1, 1, softmax=False),
+            )
+            if lab == "Cooperative":
+                if twin:
+                    out.append(ReferenceRPBCACAgent(
+                        *(m.get_weights() for m in models),
+                        slow_lr=SLOW_LR, fast_lr=FAST_LR, gamma=GAMMA, H=H,
+                    ))
+                else:
+                    out.append(REF_AGENT(*models, slow_lr=SLOW_LR,
+                                         fast_lr=FAST_LR, gamma=GAMMA, H=H))
+            else:
+                if twin:
+                    out.append(ReferenceGreedyAgent(
+                        *(m.get_weights() for m in models),
+                        slow_lr=SLOW_LR, fast_lr=FAST_LR, gamma=GAMMA,
+                    ))
+                else:
+                    out.append(REF_GREEDY(*models, slow_lr=SLOW_LR,
+                                          fast_lr=FAST_LR, gamma=GAMMA))
+        return out
+
+    # reference run
+    np.random.seed(77)
+    ref_env = Grid_World(nrow=5, ncol=5, n_agents=5,
+                         desired_state=desired, scaling=True)
+    ref_w, ref_data = REF_TRAIN(ref_env, build_agents(twin=False), args)
+    capsys.readouterr()  # swallow the reference's per-episode prints
+
+    # twin run, identical streams
+    np.random.seed(77)
+    my_env = ReferenceGridWorld(nrow=5, ncol=5, n_agents=5,
+                                desired_state=desired, scaling=True)
+    my_w, my_data = my_train(my_env, build_agents(twin=True), args)
+
+    np.testing.assert_allclose(
+        my_data["True_team_returns"], ref_data["True_team_returns"],
+        rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        my_data["Estimated_team_returns"], ref_data["Estimated_team_returns"],
+        rtol=1e-3, atol=1e-4,
+    )
+    # final weights: every agent, every network, every array
+    for mine_agent, ref_agent in zip(my_w, ref_w):
+        for mine_net, ref_net in zip(mine_agent, ref_agent):
+            _assert_weights_close(mine_net, ref_net, rtol=5e-3, atol=5e-4)
