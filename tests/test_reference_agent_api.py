@@ -501,3 +501,60 @@ def test_full_program_golden_vs_reference_trainer(capsys):
     for mine_agent, ref_agent in zip(my_w, ref_w):
         for mine_net, ref_net in zip(mine_agent, ref_agent):
             _assert_weights_close(mine_net, ref_net, rtol=5e-3, atol=5e-4)
+
+
+def test_trainer_twin_exp_buffer_warm_start():
+    """train_RPBCAC's exp_buffer warm-start (reference train_agents.py:
+    36-40): pre-seeded experience participates in the first update window
+    and is FIFO-trimmed after it."""
+    from rcmarl_tpu.agents import ReferenceRPBCACAgent
+    from rcmarl_tpu.envs import ReferenceGridWorld
+    from rcmarl_tpu.models.mlp import init_mlp
+    from rcmarl_tpu.training import train_RPBCAC
+    import jax
+
+    def flat_init(key, in_dim, out):
+        params = init_mlp(key, in_dim, (20, 20), out)
+        return [np.asarray(x) for wb in params for x in wb]
+
+    n, keys = 3, jax.random.split(jax.random.PRNGKey(0), 9)
+    agents = [
+        ReferenceRPBCACAgent(
+            flat_init(keys[3 * i], n * 2, 5),
+            flat_init(keys[3 * i + 1], n * 2, 1),
+            flat_init(keys[3 * i + 2], n * 3, 1),
+            slow_lr=SLOW_LR, fast_lr=FAST_LR, gamma=GAMMA, H=1,
+        )
+        for i in range(n)
+    ]
+    args = {
+        "agent_label": ["Cooperative"] * n,
+        "n_states": 2,
+        "gamma": GAMMA,
+        "in_nodes": [[0, 1, 2], [1, 2, 0], [2, 0, 1]],
+        "max_ep_len": 3,
+        "n_episodes": 2,
+        "n_ep_fixed": 2,
+        "n_epochs": 1,
+        "batch_size": 200,
+        "buffer_size": 8,
+        "common_reward": False,
+        "verbose": False,
+    }
+    desired = np.array([[0, 1], [2, 2], [4, 0]])
+    np.random.seed(1)
+    env = ReferenceGridWorld(nrow=5, ncol=5, n_agents=n,
+                             desired_state=desired, scaling=True)
+    # warm-start with 4 synthetic steps; the lists are mutated in place
+    pre = 4
+    rng = np.random.default_rng(2)
+    buf = (
+        [rng.normal(size=(n, 2)).astype(np.float32) for _ in range(pre)],
+        [rng.normal(size=(n, 2)).astype(np.float32) for _ in range(pre)],
+        [rng.integers(0, 5, size=(n, 1)).astype(np.float32) for _ in range(pre)],
+        [rng.normal(size=(n, 1)).astype(np.float32) for _ in range(pre)],
+    )
+    _, sim_data = train_RPBCAC(env, agents, args, exp_buffer=buf)
+    assert len(sim_data) == 2
+    # 4 warm + 6 new = 10 > buffer_size 8 -> trimmed to 8 after the update
+    assert len(buf[0]) == 8
