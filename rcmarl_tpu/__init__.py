@@ -16,6 +16,19 @@ actor updates — runs as vmapped/jitted XLA programs. Independent
 training seeds are vmapped/sharded across TPU cores.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
-from rcmarl_tpu.config import Config, Roles, circulant_in_nodes  # noqa: F401
+from rcmarl_tpu.config import (  # noqa: F401
+    Config,
+    Roles,
+    circulant_in_nodes,
+    full_in_nodes,
+)
+
+# Heavier layers (jax-compiled trainers, the reference compat twins) are
+# imported lazily so `import rcmarl_tpu` stays cheap; the canonical entry
+# points are re-exported here for discoverability:
+#   rcmarl_tpu.training.train / train_RPBCAC
+#   rcmarl_tpu.parallel.train_parallel
+#   rcmarl_tpu.agents.Reference{RPBCAC,Faulty,Greedy,Malicious}Agent
+#   rcmarl_tpu.envs.GridWorld / ReferenceGridWorld
