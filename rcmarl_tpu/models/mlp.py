@@ -31,7 +31,9 @@ MLPParams = Tuple[Tuple[jnp.ndarray, jnp.ndarray], ...]
 # This JAX build's default matmul precision is bf16-class even on CPU
 # (~1e-3 relative error). The reference is pure fp32; curve parity and the
 # golden tests require true fp32 dots. These models are tiny (20-wide), so
-# HIGHEST costs nothing — revisit only for the 256-wide BASELINE config.
+# HIGHEST costs nothing at reference scale; the 256-wide BASELINE config
+# opts into MXU-native inputs via Config(compute_dtype='bfloat16') (the
+# dtype parameter below).
 PRECISION = jax.lax.Precision.HIGHEST
 
 
