@@ -11,7 +11,7 @@ import numpy as np
 import pytest
 
 from rcmarl_tpu.agents.updates import Batch
-from rcmarl_tpu.config import Config, Roles
+from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
 from rcmarl_tpu.training import (
     buffer_init,
     buffer_push_block,
@@ -378,3 +378,26 @@ class TestHeterogeneousGraph:
         )
         for a, b in zip(jax.tree.leaves(fast), jax.tree.leaves(masked)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_training_actually_learns():
+    """End-to-end learning check (not semantics — those are golden-pinned
+    elsewhere): on an easy 3-agent 3x3 cooperative task, 300 episodes of
+    the fused trainer must lift the mean team return materially.
+    Margin calibrated at ~1/3 of the observed improvement (+1.0 to +1.4
+    across seeds) so seed noise cannot flip it."""
+    cfg = Config(
+        n_agents=3,
+        agent_roles=(0, 0, 0),
+        in_nodes=circulant_in_nodes(3, 3),
+        nrow=3,
+        ncol=3,
+        slow_lr=0.01,
+        n_episodes=300,
+        n_ep_fixed=25,
+        seed=3,
+    )
+    _, sim = train(cfg, verbose=False)
+    r = sim["True_team_returns"]
+    assert r[-50:].mean() - r[:50].mean() > 0.4
