@@ -558,3 +558,48 @@ def test_trainer_twin_exp_buffer_warm_start():
     assert len(sim_data) == 2
     # 4 warm + 6 new = 10 > buffer_size 8 -> trimmed to 8 after the update
     assert len(buf[0]) == 8
+
+
+def test_twin_exports_roundtrip_into_fused_trainer():
+    """The compat boundary closes a full circle: weights exported by the
+    twins (reference pretrained_weights format) import losslessly into
+    the fused stacked-trainer's parameters via the same path that loads
+    the reference's real artifacts."""
+    from rcmarl_tpu.agents import ReferenceRPBCACAgent
+    from rcmarl_tpu.config import Config
+    from rcmarl_tpu.models.mlp import init_mlp
+    from rcmarl_tpu.training.trainer import init_train_state
+    from rcmarl_tpu.utils.checkpoint import import_reference_weights
+    import jax
+
+    n = 3
+    cfg = Config(
+        n_agents=n, agent_roles=(0,) * n,
+        in_nodes=((0, 1, 2), (1, 2, 0), (2, 0, 1)), H=1,
+    )
+
+    def flat_init(key, in_dim, out):
+        return [np.asarray(x) for wb in init_mlp(key, in_dim, (20, 20), out)
+                for x in wb]
+
+    keys = jax.random.split(jax.random.PRNGKey(5), 3 * n)
+    twins = [
+        ReferenceRPBCACAgent(
+            flat_init(keys[3 * i], cfg.obs_dim, cfg.n_actions),
+            flat_init(keys[3 * i + 1], cfg.obs_dim, 1),
+            flat_init(keys[3 * i + 2], cfg.sa_dim, 1),
+            slow_lr=0.002, fast_lr=0.01, gamma=0.9, H=1,
+        )
+        for i in range(n)
+    ]
+    exported = np.asarray([t.get_parameters() for t in twins], dtype=object)
+
+    state = init_train_state(cfg, jax.random.PRNGKey(99))  # different init
+    params = import_reference_weights(exported, cfg, state.params)
+    # agent 1's critic W1 in the stacked pytree == twin 1's export
+    np.testing.assert_array_equal(
+        np.asarray(params.critic[0][0][1]), twins[1].get_parameters()[1][0]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(params.actor[-1][1][2]), twins[2].get_parameters()[0][-1]
+    )
