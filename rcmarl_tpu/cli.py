@@ -869,6 +869,11 @@ def _related_artifacts_section(summary_out, out_dir) -> str:
         ("simulation_results/figures", "curve figures incl. `drift_*.png` overlays"),
         ("BENCH_SHARD.jsonl", "agent-sharding wall-clock A/B (PARALLELISM.md)"),
         ("BENCH_SCALING.jsonl", "scaling matrix incl. xla-vs-pallas consensus"),
+        (
+            "PARITY_SEEDS456.md",
+            "the same pipeline over three UNSEEN seeds {400,500,600} "
+            "(robustness check, DRIFT.md)",
+        ),
     ]
     lines = [
         f"- `{p}` — {desc}"
