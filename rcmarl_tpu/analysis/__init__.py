@@ -6,5 +6,13 @@ from rcmarl_tpu.analysis.plots import (
     load_run,
     plot_returns,
 )
+from rcmarl_tpu.analysis.quality import episodes_to_threshold, quality_table
 
-__all__ = ["aggregate_scenario", "final_returns", "load_run", "plot_returns"]
+__all__ = [
+    "aggregate_scenario",
+    "final_returns",
+    "load_run",
+    "plot_returns",
+    "episodes_to_threshold",
+    "quality_table",
+]

@@ -874,6 +874,12 @@ def _related_artifacts_section(summary_out, out_dir) -> str:
             "the same pipeline over three UNSEEN seeds {400,500,600} "
             "(robustness check, DRIFT.md)",
         ),
+        (
+            "QUALITY.md",
+            "episodes-to-return-threshold matrix (BASELINE.json's second "
+            "metric): episodes and wall-clock to reach the reference's "
+            "converged returns, `python -m rcmarl_tpu quality`",
+        ),
     ]
     lines = [
         f"- `{p}` — {desc}"
@@ -979,6 +985,60 @@ def cmd_parity(argv) -> int:
     return 0
 
 
+def cmd_quality(argv) -> int:
+    p = argparse.ArgumentParser(
+        prog="rcmarl_tpu quality",
+        description="Regenerate QUALITY.md: episodes (and wall-clock) to "
+        "reach the reference's converged returns — BASELINE.json's "
+        "'episodes-to-return-threshold' metric, both sides computed from "
+        "the same artifact trees as PARITY.md",
+    )
+    from rcmarl_tpu.analysis.plots import DEFAULT_REF_RAW_DATA
+
+    p.add_argument("--raw_data", type=str, default="./simulation_results/raw_data")
+    p.add_argument("--ref_raw_data", type=str, default=DEFAULT_REF_RAW_DATA)
+    p.add_argument("--out", type=str, default="./QUALITY.md")
+    p.add_argument(
+        "--bench_jsonl",
+        type=str,
+        default="./BENCH_SCALING.jsonl",
+        help="measured production-block rows backing the wall-clock columns",
+    )
+    p.add_argument("--window", type=int, default=500)
+    p.add_argument("--tolerance", type=float, default=0.05)
+    p.add_argument("--rolling", type=int, default=200)
+    args = p.parse_args(argv)
+
+    from rcmarl_tpu.analysis.quality import (
+        episode_throughput_from_bench,
+        quality_table,
+        write_quality_md,
+    )
+
+    table = quality_table(
+        args.raw_data,
+        args.ref_raw_data,
+        window=args.window,
+        tol=args.tolerance,
+        rolling=args.rolling,
+    )
+    throughput = episode_throughput_from_bench(args.bench_jsonl)
+    write_quality_md(
+        table,
+        args.out,
+        throughput,
+        window=args.window,
+        tol=args.tolerance,
+        rolling=args.rolling,
+        mine_dir=args.raw_data,
+        ref_dir=args.ref_raw_data,
+        bench_jsonl=args.bench_jsonl,
+    )
+    print(table.to_string(index=False))
+    print(f"wrote {args.out}")
+    return 0
+
+
 def _honor_platform_env() -> None:
     """Make an explicit ``JAX_PLATFORMS=cpu`` stick.
 
@@ -1012,6 +1072,7 @@ def main(argv=None) -> int:
         "bench": cmd_bench,
         "profile": cmd_profile,
         "parity": cmd_parity,
+        "quality": cmd_quality,
     }
     if not argv or argv[0] in ("-h", "--help"):
         print(f"usage: python -m rcmarl_tpu {{{','.join(cmds)}}} [flags]")

@@ -1,0 +1,309 @@
+"""Tests for the episodes-to-quality analysis (analysis/quality.py).
+
+BASELINE.json's second metric ("episodes-to-return-threshold") must be
+computed, not asserted: these tests pin the crossing-detection math on
+synthetic curves with known crossings, the threshold convention
+(within-tol of a NEGATIVE converged return), the bench-row selection
+behind the wall-clock columns, and the QUALITY.md generator end-to-end
+on a synthetic two-tree layout.
+"""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from rcmarl_tpu.analysis.quality import (
+    episode_throughput_from_bench,
+    episodes_to_threshold,
+    quality_table,
+    write_quality_md,
+)
+
+
+def _write_run(run_dir, curve, phases: int = 1):
+    """Write a sim_data phase tree for one seed with the given team curve."""
+    run_dir.mkdir(parents=True, exist_ok=True)
+    splits = np.array_split(np.asarray(curve, np.float64), phases)
+    for i, part in enumerate(splits, start=1):
+        pd.DataFrame(
+            {
+                "True_team_returns": part,
+                "True_adv_returns": np.zeros_like(part),
+                "Estimated_team_returns": part,
+            }
+        ).to_pickle(run_dir / f"sim_data{i}.pkl")
+
+
+class TestEpisodesToThreshold:
+    def test_known_crossing(self):
+        curve = pd.Series(np.linspace(-10.0, 0.0, 101))  # hits -5 at idx 50
+        assert episodes_to_threshold(curve, -5.0) == 50
+
+    def test_never_reached(self):
+        curve = pd.Series(np.full(100, -8.0))
+        assert np.isnan(episodes_to_threshold(curve, -5.0))
+
+    def test_first_crossing_wins(self):
+        # noisy dip back below the threshold after the first touch does
+        # not move the crossing
+        curve = pd.Series([-9.0, -4.0, -6.0, -4.0])
+        assert episodes_to_threshold(curve, -5.0) == 1
+
+
+class TestQualityTable:
+    @pytest.fixture()
+    def trees(self, tmp_path):
+        """Reference converges to -5.0 slowly; ours reaches it earlier."""
+        ref = tmp_path / "ref"
+        mine = tmp_path / "mine"
+        n = 1000
+        # linear approach to the plateau, then flat
+        ref_curve = np.concatenate(
+            [np.linspace(-9.0, -5.0, 800), np.full(200, -5.0)]
+        )
+        mine_curve = np.concatenate(
+            [np.linspace(-9.0, -5.0, 400), np.full(600, -5.0)]
+        )
+        for seed in (100, 200):
+            _write_run(ref / "coop" / "H=0" / f"seed={seed}", ref_curve, 2)
+            _write_run(mine / "coop" / "H=0" / f"seed={seed}", mine_curve, 2)
+        assert len(ref_curve) == len(mine_curve) == n
+        return mine, ref
+
+    def test_crossing_order_and_threshold(self, trees):
+        mine, ref = trees
+        table = quality_table(mine, ref, window=200, tol=0.05, rolling=1)
+        assert list(table.scenario) == ["coop"] and list(table.H) == [0]
+        row = table.iloc[0]
+        # converged ref mean = -5.0, threshold 5% below: -5.25
+        assert row.ref_final == pytest.approx(-5.0)
+        assert row.threshold == pytest.approx(-5.25)
+        # ours crosses -5.25 at 400 * (9-5.25)/(9-5) = 375; ref at 750
+        assert row.ep_mine == pytest.approx(375, abs=2)
+        assert row.ep_ref == pytest.approx(750, abs=2)
+        assert row.ep_ratio == pytest.approx(2.0, rel=0.02)
+
+    def test_missing_mine_cell_is_nan(self, trees, tmp_path):
+        _, ref = trees
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        table = quality_table(empty, ref, window=200, tol=0.05, rolling=1)
+        assert np.isnan(table.iloc[0].ep_mine)
+        assert np.isnan(table.iloc[0].ep_ratio)
+        assert np.isfinite(table.iloc[0].ep_ref)
+
+    def test_rolling_smoothing_applied(self, tmp_path):
+        """A single-episode spike must not count as reaching quality
+        under a rolling window larger than the spike."""
+        ref = tmp_path / "ref"
+        mine = tmp_path / "mine"
+        base = np.full(600, -8.0)
+        ref_curve = base.copy()
+        ref_curve[-200:] = -5.0  # genuine convergence
+        spike = base.copy()
+        spike[100] = 0.0  # one-episode outlier
+        spike[-200:] = -5.0
+        _write_run(ref / "coop" / "H=0" / "seed=100", ref_curve)
+        _write_run(mine / "coop" / "H=0" / "seed=100", spike)
+        table = quality_table(mine, ref, window=100, tol=0.05, rolling=50)
+        # the spike averages to -7.84 over 50 episodes: no early crossing
+        assert table.iloc[0].ep_mine > 300
+        assert not table.iloc[0].degenerate
+
+    def test_full_window_required(self, tmp_path):
+        """The first `rolling` episodes cannot cross — a crossing needs a
+        fully-populated smoothing window (min_periods=rolling)."""
+        ref = tmp_path / "ref"
+        mine = tmp_path / "mine"
+        curve = np.full(300, -5.0)  # at threshold from episode 0
+        _write_run(ref / "coop" / "H=0" / "seed=100", curve)
+        _write_run(mine / "coop" / "H=0" / "seed=100", curve)
+        table = quality_table(mine, ref, window=100, tol=0.05, rolling=50)
+        row = table.iloc[0]
+        # earliest possible crossing is the first full window (index 49)
+        assert row.ep_ref == 49
+        assert row.ep_mine == 49
+
+    def test_mine_only_cell_appears_as_nan_row(self, trees, tmp_path):
+        """A cell swept locally with no reference counterpart must still
+        appear (all-NaN), not be silently dropped — and must render as
+        'no data', not as a sample-efficiency verdict."""
+        mine, ref = trees
+        _write_run(
+            mine / "newscen" / "H=1" / "seed=100", np.full(400, -5.0)
+        )
+        table = quality_table(mine, ref, window=200, tol=0.05, rolling=1)
+        row = table[(table.scenario == "newscen")]
+        assert len(row) == 1
+        assert np.isnan(row.iloc[0].threshold)
+        assert np.isnan(row.iloc[0].ep_mine)
+        assert not row.iloc[0].degenerate
+        assert row.iloc[0].ref_seeds == 0 and row.iloc[0].mine_seeds == 1
+
+        out = tmp_path / "Q.md"
+        write_quality_md(
+            table, out, {}, window=200, tol=0.05, rolling=1,
+            mine_dir=mine, ref_dir=ref, bench_jsonl="none.jsonl",
+        )
+        text = out.read_text()
+        newscen_line = next(l for l in text.splitlines() if "newscen" in l)
+        assert "no data" in newscen_line
+        assert "nan" not in newscen_line
+        # the summary denominator counts only cells WITH a threshold
+        assert "Of the 1 cells with a real learning signal" in text
+
+    def test_absent_mine_tree_renders_no_data(self, trees, tmp_path):
+        """A wrong --raw_data path must yield 'no data', never a false
+        'not reached' claim about sample efficiency."""
+        _, ref = trees
+        table = quality_table(
+            tmp_path / "typo_path", ref, window=200, tol=0.05, rolling=1
+        )
+        out = tmp_path / "Q.md"
+        write_quality_md(
+            table, out, {}, window=200, tol=0.05, rolling=1,
+            mine_dir="typo", ref_dir=ref, bench_jsonl="none.jsonl",
+        )
+        text = out.read_text()
+        table_rows = [l for l in text.splitlines() if l.startswith("| ")]
+        assert any("no data" in l for l in table_rows)
+        # the footnote legitimately mentions 'not reached'; no DATA row
+        # may claim it for an absent tree
+        assert not any("not reached" in l for l in table_rows)
+
+    def test_degenerate_boundary_is_exclusive(self, tmp_path):
+        """A reference crossing at smoothed index == rolling (one step
+        after the earliest possible) is genuine learning, NOT degenerate;
+        only index rolling-1 (at threshold from the start) is."""
+        ref = tmp_path / "ref"
+        mine = tmp_path / "mine"
+        rolling = 50
+        # at threshold from episode 1 onward: the full-window mean first
+        # clears the threshold at index `rolling`, not rolling-1
+        curve = np.full(300, -5.0)
+        curve[0] = -5.0 - 50 * (0.05 * 5.0 + 0.01)
+        _write_run(ref / "coop" / "H=0" / "seed=100", curve)
+        _write_run(mine / "coop" / "H=0" / "seed=100", curve)
+        table = quality_table(mine, ref, window=100, tol=0.05, rolling=rolling)
+        row = table.iloc[0]
+        assert row.ep_ref == rolling
+        assert not row.degenerate
+
+    def test_degenerate_cell_flagged(self, tmp_path):
+        """A cell whose reference curve starts at its own converged level
+        (the undefended H=0 attack cells) is flagged degenerate."""
+        ref = tmp_path / "ref"
+        mine = tmp_path / "mine"
+        flat = np.full(400, -7.0)  # no learning progress at all
+        learn = np.concatenate(
+            [np.linspace(-9.0, -7.0, 200), np.full(200, -7.0)]
+        )
+        _write_run(ref / "faulty" / "H=0" / "seed=100", flat)
+        _write_run(mine / "faulty" / "H=0" / "seed=100", learn)
+        table = quality_table(mine, ref, window=100, tol=0.05, rolling=50)
+        row = table.iloc[0]
+        assert row.degenerate
+        # a cell with genuine reference learning is NOT flagged
+        _write_run(ref / "coop" / "H=0" / "seed=100", learn)
+        _write_run(mine / "coop" / "H=0" / "seed=100", learn)
+        table = quality_table(mine, ref, window=100, tol=0.05, rolling=50)
+        coop = table[table.scenario == "coop"].iloc[0]
+        assert not coop.degenerate and coop.ep_ref > 50
+
+
+class TestThroughputRows:
+    def test_best_row_per_platform(self, tmp_path):
+        rows = [
+            {"config": "ref5_ring", "impl": "xla", "env_steps_per_sec": 11580.0,
+             "platform": "tpu", "timestamp": "t1"},
+            {"config": "ref5_ring", "impl": "pallas", "env_steps_per_sec": 6943.0,
+             "platform": "tpu", "timestamp": "t2"},
+            {"config": "ref5_ring", "impl": "xla", "env_steps_per_sec": 803.0,
+             "platform": "cpu", "timestamp": "t3"},
+            # different config, sharded-A/B, and reduced-precision rows
+            # must all be ignored (mixed-provenance wall-clock numbers)
+            {"config": "n64_ring", "impl": "xla", "env_steps_per_sec": 99999.0,
+             "platform": "tpu", "timestamp": "t4"},
+            {"config": "ref5_ring", "impl": "xla", "env_steps_per_sec": 99999.0,
+             "platform": "cpu", "shard_agents": True, "timestamp": "t5"},
+            {"config": "ref5_ring", "impl": "xla", "env_steps_per_sec": 99999.0,
+             "platform": "tpu", "compute_dtype": "bfloat16", "timestamp": "t6"},
+        ]
+        path = tmp_path / "bench.jsonl"
+        path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        best = episode_throughput_from_bench(path)
+        assert set(best) == {"tpu", "cpu"}
+        assert best["tpu"]["episodes_per_sec"] == pytest.approx(11580 / 20)
+        assert best["tpu"]["impl"] == "xla"
+        assert best["cpu"]["episodes_per_sec"] == pytest.approx(803 / 20)
+
+    def test_missing_file_is_empty(self, tmp_path):
+        assert episode_throughput_from_bench(tmp_path / "nope.jsonl") == {}
+
+
+class TestWriteQualityMd:
+    def test_artifact_renders(self, tmp_path):
+        table = pd.DataFrame(
+            [
+                {"scenario": "coop", "H": 0, "ref_final": -5.0,
+                 "threshold": -5.25, "ep_ref": 750.0, "ep_mine": 375.0,
+                 "ep_ratio": 2.0, "degenerate": False},
+                {"scenario": "greedy", "H": 0, "ref_final": -6.67,
+                 "threshold": -7.0, "ep_ref": 900.0,
+                 "ep_mine": float("nan"), "ep_ratio": float("nan"),
+                 "degenerate": False},
+                {"scenario": "malicious", "H": 0, "ref_final": -7.2,
+                 "threshold": -7.56, "ep_ref": 199.0, "ep_mine": 300.0,
+                 "ep_ratio": 0.66, "degenerate": True},
+            ]
+        )
+        throughput = {
+            "tpu": {"episodes_per_sec": 579.0, "impl": "xla",
+                    "timestamp": "t1"},
+        }
+        out = tmp_path / "QUALITY.md"
+        write_quality_md(
+            table, out, throughput, window=500, tol=0.05, rolling=200,
+            mine_dir="mine", ref_dir="ref", bench_jsonl="bench.jsonl",
+        )
+        text = out.read_text()
+        assert "do not edit result rows by hand" in text
+        # 750 episodes at 0.125 eps/s = 6000 s = 1.7 h
+        assert "1.7 h" in text
+        # 375 episodes at 579 eps/s < 1 s
+        assert "0.6 s" in text
+        assert "not reached" in text
+        # degenerate rows are marked and excluded from the summary line
+        assert "degenerate†" in text
+        assert "Of the 2 cells with a real learning signal, 1 are reached" in text
+        assert "median episode ratio 2.00" in text
+
+    def test_quality_cli_end_to_end(self, tmp_path, capsys):
+        """The subcommand wires trees + bench rows into QUALITY.md."""
+        from rcmarl_tpu.cli import main
+
+        ref = tmp_path / "ref"
+        mine = tmp_path / "mine"
+        curve = np.concatenate(
+            [np.linspace(-9.0, -5.0, 300), np.full(300, -5.0)]
+        )
+        _write_run(ref / "coop" / "H=1" / "seed=100", curve)
+        _write_run(mine / "coop" / "H=1" / "seed=100", curve)
+        bench = tmp_path / "b.jsonl"
+        bench.write_text(json.dumps(
+            {"config": "ref5_ring", "impl": "xla",
+             "env_steps_per_sec": 11580.0, "platform": "tpu",
+             "timestamp": "t"}) + "\n")
+        out = tmp_path / "QUALITY.md"
+        rc = main([
+            "quality", "--raw_data", str(mine), "--ref_raw_data", str(ref),
+            "--out", str(out), "--bench_jsonl", str(bench),
+            "--window", "100", "--rolling", "10",
+        ])
+        assert rc == 0
+        text = out.read_text()
+        # identical curves: both cross at the same episode, ratio 1.00
+        assert "| 1.00 |" in text
+        assert "coop" in text
