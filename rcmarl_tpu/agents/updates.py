@@ -46,7 +46,11 @@ from rcmarl_tpu.models.mlp import (
     mlp_forward,
     trunk_forward,
 )
-from rcmarl_tpu.ops.aggregation import resilient_aggregate, resilient_aggregate_tree
+from rcmarl_tpu.ops.aggregation import (
+    is_static_h,
+    resilient_aggregate,
+    resilient_aggregate_tree,
+)
 from rcmarl_tpu.ops.fit import fit_full_batch, fit_minibatch
 from rcmarl_tpu.ops.losses import weighted_mse, weighted_sparse_ce
 from rcmarl_tpu.ops.optim import AdamState, adam_update
@@ -67,6 +71,31 @@ class AgentParams(NamedTuple):
     tr: MLPParams
     critic_local: MLPParams
     actor_opt: AdamState
+
+
+class CellSpec(NamedTuple):
+    """One experiment cell's behavioral knobs as TRACED data.
+
+    The solo trainer specializes its program on ``Config`` at trace time
+    (roles/H/common_reward are compile-time constants; absent roles cost
+    nothing). This pytree is the alternative used by the fused-matrix
+    path (:mod:`rcmarl_tpu.parallel.matrix`): every field is an array,
+    so replicas with DIFFERENT scenarios — the reference's whole
+    scenario x H experiment matrix (``simulation_results/raw_data``
+    layout) — share ONE compiled program, vmapped over the cell axis.
+    Heterogeneous behavior then costs compute-all-then-mask across roles,
+    the trade SURVEY.md §7 endorses at these model sizes.
+
+    coop/greedy/malicious: (N,) bool role masks (faulty = none of the
+    three: it transmits frozen nets and needs no branch of its own).
+    H: () int32 trim parameter. common_reward: () bool.
+    """
+
+    coop: jnp.ndarray
+    greedy: jnp.ndarray
+    malicious: jnp.ndarray
+    H: jnp.ndarray
+    common_reward: jnp.ndarray
 
 
 class Batch(NamedTuple):
@@ -187,6 +216,7 @@ def consensus_update_one(
     mask: jnp.ndarray,
     cfg: Config,
     valid: jnp.ndarray | None = None,
+    H=None,
 ) -> MLPParams:
     """Full Phase-II update for ONE cooperative agent's critic or TR net.
 
@@ -212,11 +242,15 @@ def consensus_update_one(
          update; with Keras MSE + SUM_OVER_BATCH_SIZE the fast_lr cancels.
     """
     n_trunk = len(own) - 1
+    # traced H (the fused-matrix path) is XLA-only: the Pallas kernel
+    # fixes trim indices at lowering time (ops/aggregation.py)
+    H = cfg.H if H is None else H
+    impl = cfg.consensus_impl if is_static_h(H) else "xla"
     # b) hidden-layer consensus over trunk arrays
     trunk_agg = resilient_aggregate_tree(
         tuple(nbr_msgs[i] for i in range(n_trunk)),
-        cfg.H,
-        cfg.consensus_impl,
+        H,
+        impl,
         valid=valid,
     )
     new_params: MLPParams = tuple(trunk_agg) + (own[-1],)
@@ -225,7 +259,7 @@ def consensus_update_one(
     W_nbr, b_nbr = nbr_msgs[-1]  # (n_in, h, 1), (n_in, 1)
     proj = einsum("bh,nho->nbo", phi, W_nbr, dtype=cfg.dot_dtype)
     vals = proj + b_nbr[:, None, :]  # (n_in, B, 1)
-    agg = resilient_aggregate(vals, cfg.H, cfg.consensus_impl, valid=valid)  # (B, 1)
+    agg = resilient_aggregate(vals, H, impl, valid=valid)  # (B, 1)
     agg = jax.lax.stop_gradient(agg)
     # d) normalized team update of the head only
     new_head = team_head_update(new_params[-1], phi, agg, cfg, mask=mask)

@@ -319,6 +319,88 @@ def cmd_train(argv) -> int:
 # --------------------------------------------------------------------------
 
 
+def _run_phases(phases: int, train_fresh, train_resume, reset):
+    """The published multi-phase restart protocol, shared by the
+    sequential and fused sweeps: phase 1 trains fresh; each later phase
+    applies the restart boundary (weights + goal kept; Adam moments,
+    buffer, RNG reset) and resumes. The host fetch per phase is the
+    completion barrier (dispatch is async). Returns (host-side metrics
+    per phase, wall seconds)."""
+    t0 = time.perf_counter()
+    states, out = None, []
+    for _ in range(phases):
+        if states is None:
+            states, metrics = train_fresh()
+        else:
+            states, metrics = train_resume(reset(states))
+        out.append(type(metrics)(*(np.asarray(l) for l in metrics)))
+    return out, time.perf_counter() - t0
+
+
+def _write_sim_data(out_root, scen, H, seed, df, phase_no) -> None:
+    """One cell-seed-phase artifact in the reference raw_data layout."""
+    cell_dir = out_root / scen / f"H={H}" / f"seed={seed}"
+    cell_dir.mkdir(parents=True, exist_ok=True)
+    df.to_pickle(cell_dir / f"sim_data{phase_no}.pkl")
+
+
+def _sweep_fused(args, cell_config, cell_done, out_root) -> int:
+    """The whole scenario x H x seed matrix as ONE program per phase
+    (``sweep --fused``): cells become replicas with traced scenario knobs
+    (:mod:`rcmarl_tpu.parallel.matrix`), so the chip batches
+    n_cells x n_seeds replicas instead of running cells sequentially."""
+    from rcmarl_tpu.parallel.matrix import (
+        reset_matrix_for_phase,
+        split_matrix_metrics,
+        train_matrix,
+    )
+    from rcmarl_tpu.training.trainer import metrics_to_dataframe
+
+    cells = [
+        (scen, H)
+        for scen in args.scenarios
+        for H in args.H
+        if not (args.skip_existing and cell_done(scen, H))
+    ]
+    for scen, H in set(
+        (s, h) for s in args.scenarios for h in args.H
+    ) - set(cells):
+        print(f"{scen} H={H}: complete on disk, skipping")
+    if not cells:
+        return 0
+    cfgs = [cell_config(scen, H) for scen, H in cells]
+    base = cfgs[0]
+    n_blocks = args.n_episodes // base.n_ep_fixed
+
+    phase_metrics, dt = _run_phases(
+        args.phases,
+        train_fresh=lambda: train_matrix(base, cfgs, args.seeds, n_blocks),
+        train_resume=lambda st: train_matrix(
+            base, cfgs, args.seeds, n_blocks, states=st
+        ),
+        reset=lambda st: reset_matrix_for_phase(base, st, cfgs, args.seeds),
+    )
+
+    for ph, metrics in enumerate(phase_metrics):
+        rows = split_matrix_metrics(metrics, len(cells), len(args.seeds))
+        for (scen, H), row in zip(cells, rows):
+            for seed, m in zip(args.seeds, row):
+                _write_sim_data(
+                    out_root, scen, H, seed,
+                    metrics_to_dataframe(m), args.phase + ph,
+                )
+    total_eps = args.n_episodes * args.phases
+    n_rep = len(cells) * len(args.seeds)
+    sps = n_rep * total_eps * base.max_ep_len / dt
+    print(
+        f"fused matrix: {len(cells)} cells x {len(args.seeds)} seeds "
+        f"({n_rep} replicas) x {total_eps} eps ({args.phases} phase(s)) "
+        f"as one program per phase in {dt:.1f}s "
+        f"({sps:.0f} env-steps/s aggregate)"
+    )
+    return 0
+
+
 def cmd_sweep(argv) -> int:
     p = argparse.ArgumentParser(
         prog="rcmarl_tpu sweep",
@@ -373,6 +455,14 @@ def cmd_sweep(argv) -> int:
         "a crashed or interrupted matrix run can be re-issued verbatim and "
         "only computes what is missing",
     )
+    p.add_argument(
+        "--fused",
+        action="store_true",
+        help="run the ENTIRE scenario x H matrix as one sharded program "
+        "(cells become replicas with traced roles/H/common_reward — "
+        "parallel/matrix.py) instead of one program per cell; requires "
+        "consensus_impl xla/auto",
+    )
     args = p.parse_args(argv)
     if args.n_episodes <= 0 or args.n_episodes % args.n_ep_fixed != 0:
         raise SystemExit(
@@ -385,63 +475,67 @@ def cmd_sweep(argv) -> int:
     from rcmarl_tpu.parallel.seeds import reset_states_for_phase, train_parallel
     from rcmarl_tpu.training.trainer import metrics_to_dataframe
 
-    out_root = Path(args.out)
-    for scen in args.scenarios:
+    def cell_config(scen: str, H: int) -> Config:
         labels, is_global = scenario_labels(scen)
+        return Config.from_labels(
+            labels,
+            H=H,
+            common_reward=is_global,
+            n_episodes=args.n_episodes,
+            max_ep_len=args.max_ep_len,
+            n_ep_fixed=args.n_ep_fixed,
+            n_epochs=args.n_epochs,
+            buffer_size=args.buffer_size,
+            slow_lr=args.slow_lr,
+            fast_lr=args.fast_lr,
+            eps_explore=args.eps,
+            consensus_impl=args.consensus_impl,
+        )
+
+    out_root = Path(args.out)
+
+    def cell_done(scen: str, H: int) -> bool:
+        return all(
+            (
+                out_root / scen / f"H={H}" / f"seed={seed}"
+                / f"sim_data{args.phase + ph}.pkl"
+            ).exists()
+            for seed in args.seeds
+            for ph in range(args.phases)
+        )
+
+    if args.fused:
+        return _sweep_fused(args, cell_config, cell_done, out_root)
+
+    for scen in args.scenarios:
         for H in args.H:
-            if args.skip_existing and all(
-                (
-                    out_root / scen / f"H={H}" / f"seed={seed}"
-                    / f"sim_data{args.phase + ph}.pkl"
-                ).exists()
-                for seed in args.seeds
-                for ph in range(args.phases)
-            ):
+            if args.skip_existing and cell_done(scen, H):
                 print(f"{scen} H={H}: complete on disk, skipping")
                 continue
-            cfg = Config.from_labels(
-                labels,
-                H=H,
-                common_reward=is_global,
-                n_episodes=args.n_episodes,
-                max_ep_len=args.max_ep_len,
-                n_ep_fixed=args.n_ep_fixed,
-                n_epochs=args.n_epochs,
-                buffer_size=args.buffer_size,
-                slow_lr=args.slow_lr,
-                fast_lr=args.fast_lr,
-                eps_explore=args.eps,
-                consensus_impl=args.consensus_impl,
-            )
+            cfg = cell_config(scen, H)
             n_blocks = args.n_episodes // cfg.n_ep_fixed
-            t0 = time.perf_counter()
-            states = None
-            phase_metrics = []
-            for ph in range(args.phases):
-                if states is None:
-                    # all seeds of a cell run as ONE sharded/vmapped program
-                    states, metrics = train_parallel(
-                        cfg, seeds=args.seeds, n_blocks=n_blocks
-                    )
-                else:
-                    states = reset_states_for_phase(cfg, states, args.seeds)
-                    states, metrics = train_parallel(
-                        cfg, states=states, n_blocks=n_blocks
-                    )
-                # force completion before timing: dispatch is async, and a
-                # host-side fetch is the only reliable barrier on all backends
-                phase_metrics.append(
-                    type(metrics)(*(np.asarray(l) for l in metrics))
-                )
-            dt = time.perf_counter() - t0
+            # all seeds of a cell run as ONE sharded/vmapped program
+            phase_metrics, dt = _run_phases(
+                args.phases,
+                train_fresh=lambda cfg=cfg: train_parallel(
+                    cfg, seeds=args.seeds, n_blocks=n_blocks
+                ),
+                train_resume=lambda st, cfg=cfg: train_parallel(
+                    cfg, states=st, n_blocks=n_blocks
+                ),
+                reset=lambda st, cfg=cfg: reset_states_for_phase(
+                    cfg, st, args.seeds
+                ),
+            )
             for ph, metrics in enumerate(phase_metrics):
                 for i, seed in enumerate(args.seeds):
-                    cell = out_root / scen / f"H={H}" / f"seed={seed}"
-                    cell.mkdir(parents=True, exist_ok=True)
-                    df = metrics_to_dataframe(
-                        type(metrics)(*(l[i] for l in metrics))
+                    _write_sim_data(
+                        out_root, scen, H, seed,
+                        metrics_to_dataframe(
+                            type(metrics)(*(l[i] for l in metrics))
+                        ),
+                        args.phase + ph,
                     )
-                    df.to_pickle(cell / f"sim_data{args.phase + ph}.pkl")
             total_eps = args.n_episodes * args.phases
             sps = len(args.seeds) * total_eps * cfg.max_ep_len / dt
             print(

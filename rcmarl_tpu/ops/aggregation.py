@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from rcmarl_tpu.config import CONSENSUS_IMPLS
 
@@ -80,7 +81,16 @@ def resilient_aggregate(
 
     Args:
       values: (n_in, ...) stacked neighbor values, own value at index 0.
-      H: max number of adversaries tolerated in the neighborhood (static).
+      H: max number of adversaries tolerated in the neighborhood. A
+        Python int traces the specialized kernel (H=0 short-circuits to
+        a plain mean); a TRACED scalar (the heterogeneous-cell matrix
+        path, where replicas with different H share one program) runs
+        the general sort/clip/mean with dynamic trim indices — exactly
+        equivalent, since at H=0 the clip bounds are the min/max and the
+        clip is the identity. Traced H is XLA-only (the Pallas kernel
+        unrolls its trim indices at lowering time) and cannot be
+        range-checked at trace time — callers validate 2H <= deg-1 per
+        cell (Config does this for its static H).
       impl: 'xla' (default), 'pallas' (fused TPU kernel,
         :mod:`rcmarl_tpu.ops.pallas_aggregation`), 'pallas_interpret',
         or 'auto' (measured-crossover choice, :func:`resolve_impl`).
@@ -96,6 +106,16 @@ def resilient_aggregate(
     Returns:
       (...) aggregated values.
     """
+    if not is_static_h(H):
+        if valid is not None:
+            raise ValueError(
+                "traced H is not supported together with a padded-graph "
+                "validity mask (matrix cells must share one uniform graph)"
+            )
+        # 'auto' must pick an impl that CAN lower, so with a traced H it
+        # is xla by definition; an explicit pallas choice still errors
+        _check_impl(impl)
+        return _dynamic_h_aggregate(values, H, "xla" if impl == "auto" else impl)
     impl = resolve_impl(impl, values.shape[0], values.dtype)
     if valid is not None:
         return _masked_aggregate(values, H, valid)
@@ -115,6 +135,37 @@ def resilient_aggregate(
     sorted_vals = jnp.sort(values, axis=0)
     lower = jnp.minimum(sorted_vals[H], own)
     upper = jnp.maximum(sorted_vals[n_in - H - 1], own)
+    return jnp.mean(jnp.clip(values, lower, upper), axis=0)
+
+
+def is_static_h(H) -> bool:
+    """Python/NumPy ints are trace-time constants; anything else (a jnp
+    scalar, a tracer) selects the dynamic-trim-index path."""
+    return isinstance(H, (int, np.integer))
+
+
+def _dynamic_h_aggregate(values: jnp.ndarray, H, impl: str) -> jnp.ndarray:
+    """Clip-and-average with a TRACED trim parameter H.
+
+    The general formula — ``lower = min(sorted[H], own)``, ``upper =
+    max(sorted[n_in-1-H], own)`` — is exact for every H including 0
+    (there the bounds are the global min/max, so the clip is the
+    identity and the mean is plain), so no data-dependent branching is
+    needed: ``sorted[H]`` just becomes a dynamic index. This is what
+    lets training cells with different H values share one compiled
+    program (vmapped over the cell axis).
+    """
+    if impl != "xla":
+        raise ValueError(
+            f"traced H requires the xla consensus impl, got {impl!r} "
+            "(the Pallas kernel fixes its trim indices at lowering time)"
+        )
+    H = jnp.asarray(H, jnp.int32)
+    n_in = values.shape[0]
+    own = values[0]
+    sorted_vals = jnp.sort(values, axis=0)
+    lower = jnp.minimum(jnp.take(sorted_vals, H, axis=0), own)
+    upper = jnp.maximum(jnp.take(sorted_vals, n_in - 1 - H, axis=0), own)
     return jnp.mean(jnp.clip(values, lower, upper), axis=0)
 
 
@@ -168,6 +219,17 @@ def resilient_aggregate_tree(
     if not leaves:  # e.g. the trunk tree of a head-only (hidden=()) net
         _check_impl(impl)
         return tree
+    if not is_static_h(H):
+        if valid is not None:
+            raise ValueError(
+                "traced H is not supported together with a padded-graph "
+                "validity mask (matrix cells must share one uniform graph)"
+            )
+        _check_impl(impl)
+        concrete = "xla" if impl == "auto" else impl
+        return jax.tree.map(
+            lambda v: _dynamic_h_aggregate(v, H, concrete), tree
+        )
     impl = resolve_impl(impl, leaves[0].shape[0], leaves[0].dtype)
     if valid is not None:
         return jax.tree.map(lambda v: _masked_aggregate(v, H, valid), tree)

@@ -3,6 +3,12 @@ from rcmarl_tpu.parallel.distributed import (  # noqa: F401
     initialize,
     multihost_mesh,
 )
+from rcmarl_tpu.parallel.matrix import (  # noqa: F401
+    matrix_specs,
+    reset_matrix_for_phase,
+    split_matrix_metrics,
+    train_matrix,
+)
 from rcmarl_tpu.parallel.seeds import (  # noqa: F401
     init_states,
     make_mesh,

@@ -1,0 +1,204 @@
+"""The whole heterogeneous experiment matrix as ONE sharded program.
+
+The reference runs its scenario x H x seed experiment matrix as
+independent SGE jobs, ~8.8 h each (``simulation_results/raw_data/*/
+job.sh``, BASELINE.md); this framework's ``sweep`` already collapses the
+seed axis of each cell into one vmapped program. This module collapses
+the remaining loop: cells with DIFFERENT scenarios (role composition,
+trim parameter H, private vs team-average reward) become replicas of a
+single jitted, mesh-sharded program, by passing each cell's knobs as
+traced data (:class:`~rcmarl_tpu.agents.updates.CellSpec`) instead of
+trace-time constants.
+
+What makes this sound:
+
+- Cells may differ ONLY in ``agent_roles`` / ``H`` / ``common_reward``
+  (checked at entry): everything shape-relevant (N, graph, model sizes,
+  schedule) is shared, so one compiled executable serves all replicas.
+- A spec-mode replica is numerically identical to its statically
+  specialized solo twin (``tests/test_matrix.py`` pins bitwise equality
+  at the update-block level and float32-rounding equality end-to-end),
+  so fusing the matrix changes wall-clock, not science.
+- Heterogeneity costs compute-all-then-mask across the three role
+  branches — the trade SURVEY.md §7 endorses at these model sizes — and
+  one XLA program means the chip sees ``n_cells x n_seeds`` replicas to
+  batch (the regime where TPU throughput scales almost for free,
+  bench.py's replica sweep).
+
+Traced H rides the XLA consensus path (the Pallas kernel fixes trim
+indices at lowering time, ops/aggregation.py) and requires a uniform-
+degree graph — both true of every reference scenario.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from rcmarl_tpu.agents.updates import CellSpec
+from rcmarl_tpu.config import Config
+from rcmarl_tpu.training.rollout import EpisodeMetrics
+from rcmarl_tpu.training.trainer import TrainState, train_scanned
+from rcmarl_tpu.training.update import spec_from_config
+from rcmarl_tpu.parallel.seeds import (
+    init_states,
+    make_mesh,
+    reset_states_for_phase,
+    state_shardings,
+)
+
+__all__ = [
+    "matrix_specs",
+    "train_matrix",
+    "reset_matrix_for_phase",
+    "split_matrix_metrics",
+]
+
+
+def _check_fusable(base: Config, cells: Sequence[Config]) -> None:
+    """Every cell must be the base config modulo the traced knobs."""
+    for i, cell in enumerate(cells):
+        norm = cell.replace(
+            agent_roles=base.agent_roles,
+            H=base.H,
+            common_reward=base.common_reward,
+        )
+        if norm != base:
+            raise ValueError(
+                f"cell {i} differs from the base config beyond "
+                "agent_roles/H/common_reward; the fused matrix needs one "
+                "shared program shape"
+            )
+        if cell.padded_in_nodes()[1] is not None:
+            raise ValueError(
+                "the fused matrix requires a uniform-degree graph "
+                "(traced H excludes the padded-neighborhood path)"
+            )
+    if base.consensus_impl not in ("xla", "auto"):
+        raise ValueError(
+            "the fused matrix runs consensus on the XLA path (traced H); "
+            f"consensus_impl={base.consensus_impl!r} cannot apply"
+        )
+
+
+def matrix_specs(cells: Sequence[Config], n_seeds: int) -> CellSpec:
+    """Stack each cell's :class:`CellSpec` and repeat it across the seed
+    axis: replica layout is CELL-MAJOR, ``replica = cell * n_seeds +
+    seed_index`` — the layout :func:`train_matrix` and its callers use to
+    slice results back into (cell, seed) order."""
+    specs = [spec_from_config(c) for c in cells]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *specs)
+    return jax.tree.map(
+        lambda x: jnp.repeat(x, n_seeds, axis=0), stacked
+    )
+
+
+def _tile_states(states: TrainState, n_cells: int) -> TrainState:
+    """Tile seed-batched states across the cell axis (cell-major): every
+    cell starts from the same per-seed init, exactly as the solo sweep's
+    cells do (init depends on the seed, never on roles/H)."""
+    return jax.tree.map(
+        lambda x: jnp.tile(x, (n_cells,) + (1,) * (x.ndim - 1)), states
+    )
+
+
+def train_matrix(
+    base: Config,
+    cells: Sequence[Config],
+    seeds: Sequence[int],
+    n_blocks: int,
+    mesh: Optional[Mesh] = None,
+    states: Optional[TrainState] = None,
+    shard_agents: bool = False,
+) -> Tuple[TrainState, EpisodeMetrics]:
+    """Train every (cell, seed) replica in one sharded XLA program.
+
+    Args:
+      base: the shared program shape (any of the cells works).
+      cells: per-cell configs differing only in roles/H/common_reward.
+      seeds: integer seeds; replicas = len(cells) * len(seeds),
+        cell-major.
+      n_blocks: training blocks per replica.
+      mesh: ('seed', 'agent') mesh; defaults to the largest device count
+        dividing the replica count, all on 'seed'.
+      states: resume from previously returned batched states (phase 2 of
+        the published protocol; see :func:`reset_matrix_for_phase`).
+      shard_agents: additionally partition the agent axis over the
+        mesh's 'agent' dimension (consensus gathers become ICI
+        collectives, PARALLELISM.md) — composes with cell fusion.
+
+    Returns (batched TrainState, EpisodeMetrics), leading axis
+    ``len(cells) * len(seeds)`` in cell-major order.
+    """
+    _check_fusable(base, cells)
+    n_rep = len(cells) * len(seeds)
+    if mesh is None:
+        n_dev = max(
+            d for d in range(1, len(jax.devices()) + 1) if n_rep % d == 0
+        )
+        mesh = make_mesh(n_dev)
+    if states is None:
+        states = _tile_states(init_states(base, list(seeds)), len(cells))
+    specs = matrix_specs(cells, len(seeds))
+
+    in_shard = state_shardings(mesh, states, shard_agents)
+    a = "agent" if shard_agents else None
+    spec_shard = CellSpec(
+        coop=NamedSharding(mesh, P("seed", a)),
+        greedy=NamedSharding(mesh, P("seed", a)),
+        malicious=NamedSharding(mesh, P("seed", a)),
+        H=NamedSharding(mesh, P("seed")),
+        common_reward=NamedSharding(mesh, P("seed")),
+    )
+    states = jax.device_put(states, in_shard)
+    specs = jax.device_put(specs, spec_shard)
+
+    # The compiled executable depends only on program SHAPE — cell knobs
+    # are data — so phase 2 of a sweep (and any repeated/resumed call)
+    # must reuse it: that is the "one compile for the whole matrix"
+    # benefit. Shares seeds._JIT_CACHE, discriminated from
+    # train_parallel's keys by the leading tag.
+    from rcmarl_tpu.parallel import seeds as _seeds
+
+    key = ("matrix", base, n_blocks, mesh, shard_agents, n_rep)
+    fn = _seeds._JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(
+            jax.vmap(lambda st, sp: train_scanned(base, st, n_blocks, sp)),
+            in_shardings=(in_shard, spec_shard),
+            out_shardings=(in_shard, NamedSharding(mesh, P("seed"))),
+        )
+        if len(_seeds._JIT_CACHE) >= _seeds._JIT_CACHE_MAX:
+            _seeds._JIT_CACHE.pop(next(iter(_seeds._JIT_CACHE)))
+        _seeds._JIT_CACHE[key] = fn
+    return fn(states, specs)
+
+
+def reset_matrix_for_phase(
+    base: Config, states: TrainState, cells: Sequence[Config], seeds
+) -> TrainState:
+    """The published two-phase restart boundary over the whole matrix:
+    per replica, weights + goal layout carry over while Adam moments,
+    buffer, and RNG re-initialize from the replica's seed
+    (:func:`rcmarl_tpu.parallel.seeds.reset_states_for_phase`; reference
+    ``main.py:46-54,83-86``)."""
+    tiled_seeds = jnp.tile(jnp.asarray(seeds, jnp.uint32), len(cells))
+    return reset_states_for_phase(base, states, tiled_seeds)
+
+
+def split_matrix_metrics(
+    metrics: EpisodeMetrics, n_cells: int, n_seeds: int
+) -> List[List[EpisodeMetrics]]:
+    """Slice flat cell-major replica metrics back into [cell][seed]
+    :class:`EpisodeMetrics` (host-side convenience for writers)."""
+    out: List[List[EpisodeMetrics]] = []
+    for c in range(n_cells):
+        row = []
+        for s in range(n_seeds):
+            i = c * n_seeds + s
+            row.append(type(metrics)(*(leaf[i] for leaf in metrics)))
+        out.append(row)
+    return out

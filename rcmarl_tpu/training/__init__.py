@@ -21,6 +21,7 @@ from rcmarl_tpu.training.trainer import (  # noqa: F401
 )
 from rcmarl_tpu.training.update import (  # noqa: F401
     init_agent_params,
+    spec_from_config,
     team_average_reward,
     update_block,
 )

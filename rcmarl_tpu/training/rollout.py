@@ -16,7 +16,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from rcmarl_tpu.agents.updates import AgentParams, Batch
+from rcmarl_tpu.agents.updates import AgentParams, Batch, CellSpec
 from rcmarl_tpu.config import Config
 from rcmarl_tpu.envs.grid_world import (
     GridWorld,
@@ -69,6 +69,7 @@ def rollout_episode(
     desired: jnp.ndarray,
     key: jax.Array,
     initial: jnp.ndarray = None,
+    spec: CellSpec | None = None,
 ) -> Tuple[Batch, EpisodeMetrics]:
     """One episode: reset, ``max_ep_len`` steps, per-episode metrics
     evaluated with the CURRENT (episode-start) parameters, exactly as the
@@ -77,7 +78,9 @@ def rollout_episode(
 
     Reset honors ``cfg.randomize_state`` (reference ``grid_world.py:39-43``):
     random positions by default, else the fixed ``initial`` layout drawn at
-    startup (reference ``main.py:49``).
+    startup (reference ``main.py:49``). Rollout dynamics are
+    role-independent; ``spec`` (the fused-matrix path) only redefines
+    which agents count as cooperative in the METRICS.
     """
     k_reset, k_steps = jax.random.split(key)
     if cfg.randomize_state:
@@ -92,11 +95,18 @@ def rollout_episode(
 
     # Estimated team returns at s0 (train_agents.py:60-62)
     s0 = scale_state(env, pos0)
-    coop = jnp.asarray(cfg.coop_mask)
+    if spec is None:
+        coop = jnp.asarray(cfg.coop_mask)
+        n_coop = max(cfg.n_coop, 1)
+        n_adv = max(cfg.n_adv, 1)
+    else:
+        coop = spec.coop
+        n_coop = jnp.maximum(jnp.sum(coop), 1)
+        n_adv = jnp.maximum(jnp.sum(~coop), 1)
     v0 = jax.vmap(lambda p: mlp_forward(p, s0[None].reshape(1, -1))[0, 0])(
         params.critic
     )  # (N,)
-    est = jnp.sum(jnp.where(coop, v0, 0.0)) / max(cfg.n_coop, 1)
+    est = jnp.sum(jnp.where(coop, v0, 0.0)) / n_coop
 
     def step(carry, k):
         pos, ret, j = carry
@@ -119,8 +129,8 @@ def rollout_episode(
         jax.random.split(k_steps, cfg.max_ep_len),
     )
 
-    true_team = jnp.sum(jnp.where(coop, ep_returns, 0.0)) / max(cfg.n_coop, 1)
-    true_adv = jnp.sum(jnp.where(coop, 0.0, ep_returns)) / max(cfg.n_adv, 1)
+    true_team = jnp.sum(jnp.where(coop, ep_returns, 0.0)) / n_coop
+    true_adv = jnp.sum(jnp.where(coop, 0.0, ep_returns)) / n_adv
     batch = Batch(s=s, ns=ns, a=a, r=r, mask=jnp.ones((cfg.max_ep_len,), jnp.float32))
     return batch, EpisodeMetrics(true_team, true_adv, est)
 
@@ -132,6 +142,7 @@ def rollout_block(
     desired: jnp.ndarray,
     key: jax.Array,
     initial: jnp.ndarray = None,
+    spec: CellSpec | None = None,
 ) -> Tuple[Batch, EpisodeMetrics]:
     """``n_ep_fixed`` consecutive episodes under frozen parameters (the
     reference only updates at block boundaries, ``train_agents.py:86``).
@@ -142,7 +153,9 @@ def rollout_block(
     """
 
     def one_ep(_, k):
-        return None, rollout_episode(cfg, env, params, desired, k, initial)
+        return None, rollout_episode(
+            cfg, env, params, desired, k, initial, spec
+        )
 
     _, (ep_batch, metrics) = jax.lax.scan(
         one_ep, None, jax.random.split(key, cfg.n_ep_fixed)

@@ -87,19 +87,24 @@ def init_train_state(
 
 
 @partial(jax.jit, static_argnums=0)
-def train_block(cfg: Config, state: TrainState) -> Tuple[TrainState, EpisodeMetrics]:
+def train_block(
+    cfg: Config, state: TrainState, spec=None
+) -> Tuple[TrainState, EpisodeMetrics]:
     """One block: rollout ``n_ep_fixed`` episodes, update, push to buffer.
 
     Jitted once per (frozen, hashable) Config — repeated ``train`` calls
-    with the same config reuse the compiled program.
+    with the same config reuse the compiled program. ``spec`` (a traced
+    :class:`~rcmarl_tpu.agents.updates.CellSpec`) switches the scenario
+    knobs (roles/H/common_reward) from trace-time constants to data —
+    the fused-matrix path (:mod:`rcmarl_tpu.parallel.matrix`).
     """
     env = make_env(cfg)
     key, k_roll, k_upd = jax.random.split(state.key, 3)
     fresh, metrics = rollout_block(
-        cfg, env, state.params, state.desired, k_roll, state.initial
+        cfg, env, state.params, state.desired, k_roll, state.initial, spec
     )
     batch = update_batch(state.buffer, fresh)
-    params = update_block(cfg, state.params, batch, fresh, k_upd)
+    params = update_block(cfg, state.params, batch, fresh, k_upd, spec)
     buffer = buffer_push_block(state.buffer, fresh)
     return (
         TrainState(
@@ -110,7 +115,7 @@ def train_block(cfg: Config, state: TrainState) -> Tuple[TrainState, EpisodeMetr
 
 
 def train_scanned(
-    cfg: Config, state: TrainState, n_blocks: int
+    cfg: Config, state: TrainState, n_blocks: int, spec=None
 ) -> Tuple[TrainState, EpisodeMetrics]:
     """``n_blocks`` blocks as one ``lax.scan`` — zero host round-trips.
 
@@ -119,7 +124,7 @@ def train_scanned(
     """
 
     def body(s, _):
-        return train_block(cfg, s)
+        return train_block(cfg, s, spec)
 
     state, metrics = jax.lax.scan(body, state, None, length=n_blocks)
     return state, jax.tree.map(lambda x: x.reshape(-1), metrics)

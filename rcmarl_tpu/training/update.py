@@ -29,6 +29,7 @@ import numpy as np
 from rcmarl_tpu.agents.updates import (
     AgentParams,
     Batch,
+    CellSpec,
     adv_actor_update,
     adv_critic_fit,
     adv_tr_fit,
@@ -59,6 +60,20 @@ def init_agent_params(key: jax.Array, cfg: Config) -> AgentParams:
 
 def _role_mask(cfg: Config, role: int) -> jnp.ndarray:
     return jnp.asarray(np.array(cfg.agent_roles) == role)
+
+
+def spec_from_config(cfg: Config) -> CellSpec:
+    """The config's static role/H/common_reward knobs as a concrete
+    :class:`CellSpec` pytree — the bridge between the solo trainer's
+    trace-time specialization and the fused-matrix path (stack these
+    across cells and vmap)."""
+    return CellSpec(
+        coop=_role_mask(cfg, Roles.COOPERATIVE),
+        greedy=_role_mask(cfg, Roles.GREEDY),
+        malicious=_role_mask(cfg, Roles.MALICIOUS),
+        H=jnp.asarray(cfg.H, jnp.int32),
+        common_reward=jnp.asarray(cfg.common_reward, bool),
+    )
 
 
 def gather_neighbor_messages(cfg: Config, tree):
@@ -93,36 +108,59 @@ def gather_neighbor_messages(cfg: Config, tree):
     return jax.tree.map(lambda l: l[in_arr], tree)
 
 
-def team_average_reward(cfg: Config, r: jnp.ndarray) -> jnp.ndarray:
+def team_average_reward(
+    cfg: Config, r: jnp.ndarray, spec: CellSpec | None = None
+) -> jnp.ndarray:
     """r_coop: mean reward of cooperative agents (``train_agents.py:96-98``).
 
-    r: (B, N, 1) -> (B, 1).
+    r: (B, N, 1) -> (B, 1). With a ``spec`` the cooperative mask (and so
+    the divisor) is traced data.
     """
-    coop = jnp.asarray(cfg.coop_mask, jnp.float32)[None, :, None]
-    return jnp.sum(r * coop, axis=1) / max(cfg.n_coop, 1)
+    if spec is None:
+        coop = jnp.asarray(cfg.coop_mask, jnp.float32)[None, :, None]
+        return jnp.sum(r * coop, axis=1) / max(cfg.n_coop, 1)
+    coop = spec.coop.astype(jnp.float32)[None, :, None]
+    return jnp.sum(r * coop, axis=1) / jnp.maximum(jnp.sum(coop), 1.0)
 
 
 def critic_tr_epoch(
-    cfg: Config, carry, batch: Batch, r_coop: jnp.ndarray, ekey: jax.Array
+    cfg: Config,
+    carry,
+    batch: Batch,
+    r_coop: jnp.ndarray,
+    ekey: jax.Array,
+    spec: CellSpec | None = None,
 ):
     """One epoch of phases I+II over stacked params.
 
     carry = (critic, tr, critic_local), each leaf (N, ...).
+
+    Without ``spec``, role composition / H / common_reward come from the
+    static Config and absent roles are never traced (the solo path).
+    With a ``spec`` they are TRACED data: every role branch is computed
+    and masked, so cells with different scenarios share one program (the
+    fused-matrix path). Identical RNG stream structure in both modes —
+    the epoch key is split the same way regardless of which branches
+    run — so a spec replica reproduces its solo twin exactly.
     """
     critic, tr, critic_local = carry
     s, ns, sa, mask = batch.s, batch.ns, batch.sa, batch.mask
     r_agents = jnp.moveaxis(batch.r, 1, 0)  # (N, B, 1) per-agent rewards
     N = cfg.n_agents
+    traced = spec is not None
 
     # ---- Phase I: local fits -> messages (+ persisted adversary updates)
     msg_critic, msg_tr = critic, tr  # Faulty default: transmit frozen nets
     new_critic, new_tr, new_critic_local = critic, tr, critic_local
 
-    if cfg.n_coop:
+    if traced or cfg.n_coop:
         # common_reward applies to cooperative local fits ONLY
         # (train_agents.py:106)
-        if cfg.common_reward:
-            r_applied = jnp.broadcast_to(r_coop[None], (N, *r_coop.shape))
+        r_team = jnp.broadcast_to(r_coop[None], (N, *r_coop.shape))
+        if traced:
+            r_applied = jnp.where(spec.common_reward, r_team, r_agents)
+        elif cfg.common_reward:
+            r_applied = r_team
         else:
             r_applied = r_agents
         coop_c, _ = jax.vmap(
@@ -131,27 +169,27 @@ def critic_tr_epoch(
         coop_t, _ = jax.vmap(lambda p, r: coop_local_tr_fit(p, sa, r, mask, cfg))(
             tr, r_applied
         )
-        m = _role_mask(cfg, Roles.COOPERATIVE)
+        m = spec.coop if traced else _role_mask(cfg, Roles.COOPERATIVE)
         msg_critic = select_tree(m, coop_c, msg_critic)
         msg_tr = select_tree(m, coop_t, msg_tr)
         # own nets restored (resilient_CAC_agents.py:120,138): new_* unchanged
 
     k_gc, k_gt, k_ml, k_mc, k_mt = jax.random.split(ekey, 5)
 
-    if cfg.has_role(Roles.GREEDY):
+    if traced or cfg.has_role(Roles.GREEDY):
         greedy_c, _ = jax.vmap(
             lambda k, p, r: adv_critic_fit(k, p, s, ns, r, mask, cfg)
         )(jax.random.split(k_gc, N), critic, r_agents)
         greedy_t, _ = jax.vmap(lambda k, p, r: adv_tr_fit(k, p, sa, r, mask, cfg))(
             jax.random.split(k_gt, N), tr, r_agents
         )
-        m = _role_mask(cfg, Roles.GREEDY)
+        m = spec.greedy if traced else _role_mask(cfg, Roles.GREEDY)
         msg_critic = select_tree(m, greedy_c, msg_critic)
         msg_tr = select_tree(m, greedy_t, msg_tr)
         new_critic = select_tree(m, greedy_c, new_critic)  # persists
         new_tr = select_tree(m, greedy_t, new_tr)
 
-    if cfg.has_role(Roles.MALICIOUS):
+    if traced or cfg.has_role(Roles.MALICIOUS):
         # private critic on own reward (adversarial_CAC_agents.py:137-152)
         mal_local, _ = jax.vmap(
             lambda k, p, r: adv_critic_fit(k, p, s, ns, r, mask, cfg)
@@ -164,7 +202,7 @@ def critic_tr_epoch(
         mal_t, _ = jax.vmap(lambda k, p, r: adv_tr_fit(k, p, sa, r, mask, cfg))(
             jax.random.split(k_mt, N), tr, neg
         )
-        m = _role_mask(cfg, Roles.MALICIOUS)
+        m = spec.malicious if traced else _role_mask(cfg, Roles.MALICIOUS)
         msg_critic = select_tree(m, mal_c, msg_critic)
         msg_tr = select_tree(m, mal_t, msg_tr)
         new_critic = select_tree(m, mal_c, new_critic)  # persists
@@ -172,19 +210,30 @@ def critic_tr_epoch(
         new_critic_local = select_tree(m, mal_local, new_critic_local)
 
     # ---- Phase II: resilient consensus, cooperative agents only
-    if cfg.n_coop:
+    if traced or cfg.n_coop:
         # Heterogeneous in-degree graphs (reference main.py:28 accepts
         # arbitrary adjacency lists): rows padded to max degree with the
         # agent's own index; padded slots masked out of the aggregation.
+        # (The fused-matrix path requires a uniform graph: traced H and
+        # the padded-validity mask are mutually exclusive.)
         _, valid_pad = cfg.padded_in_nodes()
+        H = spec.H if traced else None
         nbr_c = gather_neighbor_messages(cfg, msg_critic)  # (N, n_in, ...)
         nbr_t = gather_neighbor_messages(cfg, msg_tr)
         if valid_pad is None:
             cons = jax.vmap(
-                lambda own, nbr, x: consensus_update_one(own, nbr, x, mask, cfg),
+                lambda own, nbr, x: consensus_update_one(
+                    own, nbr, x, mask, cfg, H=H
+                ),
                 in_axes=(0, 0, None),
             )
         else:
+            if traced:
+                raise ValueError(
+                    "the fused-matrix path (traced CellSpec) requires a "
+                    "uniform-degree graph; this config pads ragged "
+                    "neighborhoods"
+                )
             valid_arr = jnp.asarray(np.array(valid_pad))  # (N, n_in)
             cons_v = jax.vmap(
                 lambda own, nbr, x, v: consensus_update_one(
@@ -193,7 +242,7 @@ def critic_tr_epoch(
                 in_axes=(0, 0, None, 0),
             )
             cons = lambda own, nbr, x: cons_v(own, nbr, x, valid_arr)
-        m = _role_mask(cfg, Roles.COOPERATIVE)
+        m = spec.coop if traced else _role_mask(cfg, Roles.COOPERATIVE)
         new_critic = select_tree(m, cons(new_critic, nbr_c, s), new_critic)
         new_tr = select_tree(m, cons(new_tr, nbr_t, sa), new_tr)
 
@@ -201,32 +250,38 @@ def critic_tr_epoch(
 
 
 def actor_phase(
-    cfg: Config, params: AgentParams, fresh: Batch, key: jax.Array
+    cfg: Config,
+    params: AgentParams,
+    fresh: Batch,
+    key: jax.Array,
+    spec: CellSpec | None = None,
 ) -> Tuple[object, object]:
     """Phase III: actor updates over the fresh on-policy window
-    (``train_agents.py:149-153``). Returns (new_actor, new_actor_opt)."""
+    (``train_agents.py:149-153``). Returns (new_actor, new_actor_opt).
+    With a ``spec``, role membership is traced (see
+    :func:`critic_tr_epoch`)."""
     s, ns, sa = fresh.s, fresh.ns, fresh.sa
     a_own = jnp.moveaxis(fresh.a[..., 0], 1, 0).astype(jnp.int32)  # (N, B)
     r_own = jnp.moveaxis(fresh.r, 1, 0)  # (N, B, 1)
     N = cfg.n_agents
+    traced = spec is not None
 
     new_actor, new_opt = params.actor, params.actor_opt
-    if cfg.n_coop:
+    if traced or cfg.n_coop:
         coop_a, coop_o, _ = jax.vmap(
             lambda ac, op, cr, t, a: coop_actor_update(
                 ac, op, cr, t, s, ns, sa, a, cfg
             )
         )(params.actor, params.actor_opt, params.critic, params.tr, a_own)
-        m = _role_mask(cfg, Roles.COOPERATIVE)
+        m = spec.coop if traced else _role_mask(cfg, Roles.COOPERATIVE)
         new_actor = select_tree(m, coop_a, new_actor)
         new_opt = select_tree(m, coop_o, new_opt)
 
-    if cfg.n_adv:
+    if traced or cfg.n_adv:
         # Malicious agents drive their actor with the PRIVATE local critic
         # (adversarial_CAC_agents.py:102-119); greedy/faulty use their own.
-        critic_in = select_tree(
-            _role_mask(cfg, Roles.MALICIOUS), params.critic_local, params.critic
-        )
+        mal = spec.malicious if traced else _role_mask(cfg, Roles.MALICIOUS)
+        critic_in = select_tree(mal, params.critic_local, params.critic)
         adv_a, adv_o, _ = jax.vmap(
             lambda k, ac, op, cr, r, a: adv_actor_update(
                 k, ac, op, cr, s, ns, r, a, cfg
@@ -239,7 +294,7 @@ def actor_phase(
             r_own,
             a_own,
         )
-        m = jnp.asarray(~np.array(cfg.coop_mask))
+        m = ~spec.coop if traced else jnp.asarray(~np.array(cfg.coop_mask))
         new_actor = select_tree(m, adv_a, new_actor)
         new_opt = select_tree(m, adv_o, new_opt)
 
@@ -248,7 +303,12 @@ def actor_phase(
 
 @partial(jax.jit, static_argnums=0)
 def update_block(
-    cfg: Config, params: AgentParams, batch: Batch, fresh: Batch, key: jax.Array
+    cfg: Config,
+    params: AgentParams,
+    batch: Batch,
+    fresh: Batch,
+    key: jax.Array,
+    spec: CellSpec | None = None,
 ) -> AgentParams:
     """Full update block: ``n_epochs`` x (phase I + II) then phase III.
 
@@ -257,12 +317,14 @@ def update_block(
       batch: replay window (kept buffer + fresh block), masked.
       fresh: the on-policy actor window (fully valid).
       key: PRNG key for adversary fit shuffles and actor minibatching.
+      spec: optional traced scenario knobs (roles/H/common_reward) —
+        the fused-matrix path; None = static-Config specialization.
     """
-    r_coop = team_average_reward(cfg, batch.r)
+    r_coop = team_average_reward(cfg, batch.r, spec)
     k_epochs, k_actor = jax.random.split(key)
 
     def epoch(carry, ekey):
-        return critic_tr_epoch(cfg, carry, batch, r_coop, ekey), None
+        return critic_tr_epoch(cfg, carry, batch, r_coop, ekey, spec), None
 
     (critic, tr, critic_local), _ = jax.lax.scan(
         epoch,
@@ -270,5 +332,5 @@ def update_block(
         jax.random.split(k_epochs, cfg.n_epochs),
     )
     params = params._replace(critic=critic, tr=tr, critic_local=critic_local)
-    actor, actor_opt = actor_phase(cfg, params, fresh, k_actor)
+    actor, actor_opt = actor_phase(cfg, params, fresh, k_actor, spec)
     return params._replace(actor=actor, actor_opt=actor_opt)

@@ -253,3 +253,96 @@ class TestAutoImpl:
         state = init_train_state(cfg, jax.random.PRNGKey(0))
         state, metrics = train_block(cfg, state)
         assert np.isfinite(np.asarray(metrics.true_team_returns)).all()
+
+
+class TestTracedH:
+    """Traced-H path (the heterogeneous-cell matrix program): must match
+    the static specialization bit-for-bit for every legal H, including
+    the H=0 plain-mean shortcut, and must compose with vmap so replicas
+    with DIFFERENT H values share one program."""
+
+    @pytest.mark.parametrize("H", [0, 1, 2])
+    def test_matches_static(self, H):
+        rng = np.random.default_rng(7 + H)
+        values = jnp.asarray(rng.normal(size=(6, 4, 3)), jnp.float32)
+        static = resilient_aggregate(values, H)
+        traced = jax.jit(
+            lambda v, h: resilient_aggregate(v, h)
+        )(values, jnp.int32(H))
+        np.testing.assert_array_equal(np.asarray(static), np.asarray(traced))
+
+    def test_tree_matches_static(self):
+        rng = np.random.default_rng(11)
+        tree = {
+            "W": jnp.asarray(rng.normal(size=(5, 3, 2)), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(5, 2)), jnp.float32),
+        }
+        static = resilient_aggregate_tree(tree, 1)
+        traced = jax.jit(
+            lambda t, h: resilient_aggregate_tree(t, h)
+        )(tree, jnp.int32(1))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            static,
+            traced,
+        )
+
+    def test_vmap_heterogeneous_h(self):
+        """One program, three replicas with H = 0, 1, 2 — each replica's
+        row equals the corresponding static-H call."""
+        rng = np.random.default_rng(13)
+        values = jnp.asarray(rng.normal(size=(3, 7, 10)), jnp.float32)
+        hs = jnp.asarray([0, 1, 2], jnp.int32)
+        out = jax.jit(
+            jax.vmap(lambda v, h: resilient_aggregate(v, h))
+        )(values, hs)
+        for i, H in enumerate([0, 1, 2]):
+            np.testing.assert_array_equal(
+                np.asarray(out[i]),
+                np.asarray(resilient_aggregate(values[i], H)),
+            )
+
+    def test_traced_h_rejects_pallas(self):
+        values = jnp.zeros((4, 2), jnp.float32)
+        with pytest.raises(ValueError, match="traced H"):
+            resilient_aggregate(values, jnp.int32(1), impl="pallas")
+
+    def test_traced_h_rejects_valid_mask(self):
+        values = jnp.zeros((4, 2), jnp.float32)
+        with pytest.raises(ValueError, match="uniform graph"):
+            resilient_aggregate(
+                values, jnp.int32(1), valid=jnp.asarray([1, 1, 1, 0])
+            )
+
+    @pytest.mark.skipif(REF_AGG is None, reason="reference import failed")
+    def test_traced_h_golden_vs_reference(self):
+        rng = np.random.default_rng(17)
+        values = rng.normal(size=(5, 8)).astype(np.float32)
+        for H in (0, 1, 2):
+            ours = jax.jit(lambda v, h: resilient_aggregate(v, h))(
+                jnp.asarray(values), jnp.int32(H)
+            )
+            np.testing.assert_allclose(
+                np.asarray(ours), REF_AGG(values, H), rtol=1e-6
+            )
+
+    def test_traced_h_auto_resolves_to_xla(self):
+        """impl='auto' must lower with a traced H on ANY backend (auto
+        picks an impl that can lower; only explicit pallas errors)."""
+        rng = np.random.default_rng(19)
+        # n_in >= PALLAS_CROSSOVER_N_IN: 'auto' would pick pallas on TPU
+        values = jnp.asarray(rng.normal(size=(16, 6)), jnp.float32)
+        out = jax.jit(
+            lambda v, h: resilient_aggregate(v, h, impl="auto")
+        )(values, jnp.int32(2))
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(resilient_aggregate(values, 2))
+        )
+        tree_out = resilient_aggregate_tree(
+            {"w": values}, jnp.int32(2), impl="auto"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(tree_out["w"]), np.asarray(resilient_aggregate(values, 2))
+        )

@@ -1,0 +1,232 @@
+"""Fused-matrix path: traced CellSpec must reproduce the static path.
+
+The solo trainer specializes its program on Config at trace time; the
+fused-matrix path (one program for the whole heterogeneous scenario x H
+experiment matrix) carries roles/H/common_reward as traced data
+(:class:`rcmarl_tpu.agents.updates.CellSpec`). These tests pin the load-
+bearing contract: a spec-mode replica is NUMERICALLY IDENTICAL to its
+statically-specialized solo twin — per update block, per full training
+block, and under vmap across replicas with DIFFERENT scenarios.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+from rcmarl_tpu.training import (
+    init_agent_params,
+    init_train_state,
+    update_block,
+)
+from rcmarl_tpu.training.trainer import train_block, train_scanned
+from rcmarl_tpu.training.update import spec_from_config
+from tests.test_trainer import SMALL, _fresh
+
+
+def _cell_cfg(roles=None, H=0, common_reward=False):
+    return SMALL.replace(
+        agent_roles=roles or (Roles.COOPERATIVE,) * SMALL.n_agents,
+        H=H,
+        common_reward=common_reward,
+    )
+
+
+CELLS = {
+    "coop_h0": _cell_cfg(),
+    "coop_h1_common": _cell_cfg(H=1, common_reward=True),
+    "greedy_h1": _cell_cfg(
+        roles=(Roles.COOPERATIVE,) * 4 + (Roles.GREEDY,), H=1
+    ),
+    "faulty_h0": _cell_cfg(
+        roles=(Roles.COOPERATIVE,) * 4 + (Roles.FAULTY,), H=0
+    ),
+    "malicious_h1": _cell_cfg(
+        roles=(Roles.COOPERATIVE,) * 4 + (Roles.MALICIOUS,), H=1
+    ),
+}
+
+
+def _assert_trees_equal(a, b, **kw):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), **kw
+        ),
+        a,
+        b,
+    )
+
+
+class TestSpecEquivalence:
+    @pytest.mark.parametrize("name", sorted(CELLS))
+    def test_update_block(self, name):
+        """update_block(cfg) == update_block(cfg, spec=spec_from_config(cfg))
+        — same RNG stream structure, compute-all-then-mask selects the
+        same values the static path computes."""
+        cfg = CELLS[name]
+        params = init_agent_params(jax.random.PRNGKey(3), cfg)
+        batch, fresh = _fresh(cfg, 0.1), _fresh(cfg, 0.2)
+        key = jax.random.PRNGKey(7)
+        static = update_block(cfg, params, batch, fresh, key)
+        traced = update_block(
+            cfg, params, batch, fresh, key, spec_from_config(cfg)
+        )
+        _assert_trees_equal(static, traced, rtol=0, atol=0)
+
+    @pytest.mark.parametrize("name", ["coop_h1_common", "malicious_h1"])
+    def test_train_block(self, name):
+        """Full block (rollout + update + buffer push): state AND metrics
+        identical between the two modes."""
+        cfg = CELLS[name]
+        state = init_train_state(cfg, jax.random.PRNGKey(0))
+        s_static, m_static = train_block(cfg, state)
+        s_traced, m_traced = train_block(cfg, state, spec_from_config(cfg))
+        # composite programs fuse differently between the two modes, so
+        # equality here is to float32 rounding (update_block alone is
+        # bitwise — TestSpecEquivalence.test_update_block)
+        _assert_trees_equal(s_static, s_traced, rtol=1e-5, atol=1e-7)
+        _assert_trees_equal(m_static, m_traced, rtol=1e-5, atol=1e-7)
+
+
+class TestHeterogeneousVmap:
+    def test_matrix_of_cells_matches_solo_runs(self):
+        """THE fused-matrix contract: one vmapped program over replicas
+        with different scenarios == each scenario's solo scanned run."""
+        names = sorted(CELLS)
+        cfgs = [CELLS[n] for n in names]
+        base = cfgs[0]
+        n_blocks = 2
+
+        # identical state init across cells (roles/H don't touch init)
+        state = init_train_state(base, jax.random.PRNGKey(1))
+        states = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (len(cfgs), *x.shape)), state
+        )
+        specs = jax.tree.map(
+            lambda *xs: jnp.stack(xs), *[spec_from_config(c) for c in cfgs]
+        )
+
+        fused_states, fused_metrics = jax.jit(
+            jax.vmap(
+                lambda st, sp: train_scanned(base, st, n_blocks, sp)
+            )
+        )(states, specs)
+
+        for i, cfg in enumerate(cfgs):
+            solo_state, solo_metrics = train_scanned(cfg, state, n_blocks)
+            # float32-rounding tolerance: the vmapped fused program and
+            # each solo program fuse differently (see test_train_block)
+            _assert_trees_equal(
+                jax.tree.map(lambda x: x[i], fused_states),
+                solo_state,
+                rtol=1e-4,
+                atol=1e-6,
+            )
+            _assert_trees_equal(
+                jax.tree.map(lambda x: x[i], fused_metrics),
+                solo_metrics,
+                rtol=1e-4,
+                atol=1e-6,
+            )
+
+
+class TestFusedSweepCLI:
+    @pytest.mark.slow
+    def test_fused_matches_sequential_sweep(self, tmp_path):
+        """`sweep --fused` writes the same artifact tree as the per-cell
+        sweep, to float32 rounding, including the two-phase protocol."""
+        import pandas as pd
+
+        from rcmarl_tpu.cli import main
+
+        common = [
+            "sweep", "--scenarios", "coop", "malicious", "--H", "0", "1",
+            "--seeds", "100", "200", "--n_episodes", "100",
+            "--n_ep_fixed", "50", "--n_epochs", "2", "--buffer_size", "100",
+            "--phases", "2",
+        ]
+        seq, fused = tmp_path / "seq", tmp_path / "fused"
+        assert main(common + ["--out", str(seq)]) == 0
+        assert main(common + ["--out", str(fused), "--fused"]) == 0
+        pkls = sorted(p.relative_to(seq) for p in seq.rglob("*.pkl"))
+        assert len(pkls) == 2 * 2 * 2 * 2  # scen x H x seed x phase
+        assert pkls == sorted(p.relative_to(fused) for p in fused.rglob("*.pkl"))
+        for rel in pkls:
+            a = pd.read_pickle(seq / rel)
+            b = pd.read_pickle(fused / rel)
+            np.testing.assert_allclose(
+                a.to_numpy(), b.to_numpy(), rtol=1e-4, atol=1e-6,
+                err_msg=str(rel),
+            )
+
+    def test_fused_skip_existing_complete(self, tmp_path, capsys):
+        from rcmarl_tpu.cli import main
+
+        args = [
+            "sweep", "--fused", "--skip_existing", "--scenarios", "coop",
+            "--H", "0", "--seeds", "100", "--n_episodes", "50",
+            "--n_ep_fixed", "50", "--n_epochs", "1", "--buffer_size", "50",
+            "--out", str(tmp_path),
+        ]
+        assert main(args) == 0
+        assert (tmp_path / "coop" / "H=0" / "seed=100" / "sim_data1.pkl").exists()
+        before = (tmp_path / "coop" / "H=0" / "seed=100" / "sim_data1.pkl").stat().st_mtime
+        assert main(args) == 0
+        assert "skipping" in capsys.readouterr().out
+        after = (tmp_path / "coop" / "H=0" / "seed=100" / "sim_data1.pkl").stat().st_mtime
+        assert before == after
+
+
+class TestShardedMatrix:
+    @pytest.mark.slow
+    def test_fused_matrix_on_mesh_matches_solo(self):
+        """Cell fusion composes with mesh sharding (seed axis) AND
+        agent-axis sharding: the sharded fused matrix equals each cell's
+        unsharded solo run."""
+        from rcmarl_tpu.parallel import make_mesh, train_matrix
+        from rcmarl_tpu.training import init_train_state
+
+        n = 8
+        base = SMALL.replace(
+            n_agents=n,
+            agent_roles=(Roles.COOPERATIVE,) * n,
+            in_nodes=circulant_in_nodes(n, 4),
+        )
+        cfgs = [
+            base,
+            base.replace(H=1),
+            base.replace(
+                agent_roles=(Roles.COOPERATIVE,) * 7 + (Roles.MALICIOUS,),
+                H=1,
+            ),
+            base.replace(
+                agent_roles=(Roles.COOPERATIVE,) * 7 + (Roles.GREEDY,),
+                common_reward=True,
+            ),
+        ]
+        seeds = [3, 4]
+        mesh = make_mesh(8, seed_axis=4)  # ('seed', 'agent') = (4, 2)
+        states, metrics = train_matrix(
+            base, cfgs, seeds, n_blocks=2, mesh=mesh, shard_agents=True
+        )
+        for c, cfg in enumerate(cfgs):
+            for s, seed in enumerate(seeds):
+                i = c * len(seeds) + s
+                solo = init_train_state(cfg, jax.random.PRNGKey(seed))
+                solo_state, solo_metrics = train_scanned(cfg, solo, 2)
+                np.testing.assert_allclose(
+                    np.asarray(metrics.true_team_returns[i]),
+                    np.asarray(solo_metrics.true_team_returns),
+                    rtol=1e-4,
+                    atol=1e-6,
+                )
+                for a, b in zip(
+                    jax.tree.leaves(
+                        jax.tree.map(lambda x: x[i], states.params)
+                    ),
+                    jax.tree.leaves(solo_state.params),
+                ):
+                    np.testing.assert_allclose(
+                        np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5
+                    )
