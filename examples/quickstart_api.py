@@ -46,3 +46,27 @@ print(f"resumed for another {len(more)} episodes")
 #    (sharded over all available devices).
 states, metrics = train_parallel(cfg.replace(n_episodes=100), seeds=[1, 2, 3, 4], n_blocks=2)
 print("per-seed mean returns:", metrics.true_team_returns.mean(axis=1).tolist())
+
+# 5) The WHOLE experiment matrix as one program: cells with different
+#    scenarios (roles / trim H / reward mode) run as replicas of a single
+#    jitted program, their knobs passed as traced data (`sweep --fused`
+#    uses exactly this API).
+from rcmarl_tpu.parallel import split_matrix_metrics, train_matrix
+
+base = cfg.replace(n_episodes=100)
+cells = [
+    base.replace(agent_roles=(Roles.COOPERATIVE,) * 5, H=0),  # coop
+    base,                                                     # greedy H=1
+    base.replace(
+        agent_roles=(Roles.COOPERATIVE,) * 4 + (Roles.MALICIOUS,),
+        H=1,
+        common_reward=True,
+    ),                                                        # malicious_global
+]
+states, metrics = train_matrix(base, cells, seeds=[1, 2], n_blocks=2)
+for name, row in zip(
+    ["coop H=0", "greedy H=1", "malicious_global H=1"],
+    split_matrix_metrics(metrics, len(cells), 2),
+):
+    seed_means = [float(m.true_team_returns.mean()) for m in row]
+    print(f"{name}: per-seed team returns {seed_means}")

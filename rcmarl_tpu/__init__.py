@@ -16,7 +16,7 @@ actor updates — runs as vmapped/jitted XLA programs. Independent
 training seeds are vmapped/sharded across TPU cores.
 """
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 from rcmarl_tpu.config import (  # noqa: F401
     Config,

@@ -47,7 +47,6 @@ from rcmarl_tpu.models.mlp import (
     trunk_forward,
 )
 from rcmarl_tpu.ops.aggregation import (
-    is_static_h,
     resilient_aggregate,
     resilient_aggregate_tree,
 )
@@ -242,10 +241,11 @@ def consensus_update_one(
          update; with Keras MSE + SUM_OVER_BATCH_SIZE the fast_lr cancels.
     """
     n_trunk = len(own) - 1
-    # traced H (the fused-matrix path) is XLA-only: the Pallas kernel
-    # fixes trim indices at lowering time (ops/aggregation.py)
+    # traced H (the fused-matrix path) is XLA-only; the aggregation layer
+    # resolves 'auto' to an impl that can lower and RAISES on an explicit
+    # pallas choice rather than silently downgrading (ops/aggregation.py)
     H = cfg.H if H is None else H
-    impl = cfg.consensus_impl if is_static_h(H) else "xla"
+    impl = cfg.consensus_impl
     # b) hidden-layer consensus over trunk arrays
     trunk_agg = resilient_aggregate_tree(
         tuple(nbr_msgs[i] for i in range(n_trunk)),

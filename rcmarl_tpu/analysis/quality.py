@@ -63,6 +63,7 @@ __all__ = [
     "quality_table",
     "episode_throughput_from_bench",
     "write_quality_md",
+    "plot_quality_crossing",
 ]
 
 #: Steps per episode at the reference configuration (max_ep_len,
@@ -111,18 +112,29 @@ def _cell_curves(root, scen, H) -> list:
     ]
 
 
-def _crossing(curves: list, threshold: float, rolling: int) -> float:
-    """Episodes-to-threshold of the seed-mean curve, smoothed with a
-    FULL-window rolling mean (a crossing must be supported by ``rolling``
-    whole episodes — no min_periods=1 startup noise)."""
-    if not curves:
-        return float("nan")
+def _smoothed_mean(curves: list, rolling: int) -> pd.Series:
+    """Seed-mean curve under a FULL-window rolling mean (``min_periods =
+    rolling``: no startup noise from partially-filled windows). The ONE
+    smoothing used by the table and the figures alike."""
     mean = pd.concat(
         [c.reset_index(drop=True) for c in curves], axis=1
     ).mean(axis=1)
-    return episodes_to_threshold(
-        mean.rolling(rolling, min_periods=rolling).mean(), threshold
-    )
+    return mean.rolling(rolling, min_periods=rolling).mean()
+
+
+def _threshold_from_ref(ref_curves: list, window: int, tol: float):
+    """(ref_final, threshold): the reference's converged seed-mean and
+    the within-``tol`` quality bar derived from it — the ONE threshold
+    definition shared by the table and the figures."""
+    T = float(np.mean([c.iloc[-window:].mean() for c in ref_curves]))
+    return T, T - tol * abs(T)
+
+
+def _crossing(curves: list, threshold: float, rolling: int) -> float:
+    """Episodes-to-threshold of the smoothed seed-mean curve."""
+    if not curves:
+        return float("nan")
+    return episodes_to_threshold(_smoothed_mean(curves, rolling), threshold)
 
 
 def quality_table(
@@ -165,10 +177,9 @@ def quality_table(
             "mine_seeds": len(mine_curves),
         }
         if ref_curves:
-            row["ref_final"] = float(
-                np.mean([c.iloc[-window:].mean() for c in ref_curves])
+            row["ref_final"], row["threshold"] = _threshold_from_ref(
+                ref_curves, window, tol
             )
-            row["threshold"] = row["ref_final"] - tol * abs(row["ref_final"])
             row["ep_ref"] = _crossing(ref_curves, row["threshold"], rolling)
             row["ep_mine"] = _crossing(
                 mine_curves, row["threshold"], rolling
@@ -253,6 +264,64 @@ def _fmt_ep(e: float, n_seeds: int) -> str:
 
 def _fmt_val(x: float) -> str:
     return f"{x:.2f}" if np.isfinite(x) else "—"
+
+
+def plot_quality_crossing(
+    mine_dir,
+    ref_dir,
+    out_path,
+    scenario: str = "coop",
+    H: int = 1,
+    window: int = 500,
+    tol: float = 0.05,
+    rolling: int = 200,
+) -> str:
+    """The visual behind one QUALITY.md row: both smoothed seed-mean
+    curves, the threshold line (within ``tol`` of the reference's
+    converged return), and each curve's first crossing marked. Same
+    full-window smoothing and threshold math as :func:`quality_table`."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    ref_curves = _cell_curves(Path(ref_dir), scenario, H)
+    mine_curves = _cell_curves(Path(mine_dir), scenario, H)
+    if not ref_curves or not mine_curves:
+        raise FileNotFoundError(
+            f"cell {scenario}/H={H} missing under {mine_dir} or {ref_dir}"
+        )
+    T, threshold = _threshold_from_ref(ref_curves, window, tol)
+
+    fig, ax = plt.subplots(figsize=(7, 4))
+    for label, curves in (
+        ("reference artifacts", ref_curves),
+        ("this framework", mine_curves),
+    ):
+        curve = _smoothed_mean(curves, rolling)
+        (line,) = ax.plot(curve, label=label)
+        ep = episodes_to_threshold(curve, threshold)
+        if np.isfinite(ep):
+            ax.axvline(
+                ep, color=line.get_color(), linestyle=":", alpha=0.7
+            )
+            ax.plot([ep], [curve.iloc[int(ep)]], "o", color=line.get_color())
+    ax.axhline(
+        threshold,
+        color="gray",
+        linestyle="--",
+        label=f"threshold ({tol:.0%} of ref final {T:.2f})",
+    )
+    ax.set_xlabel("Episode (dotted = first crossing)")
+    ax.set_ylabel(f"True team return (rolling {rolling}, full window)")
+    ax.set_title(f"{scenario}, H={H}: episodes to reference quality")
+    ax.legend(fontsize=8)
+    fig.tight_layout()
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return str(out_path)
 
 
 def write_quality_md(
@@ -370,6 +439,8 @@ def write_quality_md(
         f"- `{bench_jsonl}` — the measured block-time rows behind the "
         "wall-clock columns",
         "- `BENCH_SCALING.md` — scaling matrix narrative",
+        "- `simulation_results/figures/quality_*.png` — per-cell "
+        "crossing figures (`python -m rcmarl_tpu plot --quality`)",
         "",
     ]
     Path(out_path).write_text("\n".join(lines))

@@ -560,6 +560,9 @@ BENCH_CONFIGS = {
     "n64_ring": dict(n_agents=64, hidden=(20, 20), degree=4, H=1),
     "n64_full": dict(n_agents=64, hidden=(20, 20), degree=None, H=1),
     "n64_large_h2": dict(n_agents=64, hidden=(256, 256, 256), degree=8, H=2),
+    # one axis beyond BASELINE.json's matrix: does the batched consensus
+    # sort keep scaling past N=64? (16x16 grid, deg-8 ring, H=2)
+    "n256_ring": dict(n_agents=256, hidden=(20, 20), degree=8, H=2),
 }
 
 
@@ -897,6 +900,28 @@ def cmd_plot(argv) -> int:
         help="reference artifact tree for --drift overlays "
         "(same convention as `parity`)",
     )
+    p.add_argument(
+        "--quality",
+        nargs="*",
+        default=None,
+        metavar="SCENARIO:H",
+        help="also render episodes-to-reference-quality crossing figures "
+        "(QUALITY.md evidence); no args = coop:1 malicious:1, or pass "
+        "cells like 'greedy:1 faulty:0'",
+    )
+    p.add_argument(
+        "--window",
+        type=int,
+        default=500,
+        help="final-episode window for the --quality threshold (must "
+        "match the `quality` run the figures are cited under)",
+    )
+    p.add_argument(
+        "--tolerance",
+        type=float,
+        default=0.05,
+        help="--quality threshold tolerance (same convention as `quality`)",
+    )
     args = p.parse_args(argv)
 
     from rcmarl_tpu.analysis.plots import (
@@ -924,6 +949,29 @@ def cmd_plot(argv) -> int:
                 Path(args.out) / f"drift_{scen}_h{h_val}.png",
                 scenario=scen,
                 H=h_val,
+                rolling=args.rolling,
+            )
+            print(path)
+    if args.quality is not None:
+        from rcmarl_tpu.analysis.quality import plot_quality_crossing
+
+        for cell in args.quality or ["coop:1", "malicious:1"]:
+            scen, _, h = cell.partition(":")
+            try:
+                h_val = int(h) if h else 1
+            except ValueError:
+                raise SystemExit(
+                    f"--quality: bad cell spec {cell!r}; expected "
+                    "SCENARIO:H like 'coop:1'"
+                )
+            path = plot_quality_crossing(
+                args.raw_data,
+                args.ref_raw_data,
+                Path(args.out) / f"quality_{scen}_h{h_val}.png",
+                scenario=scen,
+                H=h_val,
+                window=args.window,
+                tol=args.tolerance,
                 rolling=args.rolling,
             )
             print(path)

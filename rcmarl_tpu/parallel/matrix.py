@@ -44,6 +44,7 @@ from rcmarl_tpu.training.rollout import EpisodeMetrics
 from rcmarl_tpu.training.trainer import TrainState, train_scanned
 from rcmarl_tpu.training.update import spec_from_config
 from rcmarl_tpu.parallel.seeds import (
+    cached_jit,
     init_states,
     make_mesh,
     reset_states_for_phase,
@@ -159,21 +160,15 @@ def train_matrix(
     # The compiled executable depends only on program SHAPE — cell knobs
     # are data — so phase 2 of a sweep (and any repeated/resumed call)
     # must reuse it: that is the "one compile for the whole matrix"
-    # benefit. Shares seeds._JIT_CACHE, discriminated from
-    # train_parallel's keys by the leading tag.
-    from rcmarl_tpu.parallel import seeds as _seeds
-
-    key = ("matrix", base, n_blocks, mesh, shard_agents, n_rep)
-    fn = _seeds._JIT_CACHE.get(key)
-    if fn is None:
-        fn = jax.jit(
+    # benefit.
+    fn = cached_jit(
+        ("matrix", base, n_blocks, mesh, shard_agents, n_rep),
+        lambda: jax.jit(
             jax.vmap(lambda st, sp: train_scanned(base, st, n_blocks, sp)),
             in_shardings=(in_shard, spec_shard),
             out_shardings=(in_shard, NamedSharding(mesh, P("seed"))),
-        )
-        if len(_seeds._JIT_CACHE) >= _seeds._JIT_CACHE_MAX:
-            _seeds._JIT_CACHE.pop(next(iter(_seeds._JIT_CACHE)))
-        _seeds._JIT_CACHE[key] = fn
+        ),
+    )
     return fn(states, specs)
 
 

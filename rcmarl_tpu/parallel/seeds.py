@@ -134,10 +134,24 @@ def reset_states_for_phase(cfg: Config, states: TrainState, seeds) -> TrainState
     return jax.vmap(one)(states, jnp.asarray(seeds, jnp.uint32))
 
 
-#: Compiled-program cache for :func:`train_parallel` (bounded FIFO: the
-#: CLI touches a handful of configs; tests churn many tiny ones).
+#: Compiled-program cache for :func:`train_parallel` and
+#: :func:`rcmarl_tpu.parallel.matrix.train_matrix` (bounded FIFO: the CLI
+#: touches a handful of configs; tests churn many tiny ones).
 _JIT_CACHE: dict = {}
 _JIT_CACHE_MAX = 32
+
+
+def cached_jit(key, build):
+    """Bounded-FIFO memo for compiled multi-replica programs: repeated
+    calls with the same program shape (phase 2 of a sweep, benchmark
+    reps) reuse the executable instead of re-tracing a fresh closure."""
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        fn = build()
+        if len(_JIT_CACHE) >= _JIT_CACHE_MAX:
+            _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
+        _JIT_CACHE[key] = fn
+    return fn
 
 
 def train_parallel(
@@ -182,20 +196,14 @@ def train_parallel(
     in_shard = state_shardings(mesh, states, shard_agents)
     states = jax.device_put(states, in_shard)
 
-    # One jitted program per (cfg, n_blocks, mesh, shard_agents): repeated
-    # calls — phase 2 of a sweep, timed benchmark reps — reuse the compiled
-    # executable instead of re-tracing a fresh closure every time.
-    key = (cfg, n_blocks, mesh, shard_agents)
-    fn = _JIT_CACHE.get(key)
-    if fn is None:
-        fn = jax.jit(
+    fn = cached_jit(
+        ("seeds", cfg, n_blocks, mesh, shard_agents),
+        lambda: jax.jit(
             jax.vmap(lambda s: train_scanned(cfg, s, n_blocks)),
             in_shardings=(in_shard,),
             out_shardings=(in_shard, NamedSharding(mesh, P("seed"))),
-        )
-        if len(_JIT_CACHE) >= _JIT_CACHE_MAX:
-            _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
-        _JIT_CACHE[key] = fn
+        ),
+    )
     return fn(states)
 
 

@@ -230,3 +230,27 @@ class TestShardedMatrix:
                     np.testing.assert_allclose(
                         np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5
                     )
+
+
+def test_spec_with_explicit_pallas_raises():
+    """An explicit consensus_impl='pallas' must NOT be silently
+    downgraded on the traced-H path — the aggregation layer raises
+    (auto still resolves to xla and works)."""
+    from rcmarl_tpu.training import init_agent_params, update_block
+
+    cfg = CELLS["coop_h1_common"].replace(consensus_impl="pallas")
+    params = init_agent_params(jax.random.PRNGKey(0), cfg)
+    batch = _fresh(cfg, 0.1)
+    with pytest.raises(ValueError, match="traced H requires the xla"):
+        update_block(
+            cfg, params, batch, batch, jax.random.PRNGKey(1),
+            spec_from_config(cfg),
+        )
+    auto = cfg.replace(consensus_impl="auto")
+    out = update_block(
+        auto, params, batch, batch, jax.random.PRNGKey(1),
+        spec_from_config(auto),
+    )
+    assert all(
+        bool(jnp.all(jnp.isfinite(l))) for l in jax.tree.leaves(out)
+    )

@@ -307,3 +307,33 @@ class TestWriteQualityMd:
         # identical curves: both cross at the same episode, ratio 1.00
         assert "| 1.00 |" in text
         assert "coop" in text
+
+
+class TestQualityFigure:
+    def test_plot_quality_crossing(self, tmp_path):
+        from rcmarl_tpu.analysis.quality import plot_quality_crossing
+
+        ref = tmp_path / "ref"
+        mine = tmp_path / "mine"
+        curve = np.concatenate(
+            [np.linspace(-9.0, -5.0, 300), np.full(300, -5.0)]
+        )
+        _write_run(ref / "coop" / "H=1" / "seed=100", curve, phases=2)
+        _write_run(mine / "coop" / "H=1" / "seed=100", curve, phases=2)
+        out = plot_quality_crossing(
+            mine, ref, tmp_path / "fig.png", scenario="coop", H=1,
+            window=100, rolling=20,
+        )
+        assert (tmp_path / "fig.png").stat().st_size > 0
+        assert out.endswith("fig.png")
+
+    def test_plot_quality_missing_cell_raises(self, tmp_path):
+        from rcmarl_tpu.analysis.quality import plot_quality_crossing
+
+        ref = tmp_path / "ref"
+        _write_run(ref / "coop" / "H=1" / "seed=100", np.full(100, -5.0))
+        with pytest.raises(FileNotFoundError, match="missing"):
+            plot_quality_crossing(
+                tmp_path / "empty", ref, tmp_path / "f.png",
+                scenario="coop", H=1,
+            )
