@@ -371,6 +371,19 @@ def write_parity_md(
     Path(path).write_text("\n".join(lines) + "\n")
 
 
+def save_figure(fig, out_path) -> str:
+    """The one figure-writing convention (layout, dpi, parent dirs,
+    close) shared by every plot in this package."""
+    import matplotlib.pyplot as plt
+
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return str(out_path)
+
+
 def _phase_boundaries(scenario_dir, H: int) -> List[int]:
     """Episode indices where a new phase starts (first seed run's phase
     lengths, cumulative, excluding 0 and the end) — where the restart
@@ -422,12 +435,7 @@ def plot_drift_comparison(
     ax.set_ylabel(f"True team return (rolling {rolling})")
     ax.set_title(f"{scenario}, H={H}: ours vs shipped artifacts")
     ax.legend(fontsize=8)
-    fig.tight_layout()
-    out_path = Path(out_path)
-    out_path.parent.mkdir(parents=True, exist_ok=True)
-    fig.savefig(out_path, dpi=120)
-    plt.close(fig)
-    return str(out_path)
+    return save_figure(fig, out_path)
 
 
 def plot_returns(
@@ -485,9 +493,5 @@ def plot_returns(
             ax.set_ylabel("Discounted return")
             ax.set_title(f"{scen}, H={H}")
             ax.legend(fontsize=8)
-            fig.tight_layout()
-            path = out_dir / f"{scen}_h{H}.png"
-            fig.savefig(path, dpi=120)
-            plt.close(fig)
-            written.append(str(path))
+            written.append(save_figure(fig, out_dir / f"{scen}_h{H}.png"))
     return written

@@ -56,6 +56,7 @@ from rcmarl_tpu.analysis.plots import (
     DEFAULT_REF_RAW_DATA,
     _h_cells,
     _seed_runs,
+    save_figure,
 )
 
 __all__ = [
@@ -316,12 +317,7 @@ def plot_quality_crossing(
     ax.set_ylabel(f"True team return (rolling {rolling}, full window)")
     ax.set_title(f"{scenario}, H={H}: episodes to reference quality")
     ax.legend(fontsize=8)
-    fig.tight_layout()
-    out_path = Path(out_path)
-    out_path.parent.mkdir(parents=True, exist_ok=True)
-    fig.savefig(out_path, dpi=120)
-    plt.close(fig)
-    return str(out_path)
+    return save_figure(fig, out_path)
 
 
 def write_quality_md(

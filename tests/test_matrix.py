@@ -254,3 +254,31 @@ def test_spec_with_explicit_pallas_raises():
     assert all(
         bool(jnp.all(jnp.isfinite(l))) for l in jax.tree.leaves(out)
     )
+
+
+class TestFusableChecks:
+    def test_rejects_shape_divergence(self):
+        """Cells may differ ONLY in roles/H/common_reward."""
+        from rcmarl_tpu.parallel import train_matrix
+
+        base = CELLS["coop_h0"]
+        widened = base.replace(hidden=(30, 30))
+        with pytest.raises(ValueError, match="beyond"):
+            train_matrix(base, [base, widened], [0], n_blocks=1)
+
+    def test_rejects_pallas_impl(self):
+        from rcmarl_tpu.parallel import train_matrix
+
+        base = CELLS["coop_h0"].replace(consensus_impl="pallas")
+        with pytest.raises(ValueError, match="XLA path"):
+            train_matrix(base, [base], [0], n_blocks=1)
+
+    def test_rejects_ragged_graph(self):
+        from rcmarl_tpu.parallel import train_matrix
+
+        base = CELLS["coop_h0"].replace(
+            in_nodes=((0, 1, 2, 3), (1, 2, 3), (2, 3, 4), (3, 4, 0), (4, 0, 1)),
+            H=0,
+        )
+        with pytest.raises(ValueError, match="uniform-degree"):
+            train_matrix(base, [base], [0], n_blocks=1)
