@@ -13,6 +13,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
 
 from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
 from rcmarl_tpu.training import (
@@ -282,3 +284,39 @@ class TestFusableChecks:
         )
         with pytest.raises(ValueError, match="uniform-degree"):
             train_matrix(base, [base], [0], n_blocks=1)
+
+
+class TestSpecEquivalenceProperty:
+    """Random scenario knobs, not just the five hand-picked cells: ANY
+    role composition x H x reward mode must produce identical numerics
+    between the static path (cfg-specialized, compiled per composition)
+    and the spec path (one program, knobs as data)."""
+
+    @pytest.mark.slow
+    @settings(max_examples=6, deadline=None)
+    @given(
+        roles=st.lists(
+            st.sampled_from(
+                [Roles.COOPERATIVE, Roles.GREEDY, Roles.FAULTY,
+                 Roles.MALICIOUS]
+            ),
+            min_size=5,
+            max_size=5,
+        ),
+        H=st.integers(min_value=0, max_value=1),
+        common=st.booleans(),
+        seed=st.integers(min_value=0, max_value=2**16),
+    )
+    def test_random_cell_matches_static(self, roles, H, common, seed):
+        cfg = SMALL.replace(
+            agent_roles=tuple(roles), H=H, common_reward=common
+        )
+        base = SMALL.replace(H=0, common_reward=False)  # all-cooperative
+        params = init_agent_params(jax.random.PRNGKey(seed), cfg)
+        batch, fresh = _fresh(cfg, 0.1), _fresh(cfg, 0.3)
+        key = jax.random.PRNGKey(seed + 1)
+        static = update_block(cfg, params, batch, fresh, key)
+        traced = update_block(
+            base, params, batch, fresh, key, spec_from_config(cfg)
+        )
+        _assert_trees_equal(static, traced, rtol=0, atol=0)
