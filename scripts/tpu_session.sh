@@ -1,0 +1,75 @@
+#!/usr/bin/env bash
+# The queued TPU measurement set (BENCH_SCALING.md tunnel-outage
+# post-mortem). Run on a host with a healthy axon tunnel:
+#
+#   bash scripts/tpu_session.sh
+#
+# Probes first with a hard timeout (a wedged tunnel hangs any backend
+# init, including a bare jax.devices()); if the probe fails nothing else
+# runs. After that every step runs INDEPENDENTLY — one failing or
+# timed-out measurement must not cost the rest of the session — and a
+# status summary prints at the end. In order of value:
+#   1. the N=64 / N=256 scaling rows x {xla, pallas} (BENCH_SCALING.jsonl)
+#   2. per-phase TPU profile rows (PERF.jsonl; completes PERF.md's table)
+#   3. a bfloat16 row for the 256-wide config (the MXU-native compute
+#      mode; its float32 comparator is step 1's n64_large_h2/xla row)
+#   4. the fused experiment matrix at the published scale - 16 cells x
+#      3 seeds x 2x4000 episodes as ONE program per phase (writes a
+#      sweep tree under /tmp, we only need the printed wall-clock)
+#   5. bench.py headline sanity (the driver runs this at round end too)
+#
+# Every command appends self-describing JSONL rows; nothing here edits
+# narrative docs - update BENCH_SCALING.md / PERF.md from the new rows.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== probe =="
+if ! timeout 240 python -c "import jax; d = jax.devices(); print(d); assert d[0].platform != 'cpu', 'CPU fallback - tunnel down'"; then
+    echo "probe FAILED - tunnel down, aborting before any measurement"
+    exit 1
+fi
+
+declare -A status
+
+run_step() {
+    local name="$1"; shift
+    echo "== ${name} =="
+    if "$@"; then
+        status["$name"]=ok
+    else
+        status["$name"]="FAILED (rc=$?)"
+    fi
+}
+
+run_step "1. scaling rows (n64/n256 x xla/pallas)" \
+    timeout 5400 python -m rcmarl_tpu bench \
+    --configs n64_ring n64_full n64_large_h2 n256_ring \
+    --impl xla pallas --out BENCH_SCALING.jsonl
+
+run_step "2. per-phase profile rows" \
+    timeout 3600 python -m rcmarl_tpu profile \
+    --configs ref5_ring n64_large_h2 --impl xla pallas --out PERF.jsonl
+
+run_step "3. bfloat16 row (256-wide config)" \
+    timeout 1800 python -m rcmarl_tpu bench \
+    --configs n64_large_h2 --impl xla \
+    --compute_dtype bfloat16 --out BENCH_SCALING.jsonl
+
+run_step "4. fused published matrix, one program per phase" \
+    timeout 5400 python -m rcmarl_tpu sweep --fused \
+    --scenarios coop coop_global greedy greedy_global \
+    faulty faulty_global malicious malicious_global \
+    --H 0 1 --seeds 100 200 300 --n_episodes 4000 --phases 2 \
+    --out /tmp/fused_tpu_matrix
+
+run_step "5. headline" \
+    timeout 3600 python bench.py
+
+echo "== session summary =="
+rc=0
+for name in "${!status[@]}"; do
+    echo "  ${name}: ${status[$name]}"
+    [ "${status[$name]}" = ok ] || rc=1
+done
+echo "== update BENCH_SCALING.md / PERF.md / PARALLELISM.md from the new rows =="
+exit "$rc"

@@ -288,9 +288,16 @@ class TestFusableChecks:
 
 class TestSpecEquivalenceProperty:
     """Random scenario knobs, not just the five hand-picked cells: ANY
-    role composition x H x reward mode must produce identical numerics
-    between the static path (cfg-specialized, compiled per composition)
-    and the spec path (one program, knobs as data)."""
+    role composition x H x reward mode must match the static path
+    (cfg-specialized, compiled per composition) to float32 rounding.
+
+    Tolerance note: the hand-picked cells in TestSpecEquivalence are
+    bitwise-equal, but that is not guaranteed in general — e.g. the
+    traced ``jnp.where(common_reward, r_team, r_agents)`` select and the
+    static broadcast compile to differently-fused programs, which can
+    differ by ~1e-8 under common_reward with adversaries present
+    (hypothesis found roles=[C,C,C,G,G], H=0, common=True). Semantics
+    are identical; only XLA fusion order differs."""
 
     @pytest.mark.slow
     @settings(max_examples=6, deadline=None)
@@ -308,10 +315,8 @@ class TestSpecEquivalenceProperty:
         seed=st.integers(min_value=0, max_value=2**16),
     )
     def test_random_cell_matches_static(self, roles, H, common, seed):
-        cfg = SMALL.replace(
-            agent_roles=tuple(roles), H=H, common_reward=common
-        )
-        base = SMALL.replace(H=0, common_reward=False)  # all-cooperative
+        cfg = _cell_cfg(roles=tuple(roles), H=H, common_reward=common)
+        base = _cell_cfg()  # all-cooperative, H=0, private reward
         params = init_agent_params(jax.random.PRNGKey(seed), cfg)
         batch, fresh = _fresh(cfg, 0.1), _fresh(cfg, 0.3)
         key = jax.random.PRNGKey(seed + 1)
@@ -319,4 +324,4 @@ class TestSpecEquivalenceProperty:
         traced = update_block(
             base, params, batch, fresh, key, spec_from_config(cfg)
         )
-        _assert_trees_equal(static, traced, rtol=0, atol=0)
+        _assert_trees_equal(static, traced, rtol=1e-5, atol=1e-7)
