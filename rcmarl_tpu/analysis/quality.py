@@ -437,6 +437,15 @@ def write_quality_md(
         "- `BENCH_SCALING.md` — scaling matrix narrative",
         "- `simulation_results/figures/quality_*.png` — per-cell "
         "crossing figures (`python -m rcmarl_tpu plot --quality`)",
-        "",
     ]
+    # like cmd_parity's related-artifacts list: only link the robustness
+    # companion when it exists, and never from itself
+    companion = Path(out_path).parent / "QUALITY_SEEDS456.md"
+    if companion.exists() and Path(out_path).name != companion.name:
+        lines.append(
+            "- `QUALITY_SEEDS456.md` — the same pipeline over the three "
+            "UNSEEN seeds {400,500,600} (robustness companion, like "
+            "PARITY_SEEDS456.md)"
+        )
+    lines.append("")
     Path(out_path).write_text("\n".join(lines))

@@ -30,10 +30,12 @@ if ! timeout 240 python -c "import jax; d = jax.devices(); print(d); assert d[0]
 fi
 
 declare -A status
+step_order=()
 
 run_step() {
     local name="$1"; shift
     echo "== ${name} =="
+    step_order+=("$name")
     if "$@"; then
         status["$name"]=ok
     else
@@ -67,7 +69,7 @@ run_step "5. headline" \
 
 echo "== session summary =="
 rc=0
-for name in "${!status[@]}"; do
+for name in "${step_order[@]}"; do
     echo "  ${name}: ${status[$name]}"
     [ "${status[$name]}" = ok ] || rc=1
 done
