@@ -6,12 +6,18 @@ in about a minute on CPU (``JAX_PLATFORMS=cpu python
 examples/quickstart_api.py``); on a TPU chip crank ``n_episodes`` up.
 """
 
+import os
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
 
 import jax
+
+# smoke-test hook (tests/test_examples.py): shrink workloads, same code
+FAST = os.environ.get("RCMARL_EXAMPLE_FAST") == "1"
+EPISODES = 50 if FAST else 200
+SMALL_EPISODES = 50 if FAST else 100  # the scale-out walkthrough sections
 
 from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
 from rcmarl_tpu.parallel import train_parallel
@@ -25,7 +31,7 @@ cfg = Config(
     in_nodes=circulant_in_nodes(5, 4),
     H=1,
     slow_lr=0.002,
-    n_episodes=200,
+    n_episodes=EPISODES,
     seed=100,
 )
 
@@ -44,7 +50,7 @@ print(f"resumed for another {len(more)} episodes")
 
 # 4) Seed-parallel: several independent replicas as ONE device program
 #    (sharded over all available devices).
-states, metrics = train_parallel(cfg.replace(n_episodes=100), seeds=[1, 2, 3, 4], n_blocks=2)
+states, metrics = train_parallel(cfg.replace(n_episodes=SMALL_EPISODES), seeds=[1, 2, 3, 4], n_blocks=2)
 print("per-seed mean returns:", metrics.true_team_returns.mean(axis=1).tolist())
 
 # 5) The WHOLE experiment matrix as one program: cells with different
@@ -53,7 +59,7 @@ print("per-seed mean returns:", metrics.true_team_returns.mean(axis=1).tolist())
 #    uses exactly this API).
 from rcmarl_tpu.parallel import split_matrix_metrics, train_matrix
 
-base = cfg.replace(n_episodes=100)
+base = cfg.replace(n_episodes=SMALL_EPISODES)
 cells = [
     base.replace(agent_roles=(Roles.COOPERATIVE,) * 5, H=0),  # coop
     base,                                                     # greedy H=1

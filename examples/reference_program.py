@@ -13,6 +13,7 @@ train`` or ``examples/quickstart_api.py``; this compat path runs the
 object protocol eagerly, exactly like the reference.)
 """
 
+import os
 import sys
 from pathlib import Path
 
@@ -38,7 +39,8 @@ args = {
     "in_nodes": [[0, 1, 2, 3], [1, 2, 3, 4], [2, 3, 4, 0], [3, 4, 0, 1], [4, 0, 1, 2]],
     "n_actions": 5,
     "n_states": 2,
-    "n_episodes": 40,
+    # smoke-test hook (tests/test_examples.py) halves this
+    "n_episodes": 20 if os.environ.get("RCMARL_EXAMPLE_FAST") == "1" else 40,
     "max_ep_len": 20,
     "n_ep_fixed": 10,
     "n_epochs": 2,

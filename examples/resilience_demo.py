@@ -15,6 +15,7 @@ examples/resilience_demo.py``); the separation grows with episode count
 vs H=1).
 """
 
+import os
 import sys
 from pathlib import Path
 
@@ -23,7 +24,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
 from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
 from rcmarl_tpu.training.trainer import train
 
-EPISODES = 600
+# smoke-test hook (tests/test_examples.py): shrink, same code
+EPISODES = 100 if os.environ.get("RCMARL_EXAMPLE_FAST") == "1" else 600
 CASTS = {
     "all-cooperative": (Roles.COOPERATIVE,) * 5,
     "malicious": (Roles.COOPERATIVE,) * 4 + (Roles.MALICIOUS,),

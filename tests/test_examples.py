@@ -1,0 +1,67 @@
+"""Smoke tests for the runnable examples.
+
+The examples are user-facing documentation; they must keep executing as
+the API evolves. Each runs as a real subprocess (fresh interpreter, CPU
+platform forced the same way a user would) with the
+``RCMARL_EXAMPLE_FAST`` hook shrinking workloads — same code paths,
+smaller episode counts.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
+
+
+def _run(name: str, timeout: int):
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        RCMARL_EXAMPLE_FAST="1",
+    )
+    proc = subprocess.run(
+        [sys.executable, str(EXAMPLES / name)],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+    )
+    assert proc.returncode == 0, (
+        f"{name} failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    return proc.stdout
+
+
+def test_env_demo():
+    out = _run("env_demo.py", timeout=300)
+    assert "goal layout" in out and "t=0:" in out
+
+
+@pytest.mark.slow
+def test_reference_program():
+    # stale artifacts from a previous run must not satisfy the assertion
+    import shutil
+
+    shutil.rmtree("/tmp/reference_program_out", ignore_errors=True)
+    out = _run("reference_program.py", timeout=900)
+    assert "compat twins" in out
+    # reference-format artifacts written
+    assert (Path("/tmp/reference_program_out") / "sim_data.pkl").exists()
+
+
+@pytest.mark.slow
+def test_resilience_demo():
+    out = _run("resilience_demo.py", timeout=900)
+    assert "attack cost without defense" in out
+
+
+@pytest.mark.slow
+def test_quickstart_api():
+    out = _run("quickstart_api.py", timeout=1200)
+    assert "team return" in out
+    assert "per-seed team returns" in out  # the train_matrix walkthrough
