@@ -9,6 +9,14 @@ Environment gotchas (see .claude/skills/verify/SKILL.md):
   both force JAX_PLATFORMS=cpu AND deregister the axon backend factory:
   initializing the axon plugin dials the tunnel and can block the whole
   process if the tunnel is unhealthy — tests must never depend on it.
+- Deregistering the factory cannot UNLOAD the plugin's native library,
+  which sitecustomize already pulled into the process. With the tunnel
+  WEDGED, full-suite runs on this machine crashed nondeterministically
+  late in the process (SIGSEGV in executable serialize/deserialize,
+  SIGABRT inside an unrelated pjit call — 3 of 3 runs), while the same
+  suite passes with the sitecustomize disabled. If the tunnel is
+  unhealthy, run the suite as ``PYTHONPATH= python -m pytest tests/ -q``
+  so the plugin never loads.
 """
 
 import os
@@ -30,15 +38,22 @@ try:  # deregister the axon PJRT plugin installed by sitecustomize
     # sitecustomize's register() may have snapshotted jax_platforms=axon
     # before this conftest ran; force it back.
     jax.config.update("jax_platforms", "cpu")
-    # Persistent compilation cache: the suite is dominated by XLA compiles
-    # of the jitted trainer programs (identical across runs), so caching
-    # them cuts repeat wall-clock dramatically (VERDICT.md round-1
-    # weakness 3). Keyed on HLO + flags; safe across processes.
-    _repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    jax.config.update(
-        "jax_compilation_cache_dir", os.path.join(_repo_root, ".jax_cache")
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # Persistent compilation cache: OPT-IN via RCMARL_TEST_CACHE=1.
+    # Caching the trainer compiles cuts repeat wall-clock ~3x, but late
+    # in a full-suite process (hundreds of live executables + TF loaded
+    # in-process by the golden tests) jaxlib 0.9.0's native executable
+    # serialize/deserialize can SEGFAULT nondeterministically (observed
+    # twice, round 3: put_executable_and_time and
+    # get_executable_and_time, rc=139) — and a randomly-crashing suite
+    # is worse than a slower deterministic one. Default is therefore no
+    # persistent cache; developers iterating on one test file can export
+    # RCMARL_TEST_CACHE=1 for fast warm reruns.
+    if os.environ.get("RCMARL_TEST_CACHE") == "1":
+        _repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(_repo_root, ".jax_cache")
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 except Exception:  # pragma: no cover - jax internals moved; env vars still apply
     pass
