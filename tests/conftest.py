@@ -9,14 +9,12 @@ Environment gotchas (see .claude/skills/verify/SKILL.md):
   both force JAX_PLATFORMS=cpu AND deregister the axon backend factory:
   initializing the axon plugin dials the tunnel and can block the whole
   process if the tunnel is unhealthy — tests must never depend on it.
-- Deregistering the factory cannot UNLOAD the plugin's native library,
-  which sitecustomize already pulled into the process. With the tunnel
-  WEDGED, full-suite runs on this machine crashed nondeterministically
-  late in the process (SIGSEGV in executable serialize/deserialize,
-  SIGABRT inside an unrelated pjit call — 3 of 3 runs), while the same
-  suite passes with the sitecustomize disabled. If the tunnel is
-  unhealthy, run the suite as ``PYTHONPATH= python -m pytest tests/ -q``
-  so the plugin never loads.
+- Single-core hosts: tests that EXECUTE cross-device collectives on the
+  8-device virtual mesh are skipped there (``needs_multicore`` in
+  tests/test_parallel.py) — XLA's in-process collective rendezvous can
+  starve when the host cannot run the participants concurrently, and
+  its AwaitAndLogIfStuck watchdog then CHECK-aborts the whole pytest
+  process (reproduced solo: InProcessCommunicator::AllGather).
 """
 
 import os
@@ -39,12 +37,11 @@ try:  # deregister the axon PJRT plugin installed by sitecustomize
     # before this conftest ran; force it back.
     jax.config.update("jax_platforms", "cpu")
     # Persistent compilation cache: OPT-IN via RCMARL_TEST_CACHE=1.
-    # Caching the trainer compiles cuts repeat wall-clock ~3x, but late
-    # in a full-suite process (hundreds of live executables + TF loaded
-    # in-process by the golden tests) jaxlib 0.9.0's native executable
-    # serialize/deserialize can SEGFAULT nondeterministically (observed
-    # twice, round 3: put_executable_and_time and
-    # get_executable_and_time, rc=139) — and a randomly-crashing suite
+    # Caching the trainer compiles cuts repeat wall-clock ~3x, but
+    # jaxlib 0.9.0's native executable serialize/deserialize SEGFAULTED
+    # twice in full-suite runs (round 3: put_executable_and_time and,
+    # after a timeout-killed run truncated an entry,
+    # get_executable_and_time — rc=139), and a randomly-crashing suite
     # is worse than a slower deterministic one. Default is therefore no
     # persistent cache; developers iterating on one test file can export
     # RCMARL_TEST_CACHE=1 for fast warm reruns.

@@ -9,6 +9,8 @@ statically-specialized solo twin — per update block, per full training
 block, and under vmap across replicas with DIFFERENT scenarios.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -182,6 +184,12 @@ class TestFusedSweepCLI:
 
 class TestShardedMatrix:
     @pytest.mark.slow
+    @pytest.mark.skipif(
+        len(os.sched_getaffinity(0)) < 2,
+        reason="multi-device collective EXECUTION deadlocks XLA's "
+        "rendezvous watchdog on a single-core host "
+        "(tests/test_parallel.py:needs_multicore)",
+    )
     def test_fused_matrix_on_mesh_matches_solo(self):
         """Cell fusion composes with mesh sharding (seed axis) AND
         agent-axis sharding: the sharded fused matrix equals each cell's
