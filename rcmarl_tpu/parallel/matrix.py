@@ -114,7 +114,8 @@ def train_matrix(
     mesh: Optional[Mesh] = None,
     states: Optional[TrainState] = None,
     shard_agents: bool = False,
-) -> Tuple[TrainState, EpisodeMetrics]:
+    compile_only: bool = False,
+) -> Optional[Tuple[TrainState, EpisodeMetrics]]:
     """Train every (cell, seed) replica in one sharded XLA program.
 
     Args:
@@ -130,9 +131,15 @@ def train_matrix(
       shard_agents: additionally partition the agent axis over the
         mesh's 'agent' dimension (consensus gathers become ICI
         collectives, PARALLELISM.md) — composes with cell fusion.
+      compile_only: lower and compile the sharded program, execute
+        nothing, return None. Validates shardings and collective
+        lowering on hosts where collective EXECUTION cannot run (e.g.
+        single-core virtual meshes, where XLA's in-process rendezvous
+        watchdog would abort — tests/conftest.py:needs_multicore).
 
     Returns (batched TrainState, EpisodeMetrics), leading axis
-    ``len(cells) * len(seeds)`` in cell-major order.
+    ``len(cells) * len(seeds)`` in cell-major order; None when
+    ``compile_only``.
     """
     _check_fusable(base, cells)
     n_rep = len(cells) * len(seeds)
@@ -169,6 +176,9 @@ def train_matrix(
             out_shardings=(in_shard, NamedSharding(mesh, P("seed"))),
         ),
     )
+    if compile_only:
+        fn.lower(states, specs).compile()
+        return None
     return fn(states, specs)
 
 

@@ -54,3 +54,27 @@ try:  # deregister the axon PJRT plugin installed by sitecustomize
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 except Exception:  # pragma: no cover - jax internals moved; env vars still apply
     pass
+
+
+def host_cores() -> int:
+    """Cores actually available to this process (affinity-aware on
+    Linux; portable fallback elsewhere)."""
+    if hasattr(os, "sched_getaffinity"):
+        return len(os.sched_getaffinity(0))
+    return os.cpu_count() or 1
+
+
+import pytest  # noqa: E402
+
+#: Tests that EXECUTE cross-device collectives on the virtual mesh need
+#: real host parallelism: on a single core, XLA's in-process communicator
+#: rendezvous can starve (all participants must arrive concurrently),
+#: trip AwaitAndLogIfStuck, and CHECK-abort the whole pytest process
+#: (reproduced solo: xla::cpu::InProcessCommunicator::AllGather).
+#: Seed-axis-only sharding has zero collectives and is unaffected;
+#: compiled-HLO collective tests only inspect lowering, never execute it.
+needs_multicore = pytest.mark.skipif(
+    host_cores() < 2,
+    reason="multi-device collective EXECUTION deadlocks XLA's rendezvous "
+    "watchdog on a single-core host",
+)

@@ -22,6 +22,7 @@ from rcmarl_tpu.parallel import (
     multihost_mesh,
     train_parallel,
 )
+from tests.conftest import needs_multicore
 
 
 def test_initialize_single_process_noop(monkeypatch):
@@ -52,6 +53,7 @@ def test_gather_metrics_single_process():
 
 
 @pytest.mark.slow
+@needs_multicore  # executes shard_agents=True collectives in-process
 def test_train_parallel_over_multihost_mesh():
     cfg = Config(
         n_agents=4,

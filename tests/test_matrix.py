@@ -9,8 +9,6 @@ statically-specialized solo twin — per update block, per full training
 block, and under vmap across replicas with DIFFERENT scenarios.
 """
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -26,6 +24,7 @@ from rcmarl_tpu.training import (
 )
 from rcmarl_tpu.training.trainer import train_block, train_scanned
 from rcmarl_tpu.training.update import spec_from_config
+from tests.conftest import needs_multicore
 from tests.test_trainer import SMALL, _fresh
 
 
@@ -184,12 +183,7 @@ class TestFusedSweepCLI:
 
 class TestShardedMatrix:
     @pytest.mark.slow
-    @pytest.mark.skipif(
-        len(os.sched_getaffinity(0)) < 2,
-        reason="multi-device collective EXECUTION deadlocks XLA's "
-        "rendezvous watchdog on a single-core host "
-        "(tests/test_parallel.py:needs_multicore)",
-    )
+    @needs_multicore
     def test_fused_matrix_on_mesh_matches_solo(self):
         """Cell fusion composes with mesh sharding (seed axis) AND
         agent-axis sharding: the sharded fused matrix equals each cell's
@@ -264,6 +258,28 @@ def test_spec_with_explicit_pallas_raises():
     assert all(
         bool(jnp.all(jnp.isfinite(l))) for l in jax.tree.leaves(out)
     )
+
+
+class TestCompileOnly:
+    def test_sharded_matrix_compiles_on_any_host(self):
+        """compile_only validates the agent-sharded fused program's
+        shardings and collective lowering WITHOUT executing collectives,
+        so it is safe even where needs_multicore skips execution."""
+        from rcmarl_tpu.parallel import make_mesh, train_matrix
+
+        n = 8
+        base = SMALL.replace(
+            n_agents=n,
+            agent_roles=(Roles.COOPERATIVE,) * n,
+            in_nodes=circulant_in_nodes(n, 4),
+        )
+        cells = [base, base.replace(H=1)]
+        mesh = make_mesh(8, seed_axis=4)
+        out = train_matrix(
+            base, cells, [3, 4], n_blocks=1, mesh=mesh,
+            shard_agents=True, compile_only=True,
+        )
+        assert out is None
 
 
 class TestFusableChecks:

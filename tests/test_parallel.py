@@ -1,8 +1,6 @@
 """Multi-device tests on the virtual 8-device CPU mesh (conftest forces
 ``xla_force_host_platform_device_count=8`` — SURVEY.md §4's test story)."""
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -93,21 +91,7 @@ class TestSeedParallel:
         assert np.all(np.isfinite(np.asarray(m.true_team_returns)))
 
 
-#: Executing cross-device collectives (shard_agents=True) on the virtual
-#: 8-device mesh requires real host parallelism: on a SINGLE core, XLA's
-#: in-process communicator rendezvous can starve (all 8 participants must
-#: arrive concurrently), trip AwaitAndLogIfStuck, and CHECK-abort the
-#: whole pytest process (observed as nondeterministic rc=134 full-suite
-#: crashes, then reproduced solo:
-#: xla::cpu::InProcessCommunicator::AllGather -> AwaitAndLogIfStuck).
-#: Seed-axis-only sharding has zero collectives and is unaffected; the
-#: compiled-HLO collective tests (test_consensus_comm.py) only inspect
-#: lowering, never execute it.
-needs_multicore = pytest.mark.skipif(
-    len(os.sched_getaffinity(0)) < 2,
-    reason="multi-device collective EXECUTION deadlocks XLA's rendezvous "
-    "watchdog on a single-core host",
-)
+from tests.conftest import needs_multicore
 
 
 class TestAgentSharding:
