@@ -27,11 +27,14 @@ subprocesses with hard wall-clock timeouts, orchestrated by this parent:
    ``"platform": "cpu"`` — honest, not a fake TPU claim).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
-"platform", "workload", "attempts"} plus, on TPU, "candidates" — the
-replica-count sweep (one isolated child per count) whose best aggregate
-throughput is the headline "value"; "workload" records the winning
-shape, and numbers are cross-round comparable only when workloads match.
-The CPU fallback adds "note".
+"platform", "workload", "attempts", "headline"} plus, on TPU,
+"candidates" — the replica-count sweep (one isolated child per count)
+whose best aggregate throughput is the headline "value"; "workload"
+records the winning shape, and numbers are cross-round comparable only
+when workloads match. "headline" is true only for an on-chip
+measurement: the CPU fallback sets it false and adds "note", because
+its vs_baseline is CPU-vs-CPU, not the chip multiplier BASELINE.md's
+>=50x target refers to.
 """
 
 import json
@@ -191,6 +194,8 @@ def main() -> int:
                 for c in candidates
             ]
             best["attempts"] = len(attempts)
+            # The on-chip number BASELINE.md's >=50x target is about.
+            best["headline"] = True
             print(json.dumps(best))
             return 0
 
@@ -204,10 +209,16 @@ def main() -> int:
     attempts.append({"stage": "cpu_measure", **res})
     if "value" in res:
         res["attempts"] = len(attempts)
+        # Self-distinguishing fallback (VERDICT r3 weak 5): a CPU number
+        # divided by the CPU baseline is NOT the chip multiplier, and no
+        # driver parsing vs_baseline should be able to mistake it for one.
+        res["headline"] = False
         res["note"] = (
-            "TPU backend unavailable; CPU fallback measurement. The axon "
-            "relay died mid-round-3 (post-mortem: BENCH_SCALING.md); last "
-            "TPU headline: BENCH_r02.json (388,243 steps/s, 155,297x)"
+            "TPU backend unavailable; CPU fallback measurement — "
+            "vs_baseline here is CPU-vs-CPU, NOT the on-chip multiplier. "
+            "Last TPU headline: the most recent BENCH_r*.json with "
+            'platform "tpu" (artifacts from round 4 on also carry '
+            '"headline": true there)'
         )
         print(json.dumps(res))
         return 0

@@ -27,10 +27,17 @@ Definition (per scenario x H cell):
 - **Degenerate cells**: in the undefended adversary cells (H=0) the
   attack drives the reference's converged return down to within
   tolerance of *starting* performance — there is no learning progress to
-  time, and the metric is meaningless by construction. A cell is flagged
-  ``degenerate`` when the reference's own curve is already at threshold
-  at its first fully-smoothed point; such cells are excluded from the
-  summary statistics but still printed.
+  time, and the metric is meaningless by construction. The at-threshold-
+  from-the-start test is applied to EACH side's curve; a cell is flagged
+  ``degenerate`` only when BOTH curves are already at threshold at their
+  first fully-smoothed point. When exactly one side starts at threshold
+  while the other climbs (or never arrives), the cell is flagged
+  ``asymmetric`` and reported as an explicit finding — a one-sided rule
+  would silently hide, e.g., a cell where the reference starts converged
+  but this framework needs thousands of episodes. Both kinds are
+  excluded from the summary ratio (an at-start crossing makes the ratio
+  meaningless) but printed, and asymmetric cells get a dedicated
+  findings paragraph.
 - **Wall-clock to threshold**: episodes / measured episode throughput.
   The reference side uses its derived 2.5 env-steps/s (BASELINE.md, SGE
   ``info`` log timestamps). Our side uses measured ``ref5_ring``
@@ -185,23 +192,45 @@ def quality_table(
             row["ep_mine"] = _crossing(
                 mine_curves, row["threshold"], rolling
             )
-        # no learning progress to time: the reference is already at
-        # threshold at its first fully-smoothed point, index rolling-1
-        # (the undefended-attack cells)
-        row["degenerate"] = (
+        # "at threshold from the first fully-smoothed point" (index
+        # rolling-1) is judged PER SIDE: a cell is only degenerate —
+        # nothing to time — when BOTH curves start there (the undefended-
+        # attack cells). One side at-start while the other climbs for
+        # thousands of episodes is an asymmetry, and must surface as a
+        # finding, not vanish under a one-sided exclusion.
+        row["degenerate_ref"] = (
             np.isfinite(row["ep_ref"]) and row["ep_ref"] < rolling
         )
-        row["ep_ratio"] = (
-            row["ep_ref"] / row["ep_mine"]
-            if row["ep_mine"] and not math.isnan(row["ep_mine"])
-            else float("nan")
+        row["degenerate_mine"] = (
+            np.isfinite(row["ep_mine"]) and row["ep_mine"] < rolling
         )
+        row["degenerate"] = row["degenerate_ref"] and row["degenerate_mine"]
+        # both orientations count, including "one side at-start, the
+        # other never arrives" (ep NaN): with both trees present, NaN is
+        # a genuine never-crosses verdict, not missing data
+        row["asymmetric"] = (
+            bool(ref_curves)
+            and bool(mine_curves)
+            and row["degenerate_ref"] != row["degenerate_mine"]
+        )
+        if math.isnan(row["ep_mine"]):
+            row["ep_ratio"] = float("nan")
+        elif row["ep_mine"] == 0:
+            # a legitimate crossing at index 0 (possible when rolling=1):
+            # the ratio is division-by-zero; inf when the reference
+            # needed any episodes at all, undefined when both were at 0
+            row["ep_ratio"] = (
+                float("inf") if row["ep_ref"] > 0 else float("nan")
+            )
+        else:
+            row["ep_ratio"] = row["ep_ref"] / row["ep_mine"]
         rows.append(row)
     return pd.DataFrame(
         rows,
         columns=[
             "scenario", "H", "ref_final", "threshold", "ep_ref", "ep_mine",
-            "ep_ratio", "degenerate", "ref_seeds", "mine_seeds",
+            "ep_ratio", "degenerate", "degenerate_ref", "degenerate_mine",
+            "asymmetric", "ref_seeds", "mine_seeds",
         ],
     )
 
@@ -352,16 +381,25 @@ def write_quality_md(
         f"(ours: `{mine_dir}`, reference: `{ref_dir}`).",
         "",
         "Wall-clock columns: the reference's derived ~2.5 env-steps/s "
-        "(= 8 s/episode, BASELINE.md); ours from the measured "
-        f"`ref5_ring` production-block rows in `{bench_jsonl}` "
-        + "; ".join(
-            f"{p}: {t['episodes_per_sec']:.1f} eps/s ({t['impl']}, "
-            f"{t['timestamp']})"
-            for p, t in sorted(throughput.items())
-        )
-        + ". Single-replica timings — replica batching (bench.py's "
-        "headline) multiplies aggregate throughput further without "
-        "changing any per-replica number below.",
+        "(= 8 s/episode, BASELINE.md); "
+        + (
+            "ours from the measured "
+            f"`ref5_ring` production-block rows in `{bench_jsonl}` "
+            + "; ".join(
+                f"{p}: {t['episodes_per_sec']:.1f} eps/s ({t['impl']}, "
+                f"{t['timestamp']})"
+                for p, t in sorted(throughput.items())
+            )
+            + ". Single-replica timings — replica batching (bench.py's "
+            "headline) multiplies aggregate throughput further without "
+            "changing any per-replica number below."
+            if throughput
+            else "no measured `ref5_ring` single-replica f32 "
+            f"production-block rows found in `{bench_jsonl}`, so the "
+            "'ours' wall-clock columns are omitted — run "
+            "`python -m rcmarl_tpu bench --configs ref5_ring` to "
+            "produce them."
+        ),
         "",
         "| Scenario | H | ref final | threshold | ref episodes | our "
         "episodes | episode ratio | ref wall-clock |"
@@ -370,8 +408,17 @@ def write_quality_md(
     ]
     for _, row in table.iterrows():
         degenerate = bool(row.get("degenerate", False))
+        asymmetric = bool(row.get("asymmetric", False))
         ref_seeds = int(row.get("ref_seeds", 1))
         mine_seeds = int(row.get("mine_seeds", 1))
+        if degenerate:
+            verdict = "degenerate†"
+        elif asymmetric:
+            verdict = "asymmetric‡"
+        elif np.isfinite(row.ep_ratio):
+            verdict = f"{row.ep_ratio:.2f}"
+        else:
+            verdict = "—"
         cells = [
             "",
             row.scenario,
@@ -380,9 +427,7 @@ def write_quality_md(
             _fmt_val(row.threshold),
             _fmt_ep(row.ep_ref, ref_seeds),
             _fmt_ep(row.ep_mine, mine_seeds),
-            "degenerate†"
-            if degenerate
-            else (f"{row.ep_ratio:.2f}" if np.isfinite(row.ep_ratio) else "—"),
+            verdict,
             _fmt_seconds(row.ep_ref / ref_eps_per_sec),
         ]
         for p in platforms:
@@ -393,14 +438,19 @@ def write_quality_md(
             )
         lines.append(" | ".join(cells).strip() + " |")
 
-    degen = (
-        table["degenerate"].fillna(False).astype(bool)
-        if "degenerate" in table
-        else pd.Series(False, index=table.index)
-    )
-    # a learning signal needs a reference threshold AND no degeneracy:
-    # mine-only cells (NaN threshold) have nothing to time against
-    meaningful = table[~degen & table["threshold"].notna()]
+    def _flag(col: str) -> pd.Series:
+        return (
+            table[col].fillna(False).astype(bool)
+            if col in table
+            else pd.Series(False, index=table.index)
+        )
+
+    degen, asym = _flag("degenerate"), _flag("asymmetric")
+    # a learning signal needs a reference threshold AND a two-sided
+    # crossing to compare: mine-only cells (NaN threshold) have nothing
+    # to time against, and degenerate/asymmetric cells have an at-start
+    # crossing on at least one side that makes the ratio meaningless
+    meaningful = table[~degen & ~asym & table["threshold"].notna()]
     finite = meaningful.dropna(subset=["ep_ref", "ep_mine"])
     if len(finite):
         med = float(finite.ep_ratio.median())
@@ -413,18 +463,51 @@ def write_quality_md(
             "converged quality; ~1 = matched sample efficiency — the "
             "wall-clock advantage is then pure throughput).",
         ]
+    asym_rows = table[asym]
+    if len(asym_rows):
+        findings = []
+        for _, row in asym_rows.iterrows():
+            if bool(row.get("degenerate_ref", False)):
+                at_start, other, other_ep = (
+                    "the reference", "this framework", row.ep_mine
+                )
+            else:
+                at_start, other, other_ep = (
+                    "this framework", "the reference", row.ep_ref
+                )
+            arrives = (
+                f"first reaches it at episode {int(other_ep)}"
+                if np.isfinite(other_ep)
+                else "never reaches it in the swept budget"
+            )
+            findings.append(
+                f"- **{row.scenario} H={int(row.H)}**: {at_start} is at "
+                f"threshold from its first fully-smoothed point, but "
+                f"{other} {arrives}."
+            )
+        lines += [
+            "",
+            f"**Asymmetric cells ({len(asym_rows)}):** one side starts "
+            "at threshold while the other does not — a real behavioral "
+            "difference the ratio cannot express:",
+            "",
+            *findings,
+        ]
     if len(table):
         lines += [
             "",
-            "† degenerate: the reference's own converged return is "
-            "within tolerance of STARTING performance (the undefended "
-            "H=0 attack cells — the attack erases learning progress), "
-            "so there is nothing to time; excluded from the summary "
-            "statistic. Cells marked 'not reached' never touch the "
-            "threshold on the smoothed seed-mean curve within the swept "
-            "episode budget; see PARITY.md for how far outside they "
-            "converge and DRIFT.md for the root-cause arbitration of "
-            "the private-reward cells.",
+            "† degenerate: BOTH curves' converged returns are within "
+            "tolerance of STARTING performance (the undefended H=0 "
+            "attack cells — the attack erases learning progress), so "
+            "there is nothing to time; excluded from the summary "
+            "statistic. ‡ asymmetric: exactly ONE side starts at "
+            "threshold (see the findings list above); also excluded "
+            "from the summary ratio, but reported as a finding rather "
+            "than hidden by the exclusion. Cells marked 'not reached' "
+            "never touch the threshold on the smoothed seed-mean curve "
+            "within the swept episode budget; see PARITY.md for how far "
+            "outside they converge and DRIFT.md for the root-cause "
+            "arbitration of the private-reward cells.",
         ]
     lines += [
         "",

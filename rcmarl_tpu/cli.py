@@ -350,6 +350,7 @@ def _sweep_fused(args, cell_config, cell_done, out_root) -> int:
     (:mod:`rcmarl_tpu.parallel.matrix`), so the chip batches
     n_cells x n_seeds replicas instead of running cells sequentially."""
     from rcmarl_tpu.parallel.matrix import (
+        _check_fusable,
         reset_matrix_for_phase,
         split_matrix_metrics,
         train_matrix,
@@ -371,6 +372,15 @@ def _sweep_fused(args, cell_config, cell_done, out_root) -> int:
     cfgs = [cell_config(scen, H) for scen, H in cells]
     base = cfgs[0]
     n_blocks = args.n_episodes // base.n_ep_fixed
+
+    # Pre-validate fusability (pallas impl, ragged graphs, divergent
+    # cells) as an argument error, like cmd_sweep's other validation —
+    # WITHOUT wrapping execution, so a genuine runtime ValueError from
+    # the training path stays a loud traceback, not a usage message.
+    try:
+        _check_fusable(base, cfgs)
+    except ValueError as e:
+        raise SystemExit(f"sweep --fused: {e}")
 
     phase_metrics, dt = _run_phases(
         args.phases,

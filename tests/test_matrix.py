@@ -163,6 +163,22 @@ class TestFusedSweepCLI:
                 err_msg=str(rel),
             )
 
+    def test_fused_unfusable_config_exits_cleanly(self, tmp_path):
+        """Fusability violations (e.g. --consensus_impl pallas, which the
+        traced heterogeneous matrix cannot fuse) surface as SystemExit
+        with a message, like cmd_sweep's other argument validation — not
+        as a raw ValueError traceback."""
+        from rcmarl_tpu.cli import main
+
+        with pytest.raises(SystemExit) as exc:
+            main([
+                "sweep", "--fused", "--scenarios", "coop", "--H", "0",
+                "--seeds", "100", "--n_episodes", "50", "--n_ep_fixed",
+                "50", "--n_epochs", "1", "--buffer_size", "50",
+                "--consensus_impl", "pallas", "--out", str(tmp_path),
+            ])
+        assert "sweep --fused" in str(exc.value)
+
     def test_fused_skip_existing_complete(self, tmp_path, capsys):
         from rcmarl_tpu.cli import main
 

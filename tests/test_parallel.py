@@ -14,6 +14,7 @@ from rcmarl_tpu.parallel import (
     train_parallel,
 )
 from rcmarl_tpu.training import init_train_state, train_scanned
+from tests.conftest import needs_multicore
 
 TINY = Config(
     n_episodes=2,
@@ -89,9 +90,6 @@ class TestSeedParallel:
         assert next(iter(seeds_mod._JIT_CACHE.values())) is fn_first
         assert np.all(np.asarray(states.block) == 2)
         assert np.all(np.isfinite(np.asarray(m.true_team_returns)))
-
-
-from tests.conftest import needs_multicore
 
 
 class TestAgentSharding:

@@ -192,8 +192,9 @@ class TestQualityTable:
         assert not row.degenerate
 
     def test_degenerate_cell_flagged(self, tmp_path):
-        """A cell whose reference curve starts at its own converged level
-        (the undefended H=0 attack cells) is flagged degenerate."""
+        """A cell where BOTH curves start at their converged level (the
+        undefended H=0 attack cells) is flagged degenerate — the rule is
+        two-sided, so one side alone never qualifies."""
         ref = tmp_path / "ref"
         mine = tmp_path / "mine"
         flat = np.full(400, -7.0)  # no learning progress at all
@@ -201,16 +202,80 @@ class TestQualityTable:
             [np.linspace(-9.0, -7.0, 200), np.full(200, -7.0)]
         )
         _write_run(ref / "faulty" / "H=0" / "seed=100", flat)
-        _write_run(mine / "faulty" / "H=0" / "seed=100", learn)
+        _write_run(mine / "faulty" / "H=0" / "seed=100", flat)
         table = quality_table(mine, ref, window=100, tol=0.05, rolling=50)
         row = table.iloc[0]
-        assert row.degenerate
-        # a cell with genuine reference learning is NOT flagged
+        assert row.degenerate and not row.asymmetric
+        # a cell with genuine learning on both sides is NOT flagged
         _write_run(ref / "coop" / "H=0" / "seed=100", learn)
         _write_run(mine / "coop" / "H=0" / "seed=100", learn)
         table = quality_table(mine, ref, window=100, tol=0.05, rolling=50)
         coop = table[table.scenario == "coop"].iloc[0]
-        assert not coop.degenerate and coop.ep_ref > 50
+        assert not coop.degenerate and not coop.asymmetric
+        assert coop.ep_ref > 50
+
+    def test_one_sided_at_start_is_asymmetric_not_degenerate(self, tmp_path):
+        """Reference at threshold from the start while ours climbs for
+        hundreds of episodes (the malicious_global H=0 shape): the old
+        one-sided rule hid this as 'degenerate'; it must surface as an
+        asymmetric finding. Same for the mirror orientation, and for an
+        at-start reference whose counterpart never arrives at all."""
+        ref = tmp_path / "ref"
+        mine = tmp_path / "mine"
+        flat = np.full(400, -7.0)
+        learn = np.concatenate(
+            [np.linspace(-9.0, -7.0, 200), np.full(200, -7.0)]
+        )
+        never = np.full(400, -9.0)
+        _write_run(ref / "malg" / "H=0" / "seed=100", flat)
+        _write_run(mine / "malg" / "H=0" / "seed=100", learn)
+        _write_run(ref / "mirror" / "H=0" / "seed=100", learn)
+        _write_run(mine / "mirror" / "H=0" / "seed=100", flat)
+        _write_run(ref / "greedy" / "H=0" / "seed=100", flat)
+        _write_run(mine / "greedy" / "H=0" / "seed=100", never)
+        table = quality_table(mine, ref, window=100, tol=0.05, rolling=50)
+        malg = table[table.scenario == "malg"].iloc[0]
+        assert malg.asymmetric and not malg.degenerate
+        assert malg.degenerate_ref and not malg.degenerate_mine
+        mirror = table[table.scenario == "mirror"].iloc[0]
+        assert mirror.asymmetric and not mirror.degenerate
+        assert mirror.degenerate_mine and not mirror.degenerate_ref
+        greedy = table[table.scenario == "greedy"].iloc[0]
+        assert greedy.asymmetric and np.isnan(greedy.ep_mine)
+        # and the mirror of THAT: ours at-start while the reference's
+        # smoothed curve never crosses (here: too short for one full
+        # rolling window, so the full-window mean is all-NaN) — with
+        # both trees present that NaN is a verdict, not missing data
+        _write_run(ref / "refnever" / "H=0" / "seed=100", never[:30])
+        _write_run(mine / "refnever" / "H=0" / "seed=100", flat)
+        table = quality_table(mine, ref, window=100, tol=0.05, rolling=50)
+        refnever = table[table.scenario == "refnever"].iloc[0]
+        assert np.isnan(refnever.ep_ref) and refnever.degenerate_mine
+        assert refnever.asymmetric and not refnever.degenerate
+        # a mine-only cell (no reference curves) is NOT asymmetric —
+        # that's missing data, not a behavioral finding
+        _write_run(mine / "mineonly" / "H=1" / "seed=100", learn)
+        table = quality_table(mine, ref, window=100, tol=0.05, rolling=50)
+        only = table[table.scenario == "mineonly"].iloc[0]
+        assert not only.asymmetric and not only.degenerate
+
+
+    def test_index_zero_crossing_ratio(self, tmp_path):
+        """With rolling=1 a legitimate crossing at index 0 is possible;
+        the ratio must be inf (ref needed episodes, we needed none), not
+        NaN via a falsy-zero guard."""
+        ref = tmp_path / "ref"
+        mine = tmp_path / "mine"
+        learn = np.concatenate(
+            [np.linspace(-9.0, -5.0, 200), np.full(200, -5.0)]
+        )
+        at_start = np.full(400, -5.0)
+        _write_run(ref / "coop" / "H=1" / "seed=100", learn)
+        _write_run(mine / "coop" / "H=1" / "seed=100", at_start)
+        table = quality_table(mine, ref, window=100, tol=0.05, rolling=1)
+        row = table.iloc[0]
+        assert row.ep_mine == 0
+        assert np.isposinf(row.ep_ratio)
 
 
 class TestThroughputRows:
@@ -279,6 +344,49 @@ class TestWriteQualityMd:
         assert "degenerate†" in text
         assert "Of the 2 cells with a real learning signal, 1 are reached" in text
         assert "median episode ratio 2.00" in text
+
+    def test_asymmetric_rendering_and_findings(self, tmp_path):
+        """Asymmetric cells are marked in the table, excluded from the
+        median, and spelled out in a findings paragraph."""
+        table = pd.DataFrame(
+            [
+                {"scenario": "coop", "H": 1, "ref_final": -5.0,
+                 "threshold": -5.25, "ep_ref": 750.0, "ep_mine": 375.0,
+                 "ep_ratio": 2.0, "degenerate": False,
+                 "degenerate_ref": False, "degenerate_mine": False,
+                 "asymmetric": False},
+                {"scenario": "malicious_global", "H": 0, "ref_final": -7.2,
+                 "threshold": -7.56, "ep_ref": 199.0, "ep_mine": 5777.0,
+                 "ep_ratio": 0.03, "degenerate": False,
+                 "degenerate_ref": True, "degenerate_mine": False,
+                 "asymmetric": True},
+                {"scenario": "greedy", "H": 0, "ref_final": -6.67,
+                 "threshold": -7.0, "ep_ref": 150.0,
+                 "ep_mine": float("nan"), "ep_ratio": float("nan"),
+                 "degenerate": False, "degenerate_ref": True,
+                 "degenerate_mine": False, "asymmetric": True},
+            ]
+        )
+        out = tmp_path / "QUALITY.md"
+        write_quality_md(
+            table, out, {}, window=500, tol=0.05, rolling=200,
+            mine_dir="mine", ref_dir="ref", bench_jsonl="bench.jsonl",
+        )
+        text = out.read_text()
+        assert text.count("asymmetric‡") == 2
+        assert "Asymmetric cells (2):" in text
+        assert (
+            "**malicious_global H=0**: the reference is at threshold "
+            "from its first fully-smoothed point, but this framework "
+            "first reaches it at episode 5777." in text
+        )
+        assert "never reaches it in the swept budget" in text
+        # only coop counts toward the summary ratio
+        assert "Of the 1 cells with a real learning signal" in text
+        assert "median episode ratio 2.00" in text
+        # empty throughput: explicit note, no dangling provenance join
+        assert "no measured `ref5_ring`" in text
+        assert "`bench.jsonl` ." not in text
 
     def test_quality_cli_end_to_end(self, tmp_path, capsys):
         """The subcommand wires trees + bench rows into QUALITY.md."""
