@@ -127,35 +127,59 @@ def final_returns(
     return pd.DataFrame(rows)
 
 
+def _as_roots(raw_data_dir) -> List[Path]:
+    """One tree or several: a str/PathLike is a single root, any other
+    iterable is a list of roots whose per-seed rows are pooled."""
+    if isinstance(raw_data_dir, (str, Path)):
+        return [Path(raw_data_dir)]
+    return [Path(d) for d in raw_data_dir]
+
+
 def per_seed_final_returns(raw_data_dir, window: int = 500) -> pd.DataFrame:
     """Per-(scenario, H, seed) final-``window`` mean returns — the
     disaggregated form of :func:`final_returns`, exposing the seed spread
     (VERDICT.md round-1: parity deltas need error bars to separate 3-seed
-    noise from systematic drift)."""
+    noise from systematic drift).
+
+    ``raw_data_dir`` may be a list of trees; their rows are pooled (the
+    n=6 parity basis: original seeds + the round-3 robustness seeds). A
+    (scenario, H, seed) collision across trees raises — double-counting
+    a seed would silently deflate the std every verdict depends on. A
+    tree that does not exist contributes nothing.
+    """
     rows = []
-    root = Path(raw_data_dir)
-    scen_dirs = (
-        sorted(p for p in root.iterdir() if p.is_dir()) if root.is_dir() else []
-    )
-    for scen_dir in scen_dirs:
-        for H in _h_cells(scen_dir):
-            for seed_dir, phases in _seed_runs(scen_dir / f"H={H}"):
-                run = pd.concat(phases, ignore_index=True)
-                tail = run.iloc[-window:]
-                rows.append(
-                    {
-                        "scenario": scen_dir.name,
-                        "H": H,
-                        "seed": seed_dir.name.split("=")[-1],
-                        "team_return": tail["True_team_returns"].mean(),
-                        "adv_return": tail["True_adv_returns"].mean(),
-                        "episodes": len(run),
-                    }
-                )
-    return pd.DataFrame(
+    for root in _as_roots(raw_data_dir):
+        scen_dirs = (
+            sorted(p for p in root.iterdir() if p.is_dir())
+            if root.is_dir()
+            else []
+        )
+        for scen_dir in scen_dirs:
+            for H in _h_cells(scen_dir):
+                for seed_dir, phases in _seed_runs(scen_dir / f"H={H}"):
+                    run = pd.concat(phases, ignore_index=True)
+                    tail = run.iloc[-window:]
+                    rows.append(
+                        {
+                            "scenario": scen_dir.name,
+                            "H": H,
+                            "seed": seed_dir.name.split("=")[-1],
+                            "team_return": tail["True_team_returns"].mean(),
+                            "adv_return": tail["True_adv_returns"].mean(),
+                            "episodes": len(run),
+                        }
+                    )
+    df = pd.DataFrame(
         rows,
         columns=["scenario", "H", "seed", "team_return", "adv_return", "episodes"],
     )
+    dup = df.duplicated(subset=["scenario", "H", "seed"])
+    if dup.any():
+        clash = df[dup][["scenario", "H", "seed"]].to_dict(orient="records")
+        raise ValueError(
+            f"duplicate (scenario, H, seed) across raw_data trees: {clash}"
+        )
+    return df
 
 
 def parity_table(
@@ -211,12 +235,26 @@ def parity_table(
             if np.isfinite(row["delta"]) and row["ref_mean"] != 0
             else np.nan
         )
+        # disjoint per-seed supports (every one of our seeds beyond every
+        # reference seed) refute the seed-noise explanation no matter
+        # what the std overlap heuristic says
+        row["supports_separated"] = bool(
+            len(r)
+            and len(m)
+            and (
+                m.team_return.min() > r.team_return.max()
+                or m.team_return.max() < r.team_return.min()
+            )
+        )
         if not len(r):
             row["verdict"] = "no reference"
         elif not np.isfinite(row["delta"]):
             row["verdict"] = "missing"
         elif row["rel"] <= tolerance:
             row["verdict"] = "within"
+        elif row["supports_separated"]:
+            # systematic: not attributable to seed noise
+            row["verdict"] = "outside"
         else:
             # outside tolerance on the mean — is the reference mean inside
             # our seed spread (2 std)? then it's plausibly seed noise
@@ -230,7 +268,7 @@ def parity_table(
     cols = [
         "scenario", "H", "ref_mean", "ref_std", "ref_seeds", "mine_mean",
         "mine_std", "mine_seeds", "ref_adv", "mine_adv", "delta", "rel",
-        "verdict",
+        "supports_separated", "verdict",
     ]
     return (
         pd.DataFrame(rows, columns=cols)
@@ -334,8 +372,12 @@ def write_parity_md(
         "",
         f"Parity target: seed-mean team return within ±{tolerance:.0%}",
         "(BASELINE.json). `outside (seed-noise-compatible)` = mean delta",
-        "exceeds the target but lies within 2·(ref std + our std) — i.e.",
-        "not distinguishable from seed noise at these sample sizes.",
+        "exceeds the target but lies within 2·(ref std + our std) AND the",
+        "per-seed supports overlap — i.e. not distinguishable from seed",
+        "noise at these sample sizes. Cells whose per-seed supports are",
+        "fully disjoint are labeled plain `outside` regardless of the std",
+        "heuristic: disjoint supports refute the seed-noise explanation",
+        "(the systematic cells are root-caused in DRIFT.md).",
         "",
         "| Scenario | H | reference (±std, n) | this framework (±std, n) | Δ | rel | verdict |",
         "|---|---|---|---|---|---|---|",

@@ -1053,7 +1053,18 @@ def cmd_parity(argv) -> int:
     )
     from rcmarl_tpu.analysis.plots import DEFAULT_REF_RAW_DATA
 
-    p.add_argument("--raw_data", type=str, default="./simulation_results/raw_data")
+    p.add_argument(
+        "--raw_data",
+        type=str,
+        nargs="+",
+        default=[
+            "./simulation_results/raw_data",
+            "./simulation_results/raw_data_seeds456",
+        ],
+        help="one or more sim_data trees; per-seed rows are pooled, so "
+        "the default folds the original seeds {100,200,300} and the "
+        "round-3 robustness seeds {400,500,600} into n=6 per cell",
+    )
     p.add_argument("--ref_raw_data", type=str, default=DEFAULT_REF_RAW_DATA)
     p.add_argument("--out", type=str, default="./PARITY.md")
     p.add_argument(
@@ -1074,12 +1085,21 @@ def cmd_parity(argv) -> int:
         write_parity_md,
     )
 
+    import pandas as pd
+
     # Parse each sim_data tree once; the table and the summary artifact are
-    # both derived from these frames.
-    mine_seeds = per_seed_final_returns(args.raw_data, args.window)
+    # both derived from these frames. Multiple --raw_data trees pool their
+    # per-seed rows (n = sum of seeds across trees, per cell); a tree that
+    # does not exist contributes nothing rather than failing, so the
+    # default works before the seeds456 sweep has been run.
+    mine_dir = ", ".join(args.raw_data)
+    mine_seeds = pd.concat(
+        [per_seed_final_returns(d, args.window) for d in args.raw_data],
+        ignore_index=True,
+    )
     ref_seeds = per_seed_final_returns(args.ref_raw_data, args.window)
     table = parity_table(
-        args.raw_data,
+        mine_dir,
         args.ref_raw_data,
         args.window,
         args.tolerance,
@@ -1124,7 +1144,7 @@ def cmd_parity(argv) -> int:
         args.out,
         args.window,
         args.tolerance,
-        mine_dir=args.raw_data,
+        mine_dir=mine_dir,
         ref_dir=args.ref_raw_data,
         extra_sections=(
             qualitative_claims_section(table)

@@ -6,6 +6,7 @@ shipped with the reference (``raw_data/coop/H=1/seed=100/``) to pin the
 interop layout, not a synthetic imitation of it.
 """
 
+import json
 from pathlib import Path
 
 import jax
@@ -329,6 +330,87 @@ class TestAnalysis:
             mine, ref, tmp_path / "fig.png", scenario="coop", H=0, rolling=2
         )
         assert Path(out).exists()
+
+    def test_parity_verdicts_and_support_separation(self, tmp_path):
+        """Verdict ladder: within / noise-compatible / outside — and
+        fully-disjoint per-seed supports refute the seed-noise label no
+        matter what the std-overlap heuristic says."""
+        from rcmarl_tpu.analysis.plots import parity_table
+
+        def write(root, scen, seed, level, jitter):
+            d = root / scen / "H=0" / f"seed={seed}"
+            d.mkdir(parents=True)
+            pd.DataFrame({
+                "True_team_returns": np.full(40, level + jitter),
+                "True_adv_returns": np.zeros(40),
+                "Estimated_team_returns": np.full(40, level),
+            }).to_pickle(d / "sim_data1.pkl")
+
+        mine, ref = tmp_path / "mine", tmp_path / "ref"
+        # within: identical
+        for i, seed in enumerate((1, 2, 3)):
+            write(mine, "within", seed, -5.0, 0.01 * i)
+            write(ref, "within", seed, -5.0, 0.01 * i)
+        # separated: ours clusters at -4.6, ref at -5.2, wide stds would
+        # let the 2*(std+std) heuristic call it noise — supports disjoint
+        for i, seed in enumerate((1, 2, 3)):
+            write(mine, "drift", seed, -4.6, 0.2 * i)
+            write(ref, "drift", seed, -5.2, 0.2 * i)
+        # noise-compatible: overlapping supports, means 8% apart
+        for i, seed in enumerate((1, 2, 3)):
+            write(mine, "noisy", seed, -5.0, -0.3 * i)
+            write(ref, "noisy", seed, -5.4, -0.3 * i)
+        table = parity_table(mine, ref, window=40, tolerance=0.05)
+        t = {r.scenario: r for _, r in table.iterrows()}
+        assert t["within"].verdict == "within"
+        assert not t["within"].supports_separated
+        assert t["drift"].supports_separated
+        assert t["drift"].verdict == "outside"
+        # std heuristic alone would have said noise-compatible
+        assert abs(t["drift"].delta) <= 2 * (
+            t["drift"].mine_std + t["drift"].ref_std
+        )
+        assert t["noisy"].verdict == "outside (seed-noise-compatible)"
+        assert not t["noisy"].supports_separated
+
+    def test_parity_cli_pools_multiple_trees(self, tmp_path, capsys):
+        """`parity --raw_data A B` folds per-seed rows from both trees
+        (the n=6 PARITY.md), and a missing tree contributes nothing."""
+        from rcmarl_tpu.cli import main
+
+        def write(root, seed, level):
+            d = root / "coop" / "H=0" / f"seed={seed}"
+            d.mkdir(parents=True)
+            pd.DataFrame({
+                "True_team_returns": np.full(40, level),
+                "True_adv_returns": np.zeros(40),
+                "Estimated_team_returns": np.full(40, level),
+            }).to_pickle(d / "sim_data1.pkl")
+
+        ref, t1, t2 = tmp_path / "ref", tmp_path / "t1", tmp_path / "t2"
+        for seed in (100, 200, 300):
+            write(ref, seed, -5.0)
+            write(t1, seed, -5.0)
+        for seed in (400, 500, 600):
+            write(t2, seed, -5.1)
+        out, summary = tmp_path / "P.md", tmp_path / "s.json"
+        rc = main([
+            "parity", "--raw_data", str(t1), str(t2),
+            str(tmp_path / "missing_tree"),
+            "--ref_raw_data", str(ref), "--out", str(out),
+            "--summary_out", str(summary), "--window", "40",
+        ])
+        assert rc == 0
+        text = out.read_text()
+        assert "(n=6)" in text and "(n=3)" in text
+        data = json.loads(summary.read_text())
+        assert len(data["per_seed"]["mine"]) == 6
+        assert [r["seed"] for r in data["per_seed"]["mine"]] == [
+            "100", "200", "300", "400", "500", "600"
+        ]
+        assert data["raw_data"] == [
+            str(t1), str(t2), str(tmp_path / "missing_tree")
+        ]
 
     def test_qualitative_claims_section_verdicts(self):
         """Measured verdicts, not asserted ones: holds / FAILS / missing,
