@@ -252,6 +252,7 @@ def consensus_update_one(
         H,
         impl,
         valid=valid,
+        n_agents=cfg.n_agents,
     )
     new_params: MLPParams = tuple(trunk_agg) + (own[-1],)
     # c) projection: phi with aggregated trunk, all neighbor heads at once
@@ -259,7 +260,7 @@ def consensus_update_one(
     W_nbr, b_nbr = nbr_msgs[-1]  # (n_in, h, 1), (n_in, 1)
     proj = einsum("bh,nho->nbo", phi, W_nbr, dtype=cfg.dot_dtype)
     vals = proj + b_nbr[:, None, :]  # (n_in, B, 1)
-    agg = resilient_aggregate(vals, H, impl, valid=valid)  # (B, 1)
+    agg = resilient_aggregate(vals, H, impl, valid=valid, n_agents=cfg.n_agents)  # (B, 1)
     agg = jax.lax.stop_gradient(agg)
     # d) normalized team update of the head only
     new_head = team_head_update(new_params[-1], phi, agg, cfg, mask=mask)

@@ -206,11 +206,20 @@ def quality_table(
         )
         row["degenerate"] = row["degenerate_ref"] and row["degenerate_mine"]
         # both orientations count, including "one side at-start, the
-        # other never arrives" (ep NaN): with both trees present, NaN is
-        # a genuine never-crosses verdict, not missing data
+        # other never arrives" (ep NaN) — but an ep NaN is a genuine
+        # never-crosses verdict only when the side's longest curve spans
+        # at least one full rolling window; a truncated / in-progress
+        # run also smooths to all-NaN, and incomplete data must not be
+        # reported as a behavioral finding
+        ref_spans_window = bool(ref_curves) and (
+            max(len(c) for c in ref_curves) >= rolling
+        )
+        mine_spans_window = bool(mine_curves) and (
+            max(len(c) for c in mine_curves) >= rolling
+        )
         row["asymmetric"] = (
-            bool(ref_curves)
-            and bool(mine_curves)
+            ref_spans_window
+            and mine_spans_window
             and row["degenerate_ref"] != row["degenerate_mine"]
         )
         if math.isnan(row["ep_mine"]):

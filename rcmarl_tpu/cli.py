@@ -732,7 +732,7 @@ def cmd_bench(argv) -> int:
             {
                 "config": name,
                 "impl": impl,
-                "impl_resolved": resolve_impl(impl, cfg.n_in),
+                "impl_resolved": resolve_impl(impl, cfg.n_in, n_agents=cfg.n_agents),
                 "compute_dtype": cfg.compute_dtype,
                 "n_agents": cfg.n_agents,
                 "n_in": cfg.n_in,
@@ -844,7 +844,7 @@ def cmd_profile(argv) -> int:
             {
                 "config": name,
                 "impl": impl,
-                "impl_resolved": resolve_impl(impl, cfg.n_in),
+                "impl_resolved": resolve_impl(impl, cfg.n_in, n_agents=cfg.n_agents),
                 "compute_dtype": cfg.compute_dtype,
                 "n_agents": cfg.n_agents,
                 "hidden": list(cfg.hidden),
@@ -1087,16 +1087,17 @@ def cmd_parity(argv) -> int:
 
     import pandas as pd
 
-    # Parse each sim_data tree once; the table and the summary artifact are
-    # both derived from these frames. Multiple --raw_data trees pool their
-    # per-seed rows (n = sum of seeds across trees, per cell); a tree that
-    # does not exist contributes nothing rather than failing, so the
-    # default works before the seeds456 sweep has been run.
+    # Parse the sim_data trees once; the table and the summary artifact
+    # are both derived from these frames. Multiple --raw_data trees pool
+    # their per-seed rows (n = sum of seeds across trees, per cell) in
+    # ONE per_seed_final_returns call so its cross-tree duplicate-seed
+    # guard applies — per-tree calls concatenated afterwards would let a
+    # seed present in two trees double-count silently, deflating the std
+    # every verdict depends on. A tree that does not exist contributes
+    # nothing rather than failing, so the default works before the
+    # seeds456 sweep has been run.
     mine_dir = ", ".join(args.raw_data)
-    mine_seeds = pd.concat(
-        [per_seed_final_returns(d, args.window) for d in args.raw_data],
-        ignore_index=True,
-    )
+    mine_seeds = per_seed_final_returns(args.raw_data, args.window)
     ref_seeds = per_seed_final_returns(args.ref_raw_data, args.window)
     table = parity_table(
         mine_dir,

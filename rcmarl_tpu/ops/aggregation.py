@@ -31,12 +31,18 @@ import numpy as np
 from rcmarl_tpu.config import CONSENSUS_IMPLS
 
 
-#: Measured TPU crossover (BENCH_SCALING.jsonl, v5e): XLA's fused sort
-#: wins at reference-scale neighborhoods (n_in 4-5, ~1.7x faster), the
-#: fused Pallas kernel overtakes it once the gathered block grows
-#: (n_in=16 full graph: pallas 1.09x faster, and the margin is projected
-#: to widen with n_in and parameter volume — ops/pallas_aggregation.py).
-PALLAS_CROSSOVER_N_IN = 16
+#: Measured TPU crossover (BENCH_SCALING.jsonl, v5e), keyed on the total
+#: gathered-block volume ``n_in * n_agents`` — NOT on n_in alone: at
+#: identical n_in=5 the winner flips with the agent count (n16_ring: xla
+#: 1.67x faster at volume 80; n64_ring: pallas 1.64x faster at volume
+#: 320), so the deciding variable is how much data one fused launch
+#: processes across the vmapped agent axis. Measured xla wins at volumes
+#: {20, 80}; measured pallas wins at {256, 320, 4096}; the threshold
+#: sits at the smallest measured pallas win (n16_full, 1.09x). Parameter
+#: volume per agent is held constant across these rows (the reference's
+#: 20-20 nets), so P is deliberately not in the key; refit if a
+#: measured row at a different architecture contradicts it.
+PALLAS_CROSSOVER_VOLUME = 256
 
 
 def _check_impl(impl: str) -> None:
@@ -49,24 +55,33 @@ def _check_impl(impl: str) -> None:
         )
 
 
-def resolve_impl(impl: str, n_in: int, dtype=None) -> str:
+def resolve_impl(impl: str, n_in: int, dtype=None, n_agents: int = 1) -> str:
     """Resolve ``'auto'`` to a concrete implementation at trace time.
 
     ``'auto'`` picks the Pallas kernel exactly where hardware
-    measurement says it wins — on a TPU backend with a neighborhood of
-    at least :data:`PALLAS_CROSSOVER_N_IN` — and the XLA sort everywhere
-    else: small neighborhoods, CPU/interpreter platforms where the
-    kernel cannot lower, and f64 inputs (the kernel computes in f32, a
-    silent precision loss the XLA path doesn't have — see
-    ``fused_resilient_aggregate``). Concrete impl strings pass through
-    unchanged, so explicit choices always stick.
+    measurement says it wins — on a TPU backend with a gathered-block
+    volume ``n_in * n_agents`` of at least
+    :data:`PALLAS_CROSSOVER_VOLUME` — and the XLA sort everywhere else:
+    small total volumes, CPU/interpreter platforms where the kernel
+    cannot lower, and f64 inputs (the kernel computes in f32, a silent
+    precision loss the XLA path doesn't have — see
+    ``fused_resilient_aggregate``). ``n_agents`` is the vmapped
+    agent-axis size of the surrounding consensus layer; it must be
+    passed by the caller because inside the vmap the agent axis is
+    invisible to the kernel (callers that aggregate one agent at a
+    time, like the reference-API twins, correctly use the default 1).
+    Concrete impl strings pass through unchanged, so explicit choices
+    always stick.
     """
     _check_impl(impl)
     if impl != "auto":
         return impl
     if dtype is not None and jnp.dtype(dtype) == jnp.float64:
         return "xla"
-    if jax.default_backend() == "tpu" and n_in >= PALLAS_CROSSOVER_N_IN:
+    if (
+        jax.default_backend() == "tpu"
+        and n_in * n_agents >= PALLAS_CROSSOVER_VOLUME
+    ):
         return "pallas"
     return "xla"
 
@@ -76,6 +91,7 @@ def resilient_aggregate(
     H: int,
     impl: str = "xla",
     valid: jnp.ndarray | None = None,
+    n_agents: int = 1,
 ) -> jnp.ndarray:
     """Clip-and-average over the leading neighbor axis.
 
@@ -102,6 +118,8 @@ def resilient_aggregate(
         per agent by ``Config``). May be traced (vmapped over agents).
         The masked path is XLA-only: padded graphs route past the Pallas
         kernel (irregular graphs are host-defined, small-scale usage).
+      n_agents: vmapped agent-axis size of the calling consensus layer,
+        used only to resolve ``'auto'`` (see :func:`resolve_impl`).
 
     Returns:
       (...) aggregated values.
@@ -116,7 +134,7 @@ def resilient_aggregate(
         # is xla by definition; an explicit pallas choice still errors
         _check_impl(impl)
         return _dynamic_h_aggregate(values, H, "xla" if impl == "auto" else impl)
-    impl = resolve_impl(impl, values.shape[0], values.dtype)
+    impl = resolve_impl(impl, values.shape[0], values.dtype, n_agents)
     if valid is not None:
         return _masked_aggregate(values, H, valid)
     if impl != "xla":
@@ -207,14 +225,19 @@ def _masked_aggregate(
 
 
 def resilient_aggregate_tree(
-    tree, H: int, impl: str = "xla", valid: jnp.ndarray | None = None
+    tree,
+    H: int,
+    impl: str = "xla",
+    valid: jnp.ndarray | None = None,
+    n_agents: int = 1,
 ):
     """Apply :func:`resilient_aggregate` to every leaf of a pytree whose
     leaves carry a leading neighbor axis (e.g. a gathered parameter
     pytree with leaves (n_in, ...)). With a pallas impl the whole tree is
     flattened into ONE fused kernel launch instead of one sort per leaf.
     ``valid`` masks padded neighbor slots (see :func:`resilient_aggregate`;
-    masked trees take the XLA path)."""
+    masked trees take the XLA path). ``n_agents`` is the vmapped
+    agent-axis size, used only to resolve ``'auto'``."""
     leaves = jax.tree.leaves(tree)
     if not leaves:  # e.g. the trunk tree of a head-only (hidden=()) net
         _check_impl(impl)
@@ -230,7 +253,7 @@ def resilient_aggregate_tree(
         return jax.tree.map(
             lambda v: _dynamic_h_aggregate(v, H, concrete), tree
         )
-    impl = resolve_impl(impl, leaves[0].shape[0], leaves[0].dtype)
+    impl = resolve_impl(impl, leaves[0].shape[0], leaves[0].dtype, n_agents)
     if valid is not None:
         return jax.tree.map(lambda v: _masked_aggregate(v, H, valid), tree)
     if impl != "xla":

@@ -26,7 +26,9 @@ cd "$(dirname "$0")/.."
 echo "== probe =="
 if ! timeout 240 python -c "import jax; d = jax.devices(); print(d); assert d[0].platform != 'cpu', 'CPU fallback - tunnel down'"; then
     echo "probe FAILED - tunnel down, aborting before any measurement"
-    exit 1
+    # distinct exit code: "nothing ran" (watchers keep waiting) vs "ran
+    # with failures" (exit 1 below)
+    exit 2
 fi
 
 declare -A status

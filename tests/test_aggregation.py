@@ -211,19 +211,46 @@ class TestAutoImpl:
     def test_resolution_rules(self, monkeypatch):
         from rcmarl_tpu.ops import aggregation as agg
 
-        # non-TPU backend: always the XLA sort, any neighborhood size
+        # non-TPU backend: always the XLA sort, any volume
         monkeypatch.setattr(agg.jax, "default_backend", lambda: "cpu")
         assert agg.resolve_impl("auto", 4) == "xla"
-        assert agg.resolve_impl("auto", 64) == "xla"
-        # TPU backend: pallas from the measured crossover up
+        assert agg.resolve_impl("auto", 64, n_agents=64) == "xla"
+        # TPU backend: pallas from the measured volume crossover up
         monkeypatch.setattr(agg.jax, "default_backend", lambda: "tpu")
-        assert agg.resolve_impl("auto", agg.PALLAS_CROSSOVER_N_IN - 1) == "xla"
-        assert agg.resolve_impl("auto", agg.PALLAS_CROSSOVER_N_IN) == "pallas"
+        v = agg.PALLAS_CROSSOVER_VOLUME
+        assert agg.resolve_impl("auto", v - 1) == "xla"
+        assert agg.resolve_impl("auto", v) == "pallas"
         # f64 never routes to the f32-computing kernel
-        assert agg.resolve_impl("auto", 64, np.float64) == "xla"
+        assert agg.resolve_impl("auto", 64, np.float64, n_agents=64) == "xla"
         # explicit impls pass through untouched on every backend
         assert agg.resolve_impl("xla", 64) == "xla"
         assert agg.resolve_impl("pallas", 4) == "pallas"
+
+    def test_crossover_matches_measured_rows(self, monkeypatch):
+        """Pin 'auto' to every measured TPU row in BENCH_SCALING.jsonl.
+
+        The round-4 rows REFUTED an n_in-only rule: at identical n_in=5
+        the winner flips with the agent count (n16_ring xla 1.67x faster
+        vs n64_ring pallas 1.64x faster), so 'auto' keys on the volume
+        n_in * n_agents. Each (config -> winner) below is a measured
+        2026-07-30/2026-08-02 row, not a projection.
+        """
+        from rcmarl_tpu.ops import aggregation as agg
+
+        monkeypatch.setattr(agg.jax, "default_backend", lambda: "tpu")
+        measured = [
+            ("ref5_ring", 4, 5, "xla"),  # 11580 vs 6943
+            ("n16_ring", 5, 16, "xla"),  # 8494 vs 5085
+            ("n16_full", 16, 16, "pallas"),  # 9146 vs 8387
+            ("n64_ring", 5, 64, "pallas"),  # 5039 vs 3077
+            ("n64_full", 64, 64, "pallas"),  # 1980 vs 1470
+        ]
+        for config, n_in, n_agents, winner in measured:
+            got = agg.resolve_impl("auto", n_in, n_agents=n_agents)
+            assert got == winner, (
+                f"{config}: auto resolved to {got}, measured winner is "
+                f"{winner} (n_in={n_in}, n_agents={n_agents})"
+            )
 
     def test_auto_matches_xla_on_cpu(self):
         vals = jnp.asarray(np.random.default_rng(0).normal(size=(5, 3, 7)))
@@ -332,7 +359,8 @@ class TestTracedH:
         """impl='auto' must lower with a traced H on ANY backend (auto
         picks an impl that can lower; only explicit pallas errors)."""
         rng = np.random.default_rng(19)
-        # n_in >= PALLAS_CROSSOVER_N_IN: 'auto' would pick pallas on TPU
+        # regardless of volume, a traced H forces the xla path — the
+        # Pallas kernel fixes its trim indices at lowering time
         values = jnp.asarray(rng.normal(size=(16, 6)), jnp.float32)
         out = jax.jit(
             lambda v, h: resilient_aggregate(v, h, impl="auto")

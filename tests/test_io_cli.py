@@ -373,6 +373,36 @@ class TestAnalysis:
         assert t["noisy"].verdict == "outside (seed-noise-compatible)"
         assert not t["noisy"].supports_separated
 
+    def test_support_separation_needs_three_seeds_per_side(self, tmp_path):
+        """With n=2 on either side (some reference _global cells ship
+        only 2 seeds), disjoint supports are weak evidence: the column
+        still records the disjointness, but the hard 'outside' override
+        is gated on >= 3 seeds per side and the cell falls through to
+        the std-overlap heuristic."""
+        from rcmarl_tpu.analysis.plots import parity_table
+
+        def write(root, scen, seed, level):
+            d = root / scen / "H=0" / f"seed={seed}"
+            d.mkdir(parents=True)
+            pd.DataFrame({
+                "True_team_returns": np.full(40, level),
+                "True_adv_returns": np.zeros(40),
+                "Estimated_team_returns": np.full(40, level),
+            }).to_pickle(d / "sim_data1.pkl")
+
+        mine, ref = tmp_path / "mine", tmp_path / "ref"
+        # disjoint supports, but only 2 reference seeds; the wide spread
+        # keeps the delta within 2*(mine_std + ref_std)
+        for seed, level in ((1, -4.3), (2, -4.9), (3, -4.6)):
+            write(mine, "lown", seed, level)
+        for seed, level in ((1, -5.1), (2, -5.7)):
+            write(ref, "lown", seed, level)
+        table = parity_table(mine, ref, window=40, tolerance=0.05)
+        row = table[table.scenario == "lown"].iloc[0]
+        assert row.supports_separated  # still recorded for the reader
+        assert row.ref_seeds == 2
+        assert row.verdict == "outside (seed-noise-compatible)"
+
     def test_parity_cli_pools_multiple_trees(self, tmp_path, capsys):
         """`parity --raw_data A B` folds per-seed rows from both trees
         (the n=6 PARITY.md), and a missing tree contributes nothing."""
@@ -411,6 +441,16 @@ class TestAnalysis:
         assert data["raw_data"] == [
             str(t1), str(t2), str(tmp_path / "missing_tree")
         ]
+        # a seed present in two pooled trees must raise, not silently
+        # double-count (the cross-tree guard applies to the CLI's pooled
+        # call, not only to direct per_seed_final_returns list input)
+        write(t2, 100, -5.2)
+        with pytest.raises(ValueError, match="duplicate"):
+            main([
+                "parity", "--raw_data", str(t1), str(t2),
+                "--ref_raw_data", str(ref), "--out", str(out),
+                "--summary_out", str(summary), "--window", "40",
+            ])
 
     def test_qualitative_claims_section_verdicts(self):
         """Measured verdicts, not asserted ones: holds / FAILS / missing,

@@ -242,16 +242,25 @@ class TestQualityTable:
         assert mirror.degenerate_mine and not mirror.degenerate_ref
         greedy = table[table.scenario == "greedy"].iloc[0]
         assert greedy.asymmetric and np.isnan(greedy.ep_mine)
-        # and the mirror of THAT: ours at-start while the reference's
-        # smoothed curve never crosses (here: too short for one full
-        # rolling window, so the full-window mean is all-NaN) — with
-        # both trees present that NaN is a verdict, not missing data
-        _write_run(ref / "refnever" / "H=0" / "seed=100", never[:30])
-        _write_run(mine / "refnever" / "H=0" / "seed=100", flat)
+        # the insufficient-data boundary: a curve SHORTER than one
+        # rolling window smooths to all-NaN exactly like a genuine
+        # never-crossing, but it is an in-progress run, not a behavioral
+        # finding — the cell must not be flagged asymmetric (the genuine
+        # never-arrives orientation is `greedy` above, full-length).
+        _write_run(ref / "refshort" / "H=0" / "seed=100", never[:30])
+        _write_run(mine / "refshort" / "H=0" / "seed=100", flat)
         table = quality_table(mine, ref, window=100, tol=0.05, rolling=50)
-        refnever = table[table.scenario == "refnever"].iloc[0]
-        assert np.isnan(refnever.ep_ref) and refnever.degenerate_mine
-        assert refnever.asymmetric and not refnever.degenerate
+        refshort = table[table.scenario == "refshort"].iloc[0]
+        assert np.isnan(refshort.ep_ref) and refshort.degenerate_mine
+        assert not refshort.asymmetric and not refshort.degenerate
+        # same truncation on OUR side: a 30-episode in-progress run must
+        # not be reported as 'never reaches the reference quality'
+        _write_run(ref / "mineshort" / "H=0" / "seed=100", flat)
+        _write_run(mine / "mineshort" / "H=0" / "seed=100", never[:30])
+        table = quality_table(mine, ref, window=100, tol=0.05, rolling=50)
+        mineshort = table[table.scenario == "mineshort"].iloc[0]
+        assert np.isnan(mineshort.ep_mine)
+        assert not mineshort.asymmetric and not mineshort.degenerate
         # a mine-only cell (no reference curves) is NOT asymmetric —
         # that's missing data, not a behavioral finding
         _write_run(mine / "mineonly" / "H=1" / "seed=100", learn)
