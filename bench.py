@@ -71,7 +71,12 @@ def _measure(n_seeds: int, n_blocks: int, reps: int) -> None:
     from rcmarl_tpu.parallel.seeds import init_states
     from rcmarl_tpu.training import train_scanned
 
-    # Published-run hyperparameters (job.sh: slow_lr=0.002; BASELINE.md)
+    # Published-run hyperparameters (job.sh: slow_lr=0.002; BASELINE.md).
+    # consensus_impl stays the Config default ('xla' = dual top-(H+1)
+    # selection bounds since round 6 — bitwise-equal to the old full
+    # sort, so headline numbers remain trajectory-comparable across
+    # rounds; the sort-vs-select A/B arms live in `python -m rcmarl_tpu
+    # bench/profile --impl xla xla_sort pallas pallas_sort`).
     cfg = Config(slow_lr=0.002, fast_lr=0.01, seed=100)
 
     def fetch(states, metrics):

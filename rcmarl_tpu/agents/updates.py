@@ -231,7 +231,9 @@ def consensus_update_one(
 
     Steps b-d of reference train_agents.py:125-145:
       b) hidden consensus (resilient_CAC_agents.py:142-166): clip-mean
-         each trunk array over neighbors; write trunk only.
+         each trunk array over neighbors (trim bounds by dual
+         top-(H+1) selection on the default impl — ops/aggregation.py);
+         write trunk only.
       c) projection (resilient_CAC_agents.py:168-206): evaluate each
          neighbor's head on the agent's NEW trunk features over the whole
          batch; clip-mean over neighbors -> per-sample targets.
@@ -243,7 +245,12 @@ def consensus_update_one(
     n_trunk = len(own) - 1
     # traced H (the fused-matrix path) is XLA-only; the aggregation layer
     # resolves 'auto' to an impl that can lower and RAISES on an explicit
-    # pallas choice rather than silently downgrading (ops/aggregation.py)
+    # pallas choice rather than silently downgrading (ops/aggregation.py).
+    # Both aggregation calls below carry everything the 3-way 'auto'
+    # policy keys on — H (static here, traced on the matrix path), the
+    # leading neighbor-axis size, and n_agents for the gathered volume —
+    # so sort-vs-select-vs-pallas resolution happens at trace time with
+    # no extra plumbing at this layer.
     H = cfg.H if H is None else H
     impl = cfg.consensus_impl
     # b) hidden-layer consensus over trunk arrays

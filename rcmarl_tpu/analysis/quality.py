@@ -145,6 +145,20 @@ def _crossing(curves: list, threshold: float, rolling: int) -> float:
     return episodes_to_threshold(_smoothed_mean(curves, rolling), threshold)
 
 
+def _majority_spans_window(curves: list, rolling: int) -> bool:
+    """True when MORE THAN HALF of a side's per-seed curves span at
+    least one full rolling window. Used to decide whether an all-NaN
+    smoothed crossing is a genuine never-crosses verdict: the smoothed
+    seed-mean averages every curve, so with only one full-length seed
+    among truncated ones its tail rests on partial data — a hard
+    behavioral label (``asymmetric``) needs the majority of seeds to
+    actually cover the window."""
+    if not curves:
+        return False
+    spanning = sum(len(c) >= rolling for c in curves)
+    return 2 * spanning > len(curves)
+
+
 def quality_table(
     mine_dir,
     ref_dir=DEFAULT_REF_RAW_DATA,
@@ -207,16 +221,14 @@ def quality_table(
         row["degenerate"] = row["degenerate_ref"] and row["degenerate_mine"]
         # both orientations count, including "one side at-start, the
         # other never arrives" (ep NaN) — but an ep NaN is a genuine
-        # never-crosses verdict only when the side's longest curve spans
-        # at least one full rolling window; a truncated / in-progress
-        # run also smooths to all-NaN, and incomplete data must not be
-        # reported as a behavioral finding
-        ref_spans_window = bool(ref_curves) and (
-            max(len(c) for c in ref_curves) >= rolling
-        )
-        mine_spans_window = bool(mine_curves) and (
-            max(len(c) for c in mine_curves) >= rolling
-        )
+        # never-crosses verdict only when a MAJORITY of the side's
+        # curves span at least one full rolling window: truncated /
+        # in-progress runs also smooth to all-NaN, and when most of a
+        # side's seeds are partial the smoothed seed-mean tail rests on
+        # incomplete data, which must not be reported as a behavioral
+        # finding on the strength of a single full-length seed
+        ref_spans_window = _majority_spans_window(ref_curves, rolling)
+        mine_spans_window = _majority_spans_window(mine_curves, rolling)
         row["asymmetric"] = (
             ref_spans_window
             and mine_spans_window

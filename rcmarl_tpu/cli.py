@@ -112,7 +112,9 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         type=str,
         default="xla",
         choices=list(CONSENSUS_IMPLS),
-        help="consensus aggregation backend (pallas = fused TPU kernel)",
+        help="consensus aggregation backend: xla/pallas = selection-based "
+        "trim bounds, *_sort = full-sort comparison arms, auto = measured "
+        "3-way crossover (ops/aggregation.py)",
     )
     p.add_argument(
         "--compute_dtype",
@@ -456,7 +458,9 @@ def cmd_sweep(argv) -> int:
         type=str,
         default="xla",
         choices=list(CONSENSUS_IMPLS),
-        help="consensus aggregation backend (pallas = fused TPU kernel)",
+        help="consensus aggregation backend: xla/pallas = selection-based "
+        "trim bounds, *_sort = full-sort comparison arms, auto = measured "
+        "3-way crossover (ops/aggregation.py)",
     )
     p.add_argument(
         "--skip_existing",
@@ -732,7 +736,7 @@ def cmd_bench(argv) -> int:
             {
                 "config": name,
                 "impl": impl,
-                "impl_resolved": resolve_impl(impl, cfg.n_in, n_agents=cfg.n_agents),
+                "impl_resolved": resolve_impl(impl, cfg.n_in, n_agents=cfg.n_agents, H=cfg.H),
                 "compute_dtype": cfg.compute_dtype,
                 "n_agents": cfg.n_agents,
                 "n_in": cfg.n_in,
@@ -844,7 +848,7 @@ def cmd_profile(argv) -> int:
             {
                 "config": name,
                 "impl": impl,
-                "impl_resolved": resolve_impl(impl, cfg.n_in, n_agents=cfg.n_agents),
+                "impl_resolved": resolve_impl(impl, cfg.n_in, n_agents=cfg.n_agents, H=cfg.H),
                 "compute_dtype": cfg.compute_dtype,
                 "n_agents": cfg.n_agents,
                 "hidden": list(cfg.hidden),

@@ -18,8 +18,21 @@ from dataclasses import dataclass
 from typing import Tuple
 
 
-#: Valid consensus aggregation backends (see ops/aggregation.py).
-CONSENSUS_IMPLS = ("xla", "pallas", "pallas_interpret", "auto")
+#: Valid consensus aggregation backends (see ops/aggregation.py):
+#: 'xla' / 'pallas' compute the trim bounds by dual top-(H+1) selection
+#: (running min/max registers — the measured-faster default), the
+#: '*_sort' twins keep the original full-sort strategy as the
+#: measured-comparison arm, 'pallas_interpret' runs the selection kernel
+#: in the Pallas interpreter (CPU tests), and 'auto' is the 3-way
+#: measured-crossover policy keyed on (H, n_in, volume).
+CONSENSUS_IMPLS = (
+    "xla",
+    "xla_sort",
+    "pallas",
+    "pallas_sort",
+    "pallas_interpret",
+    "auto",
+)
 
 
 class Roles:
@@ -116,12 +129,24 @@ class Config:
     coop_fit_steps: int = 5
     seed: int = 300
     # --- consensus kernel implementation ---
-    # 'xla' (default): jnp sort/clip/mean, best at reference scale.
-    # 'pallas': fused VMEM-resident kernel (ops/pallas_aggregation.py),
-    # for large-N/large-model scale-out on TPU.
-    # 'pallas_interpret': pallas in interpreter mode (CPU tests only).
-    # 'auto': measured-crossover choice — pallas on TPU from n_in >= 16
-    # up, xla otherwise (ops/aggregation.py:resolve_impl, BENCH_SCALING.md).
+    # 'xla' (default): dual top-(H+1) selection bounds + clip/mean —
+    # bitwise-equal to the sort, and the measured epoch winner at the
+    # published-scenario scales this default serves (n_in <= 16:
+    # ref5_ring 1.22x, n16_full 1.65x — PERF.md "sort vs select").
+    # Dense scale-out graphs (n_in > 16) measure FASTER under the sort
+    # (n64_full epoch 0.64x for selection): pick 'auto' (or 'xla_sort')
+    # there — the crossover exists precisely for that regime.
+    # 'xla_sort': the original full jnp.sort bounds (comparison arm;
+    # measured winner in dense n_in=64 epochs, see ops/aggregation.py).
+    # 'pallas': fused VMEM-resident selection kernel
+    # (ops/pallas_aggregation.py), for large-N/large-model scale-out on
+    # TPU. 'pallas_sort': the kernel's sorting-network arm.
+    # 'pallas_interpret': selection kernel in interpreter mode (CPU
+    # tests only).
+    # 'auto': 3-way measured-crossover choice keyed on (H, n_in,
+    # volume) — pallas on TPU from volume >= 256 up, xla vs xla_sort by
+    # the CPU-measured selection crossover elsewhere
+    # (ops/aggregation.py:resolve_impl, BENCH_SCALING.md, PERF.md).
     consensus_impl: str = "xla"
     # --- matmul compute precision ---
     # 'float32' (default): true-fp32 dots, the reference-parity path.

@@ -1,6 +1,7 @@
 from rcmarl_tpu.ops.aggregation import (  # noqa: F401
     resilient_aggregate,
     resilient_aggregate_tree,
+    resolve_impl,
 )
 from rcmarl_tpu.ops.fit import (  # noqa: F401
     fit_full_batch,

@@ -14,12 +14,28 @@ Values are *clipped into* [lower, upper], not discarded — a clipped mean
 (~trimmed mean) guaranteed to keep the agent's own value inside the
 bounds. H=0 degenerates to the plain mean.
 
-TPU shape: one fused ``sort -> clip -> mean`` over a small leading
+The aggregation only ever reads TWO order statistics out of the sort —
+``sorted[H]`` (the (H+1)-th smallest) and ``sorted[n_in-H-1]`` (the
+(H+1)-th largest) — so the default implementation here computes exactly
+those via **dual top-(H+1) selection** (``impl='xla'``): an unrolled
+insertion network of 2(H+1) running min/max registers streamed over the
+n_in rows (:func:`_running_extrema`), O(k·n) vectorized compare-exchange
+ops with no data-dependent control flow, in place of the full
+O(n·log²n) sort XLA would lower. The bounds are **bitwise identical**
+to the sort's (both produce exact input values), so the two paths are
+interchangeable; ``impl='xla_sort'`` keeps the full sort as the
+measured-comparison arm and for the large-k corner where it wins (see
+:func:`resolve_impl`). ``lax.top_k`` was measured and rejected: on CPU
+the TopK custom call plus the neighbor-axis transpose runs ~2x SLOWER
+than the sort it would replace, while the register chain runs 1.4-16x
+faster (PERF.md "sort vs select").
+
+TPU shape: one fused ``select -> clip -> mean`` over a small leading
 neighbor axis, batched over everything else (all parameters of a whole
 pytree in one call; all samples of a projection batch in another), and
-vmapped over the agent axis by the consensus layer. XLA lowers the tiny
-fixed-size sort to a vectorized sorting network; no Pallas needed at
-reference scale (SURVEY.md §7 hard part (e)).
+vmapped over the agent axis by the consensus layer. At scale-out the
+same selection trick runs inside the Pallas kernel's registers
+(:mod:`rcmarl_tpu.ops.pallas_aggregation`).
 """
 
 from __future__ import annotations
@@ -41,8 +57,37 @@ from rcmarl_tpu.config import CONSENSUS_IMPLS
 #: sits at the smallest measured pallas win (n16_full, 1.09x). Parameter
 #: volume per agent is held constant across these rows (the reference's
 #: 20-20 nets), so P is deliberately not in the key; refit if a
-#: measured row at a different architecture contradicts it.
+#: measured row at a different architecture contradicts it. The rows
+#: behind this value predate the selection impls (both arms ran full
+#: sorts); re-run ``bench --impl pallas pallas_sort xla xla_sort`` on
+#: TPU to refit it on selection-vs-selection measurements.
 PALLAS_CROSSOVER_VOLUME = 256
+
+#: Measured CPU sort-vs-select crossover (PERF.md "sort vs select",
+#: 2026-08-04 rows), fit on EPOCH-level measurements, not the isolated
+#: kernel: selection wins the full critic_tr_epoch at every measured
+#: n_in up to 16 (ref5_ring 1.22x, n16_full 1.65x; isolated-kernel rows
+#: win 2.1-16x for every legal H there), but LOSES it at n_in = 64
+#: (n64_full epoch 0.64x even at the most favorable k = H+1 = 2) even
+#: though the isolated kernel still wins 1.38x at that shape — inside
+#: the vmapped consensus layer XLA materializes the n_in unstacked row
+#: slices the register chain reads, and at 64 rows that traffic swamps
+#: the saved compare-exchanges. H therefore cannot rescue selection
+#: above the n_in threshold (k = 2 is already the selection-friendliest
+#: trim), and the crossover keys on n_in alone; the isolated-kernel
+#: k-crossover (selection wins to k = 3 at n_in = 64, loses 0.24x at
+#: k = 32) is recorded in PERF.md for refitting if the slicing cost
+#: ever changes.
+SELECT_MAX_N_IN = 16
+
+
+def _selection_favored(n_in: int, H: int) -> bool:
+    """Measured rule for where dual top-(H+1) selection beats the full
+    sort at epoch granularity (see :data:`SELECT_MAX_N_IN`; ``H`` stays
+    in the signature because the policy is keyed on (H, n_in, volume) —
+    the measured rows show H cannot flip the verdict on either side of
+    the n_in threshold, so it is currently unused)."""
+    return n_in <= SELECT_MAX_N_IN
 
 
 def _check_impl(impl: str) -> None:
@@ -55,35 +100,129 @@ def _check_impl(impl: str) -> None:
         )
 
 
-def resolve_impl(impl: str, n_in: int, dtype=None, n_agents: int = 1) -> str:
+def resolve_impl(
+    impl: str, n_in: int, dtype=None, n_agents: int = 1, H: int | None = None
+) -> str:
     """Resolve ``'auto'`` to a concrete implementation at trace time.
 
-    ``'auto'`` picks the Pallas kernel exactly where hardware
-    measurement says it wins — on a TPU backend with a gathered-block
-    volume ``n_in * n_agents`` of at least
-    :data:`PALLAS_CROSSOVER_VOLUME` — and the XLA sort everywhere else:
-    small total volumes, CPU/interpreter platforms where the kernel
-    cannot lower, and f64 inputs (the kernel computes in f32, a silent
-    precision loss the XLA path doesn't have — see
-    ``fused_resilient_aggregate``). ``n_agents`` is the vmapped
-    agent-axis size of the surrounding consensus layer; it must be
-    passed by the caller because inside the vmap the agent axis is
-    invisible to the kernel (callers that aggregate one agent at a
-    time, like the reference-API twins, correctly use the default 1).
-    Concrete impl strings pass through unchanged, so explicit choices
-    always stick.
+    ``'auto'`` is a 3-way measured-crossover policy keyed on
+    ``(H, n_in, volume)``:
+
+    1. on a TPU backend with a gathered-block volume ``n_in * n_agents``
+       of at least :data:`PALLAS_CROSSOVER_VOLUME`, the fused Pallas
+       selection kernel (``'pallas'``) — hardware measurement says the
+       kernel wins there regardless of trim strategy;
+    2. otherwise the XLA selection path (``'xla'``) wherever the
+       measured CPU epoch rows favor dual top-(H+1) selection
+       (:func:`_selection_favored`: every measured n_in up to 16);
+    3. the full XLA sort (``'xla_sort'``) beyond, where the row-slice
+       traffic of the register chain inside the vmapped consensus
+       layer swamps the saved compare-exchanges (n64_full epoch
+       measured 0.64x even at the selection-friendliest k = 2).
+
+    f64 inputs never route to the Pallas kernel (it computes in f32, a
+    silent precision loss the XLA paths don't have — see
+    ``fused_resilient_aggregate``); they take the same xla-vs-xla_sort
+    rule. ``n_agents`` is the vmapped agent-axis size of the surrounding
+    consensus layer; it must be passed by the caller because inside the
+    vmap the agent axis is invisible to the kernel (callers that
+    aggregate one agent at a time, like the reference-API twins,
+    correctly use the default 1). ``H`` feeds rule 2/3 (currently
+    without effect — the measured rows key on n_in alone; ``None`` means
+    unknown, e.g. informational callers). Concrete impl strings pass
+    through unchanged, so explicit choices always stick.
     """
     _check_impl(impl)
     if impl != "auto":
         return impl
+    select = (
+        "xla"
+        if _selection_favored(n_in, 0 if H is None else H)
+        else "xla_sort"
+    )
     if dtype is not None and jnp.dtype(dtype) == jnp.float64:
-        return "xla"
+        return select
     if (
         jax.default_backend() == "tpu"
         and n_in * n_agents >= PALLAS_CROSSOVER_VOLUME
     ):
         return "pallas"
-    return "xla"
+    return select
+
+
+def _sorting_network(rows):
+    """Odd-even transposition sort of a static list of equal-shape arrays.
+
+    n rounds of adjacent compare-exchange; fully unrolled (n is tiny and
+    static), so it lowers to pure vectorized min/max with no control
+    flow. Shared by :func:`_running_extrema`'s seed step and the Pallas
+    sort-variant kernel (:mod:`rcmarl_tpu.ops.pallas_aggregation`).
+    """
+    s = list(rows)
+    n = len(s)
+    for rnd in range(n):
+        for j in range(rnd % 2, n - 1, 2):
+            s[j], s[j + 1] = (
+                jnp.minimum(s[j], s[j + 1]),
+                jnp.maximum(s[j], s[j + 1]),
+            )
+    return s
+
+
+def _running_extrema(rows, k: int):
+    """The k smallest and k largest of ``rows`` via running registers.
+
+    ``rows`` is a static-length sequence of equal-shape arrays (the
+    unstacked neighbor axis). Maintains k ascending "smallest" registers
+    and k ascending "largest" registers; each remaining row is inserted
+    with a chain of k vectorized compare-exchanges per side — O(k·n)
+    ``minimum``/``maximum`` VPU ops total, fully unrolled (k and n are
+    tiny and static), no data-dependent control flow, and only ~2k live
+    register arrays instead of the n-array block a sort materializes.
+    Works identically inside a Pallas kernel (registers/VMEM) and in
+    plain XLA.
+
+    Returns ``(small, large)``: lists of length k, each sorted
+    ascending. ``small[j]`` is the (j+1)-th smallest of the rows —
+    ``sorted[j]`` — and ``large[j]`` is ``sorted[n-k+j]``, so
+    ``small[k-1]`` / ``large[0]`` are the k-th smallest / k-th largest.
+    All outputs are exact input values (selection, not arithmetic), so
+    they are bitwise identical to the corresponding sort entries.
+    """
+    return _running_small(rows, k), _running_large(rows, k)
+
+
+def _running_small(rows, k: int):
+    """The ``small`` half of :func:`_running_extrema` alone — callers
+    that need only one side (the masked path feeds differently-masked
+    inputs to each) skip the other chain's compare-exchanges."""
+    small = _sorting_network(rows[:k])  # seed: first k rows, sorted
+    for x in rows[k:]:
+        for j in range(k):  # ascending insert: x carries the displaced max
+            small[j], x = jnp.minimum(small[j], x), jnp.maximum(small[j], x)
+    return small
+
+
+def _running_large(rows, k: int):
+    """The ``large`` half of :func:`_running_extrema` alone."""
+    large = _sorting_network(rows[:k])
+    for y in rows[k:]:
+        for j in range(k - 1, -1, -1):  # descending: y carries the min
+            large[j], y = jnp.maximum(large[j], y), jnp.minimum(large[j], y)
+    return large
+
+
+def _trim_bounds(values: jnp.ndarray, H: int, impl: str):
+    """The raw trim bounds ``(sorted[H], sorted[n_in-H-1])`` over axis 0,
+    by the impl's strategy — bitwise identical between the two."""
+    n_in = values.shape[0]
+    if impl == "xla_sort":
+        sorted_vals = jnp.sort(values, axis=0)
+        return sorted_vals[H], sorted_vals[n_in - H - 1]
+    small, large = _running_extrema(
+        [values[i] for i in range(n_in)], H + 1
+    )
+    return small[H], large[0]
 
 
 def resilient_aggregate(
@@ -101,15 +240,20 @@ def resilient_aggregate(
         Python int traces the specialized kernel (H=0 short-circuits to
         a plain mean); a TRACED scalar (the heterogeneous-cell matrix
         path, where replicas with different H share one program) runs
-        the general sort/clip/mean with dynamic trim indices — exactly
+        the general select/clip/mean with dynamic trim indices — exactly
         equivalent, since at H=0 the clip bounds are the min/max and the
         clip is the identity. Traced H is XLA-only (the Pallas kernel
         unrolls its trim indices at lowering time) and cannot be
         range-checked at trace time — callers validate 2H <= deg-1 per
         cell (Config does this for its static H).
-      impl: 'xla' (default), 'pallas' (fused TPU kernel,
-        :mod:`rcmarl_tpu.ops.pallas_aggregation`), 'pallas_interpret',
-        or 'auto' (measured-crossover choice, :func:`resolve_impl`).
+      impl: 'xla' (default; dual top-(H+1) selection, bitwise-equal to
+        the sort), 'xla_sort' (full jnp.sort — the measured-comparison
+        arm, and the winner only in the large-k corner), 'pallas'
+        (fused TPU selection kernel,
+        :mod:`rcmarl_tpu.ops.pallas_aggregation`), 'pallas_sort' (the
+        kernel's sorting-network arm), 'pallas_interpret' (selection
+        kernel in the interpreter, CPU tests), or 'auto' (the 3-way
+        measured-crossover choice, :func:`resolve_impl`).
       valid: optional (n_in,) edge-validity mask for heterogeneous
         in-degree graphs (reference ``main.py:28`` accepts arbitrary
         adjacency lists): neighborhoods are padded to the graph's max
@@ -130,29 +274,33 @@ def resilient_aggregate(
                 "traced H is not supported together with a padded-graph "
                 "validity mask (matrix cells must share one uniform graph)"
             )
-        # 'auto' must pick an impl that CAN lower, so with a traced H it
-        # is xla by definition; an explicit pallas choice still errors
-        _check_impl(impl)
-        return _dynamic_h_aggregate(values, H, "xla" if impl == "auto" else impl)
-    impl = resolve_impl(impl, values.shape[0], values.dtype, n_agents)
+        return _dynamic_h_aggregate(
+            values, H, _resolve_dynamic(impl, values.shape[0])
+        )
     if valid is not None:
-        return _masked_aggregate(values, H, valid)
-    if impl != "xla":
+        return _masked_aggregate(
+            values, H, valid, _resolve_masked(impl, values.shape[0], H)
+        )
+    impl = resolve_impl(impl, values.shape[0], values.dtype, n_agents, H)
+    if impl not in ("xla", "xla_sort"):
         from rcmarl_tpu.ops.pallas_aggregation import fused_resilient_aggregate
 
         return fused_resilient_aggregate(
-            values, H, interpret=impl == "pallas_interpret"
+            values,
+            H,
+            variant="sort" if impl == "pallas_sort" else "select",
+            interpret=impl == "pallas_interpret",
         )
     n_in = values.shape[0]
     if not 0 <= 2 * H <= n_in - 1:
         raise ValueError(f"H={H} invalid for n_in={n_in}: need 0 <= 2H <= n_in-1")
     own = values[0]
     if H == 0:
-        # sort/clip are the identity w.r.t. the mean when H == 0
+        # select/clip are the identity w.r.t. the mean when H == 0
         return jnp.mean(values, axis=0)
-    sorted_vals = jnp.sort(values, axis=0)
-    lower = jnp.minimum(sorted_vals[H], own)
-    upper = jnp.maximum(sorted_vals[n_in - H - 1], own)
+    lo, hi = _trim_bounds(values, H, impl)
+    lower = jnp.minimum(lo, own)
+    upper = jnp.maximum(hi, own)
     return jnp.mean(jnp.clip(values, lower, upper), axis=0)
 
 
@@ -162,6 +310,36 @@ def is_static_h(H) -> bool:
     return isinstance(H, (int, np.integer))
 
 
+def _resolve_masked(impl: str, n_in: int, H: int) -> str:
+    """Impl resolution for the padded-graph (masked) path, which is
+    XLA-only by design (irregular graphs are host-defined, small-scale
+    usage; the Pallas kernel never lowers for them): the sort arms
+    ('xla_sort'/'pallas_sort') keep the sort strategy, every other
+    concrete impl means selection, and 'auto' applies the measured n_in
+    crossover — never the TPU volume rule, which would otherwise route
+    a dense masked graph into the selection branch the measured rows
+    reject."""
+    _check_impl(impl)
+    if impl == "auto":
+        return "xla" if _selection_favored(n_in, H) else "xla_sort"
+    return "xla_sort" if impl in ("xla_sort", "pallas_sort") else "xla"
+
+
+def _resolve_dynamic(impl: str, n_in: int) -> str:
+    """Impl resolution for the traced-H path: only the two XLA arms can
+    lower (the Pallas kernel fixes its trim indices at lowering time),
+    and 'auto' applies the measured n_in crossover with the STATIC
+    worst-case trim k_max = (n_in-1)//2 + 1 — H is data here, so the
+    policy must hold for every H the cells might carry. An explicit
+    pallas choice still errors rather than silently downgrading
+    (callers' tests pin this)."""
+    _check_impl(impl)
+    if impl == "auto":
+        k_max = (n_in - 1) // 2 + 1
+        return "xla" if _selection_favored(n_in, k_max - 1) else "xla_sort"
+    return impl
+
+
 def _dynamic_h_aggregate(values: jnp.ndarray, H, impl: str) -> jnp.ndarray:
     """Clip-and-average with a TRACED trim parameter H.
 
@@ -169,34 +347,58 @@ def _dynamic_h_aggregate(values: jnp.ndarray, H, impl: str) -> jnp.ndarray:
     max(sorted[n_in-1-H], own)`` — is exact for every H including 0
     (there the bounds are the global min/max, so the clip is the
     identity and the mean is plain), so no data-dependent branching is
-    needed: ``sorted[H]`` just becomes a dynamic index. This is what
-    lets training cells with different H values share one compiled
-    program (vmapped over the cell axis).
+    needed: the trim indices just become dynamic. This is what lets
+    training cells with different H values share one compiled program
+    (vmapped over the cell axis).
+
+    Selection variant (``impl='xla'``): H is traced, but its legal range
+    is static — 2H <= n_in-1 — so k_max = (n_in-1)//2 + 1 running
+    registers cover every possible trim: ``small`` holds
+    ``sorted[0:k_max]`` and ``large`` holds ``sorted[n_in-k_max:]``, and
+    the traced H dynamic-indexes into the stacked registers
+    (``lower = small[H]``, ``upper = large[k_max-1-H]``) instead of into
+    a full sorted copy.
     """
-    if impl != "xla":
+    if impl not in ("xla", "xla_sort"):
         raise ValueError(
-            f"traced H requires the xla consensus impl, got {impl!r} "
-            "(the Pallas kernel fixes its trim indices at lowering time)"
+            f"traced H requires the xla consensus family (xla/xla_sort), "
+            f"got {impl!r} (the Pallas kernel fixes its trim indices at "
+            "lowering time)"
         )
     H = jnp.asarray(H, jnp.int32)
     n_in = values.shape[0]
     own = values[0]
-    sorted_vals = jnp.sort(values, axis=0)
-    lower = jnp.minimum(jnp.take(sorted_vals, H, axis=0), own)
-    upper = jnp.maximum(jnp.take(sorted_vals, n_in - 1 - H, axis=0), own)
+    if impl == "xla_sort":
+        sorted_vals = jnp.sort(values, axis=0)
+        lower_raw = jnp.take(sorted_vals, H, axis=0)
+        upper_raw = jnp.take(sorted_vals, n_in - 1 - H, axis=0)
+    else:
+        k_max = (n_in - 1) // 2 + 1
+        small, large = _running_extrema(
+            [values[i] for i in range(n_in)], k_max
+        )
+        lower_raw = jnp.take(jnp.stack(small), H, axis=0)
+        upper_raw = jnp.take(jnp.stack(large), k_max - 1 - H, axis=0)
+    lower = jnp.minimum(lower_raw, own)
+    upper = jnp.maximum(upper_raw, own)
     return jnp.mean(jnp.clip(values, lower, upper), axis=0)
 
 
 def _masked_aggregate(
-    values: jnp.ndarray, H: int, valid: jnp.ndarray
+    values: jnp.ndarray, H: int, valid: jnp.ndarray, impl: str = "xla"
 ) -> jnp.ndarray:
     """Clip-and-average over only the valid neighbor slots.
 
     Exactly :func:`resilient_aggregate` restricted to the ``d = sum(valid)``
-    valid entries: invalid slots sort to the end as +inf, so
-    ``sorted[H]`` is the H-th smallest valid value and the upper bound is
-    ``sorted[d - H - 1]`` (a dynamic index — d is data under vmap, H is
-    static); the mean runs over the d valid entries only.
+    valid entries. Selection variant (the default): masking invalid
+    slots to +inf makes the (H+1)-th smallest *valid* entry fall out of
+    the small registers directly, and masking to -inf does the same for
+    the (H+1)-th largest on the large side — both static index
+    ``[H]``/``[0]`` picks, replacing the sort variant's
+    dynamic-index-into-full-sort for the upper bound (``sorted[d-H-1]``
+    with d traced under vmap). Config's per-agent ``2H <= d-1`` check
+    guarantees H+1 valid entries exist on each side. The mean runs over
+    the d valid entries only.
     """
     n_in = values.shape[0]
     # Same static sanity check as the unmasked path (vs the padded size;
@@ -212,14 +414,22 @@ def _masked_aggregate(
         # (even non-finite) and must not poison the sum
         return jnp.sum(jnp.where(v > 0, values, 0.0), axis=0) / count
     own = values[0]
-    masked = jnp.where(v > 0, values, jnp.inf)
-    sorted_vals = jnp.sort(masked, axis=0)
-    lower = jnp.minimum(sorted_vals[H], own)
-    upper_idx = count.astype(jnp.int32) - H - 1
-    upper_row = jax.lax.dynamic_index_in_dim(
-        sorted_vals, upper_idx, axis=0, keepdims=False
-    )
-    upper = jnp.maximum(upper_row, own)
+    if impl == "xla_sort":
+        masked = jnp.where(v > 0, values, jnp.inf)
+        sorted_vals = jnp.sort(masked, axis=0)
+        lower = jnp.minimum(sorted_vals[H], own)
+        upper_idx = count.astype(jnp.int32) - H - 1
+        upper_row = jax.lax.dynamic_index_in_dim(
+            sorted_vals, upper_idx, axis=0, keepdims=False
+        )
+        upper = jnp.maximum(upper_row, own)
+    else:
+        sink_lo = jnp.where(v > 0, values, jnp.inf)  # invalid sinks high
+        sink_hi = jnp.where(v > 0, values, -jnp.inf)  # invalid sinks low
+        small = _running_small([sink_lo[i] for i in range(n_in)], H + 1)
+        large = _running_large([sink_hi[i] for i in range(n_in)], H + 1)
+        lower = jnp.minimum(small[H], own)
+        upper = jnp.maximum(large[0], own)
     clipped = jnp.where(v > 0, jnp.clip(values, lower, upper), 0.0)
     return jnp.sum(clipped, axis=0) / count
 
@@ -234,10 +444,11 @@ def resilient_aggregate_tree(
     """Apply :func:`resilient_aggregate` to every leaf of a pytree whose
     leaves carry a leading neighbor axis (e.g. a gathered parameter
     pytree with leaves (n_in, ...)). With a pallas impl the whole tree is
-    flattened into ONE fused kernel launch instead of one sort per leaf.
-    ``valid`` masks padded neighbor slots (see :func:`resilient_aggregate`;
-    masked trees take the XLA path). ``n_agents`` is the vmapped
-    agent-axis size, used only to resolve ``'auto'``."""
+    flattened into ONE fused kernel launch instead of one selection per
+    leaf. ``valid`` masks padded neighbor slots (see
+    :func:`resilient_aggregate`; masked trees take the XLA path).
+    ``n_agents`` is the vmapped agent-axis size, used only to resolve
+    ``'auto'``."""
     leaves = jax.tree.leaves(tree)
     if not leaves:  # e.g. the trunk tree of a head-only (hidden=()) net
         _check_impl(impl)
@@ -248,20 +459,27 @@ def resilient_aggregate_tree(
                 "traced H is not supported together with a padded-graph "
                 "validity mask (matrix cells must share one uniform graph)"
             )
-        _check_impl(impl)
-        concrete = "xla" if impl == "auto" else impl
+        concrete = _resolve_dynamic(impl, leaves[0].shape[0])
         return jax.tree.map(
             lambda v: _dynamic_h_aggregate(v, H, concrete), tree
         )
-    impl = resolve_impl(impl, leaves[0].shape[0], leaves[0].dtype, n_agents)
     if valid is not None:
-        return jax.tree.map(lambda v: _masked_aggregate(v, H, valid), tree)
-    if impl != "xla":
+        concrete = _resolve_masked(impl, leaves[0].shape[0], H)
+        return jax.tree.map(
+            lambda v: _masked_aggregate(v, H, valid, concrete), tree
+        )
+    impl = resolve_impl(
+        impl, leaves[0].shape[0], leaves[0].dtype, n_agents, H
+    )
+    if impl not in ("xla", "xla_sort"):
         from rcmarl_tpu.ops.pallas_aggregation import (
             fused_resilient_aggregate_tree,
         )
 
         return fused_resilient_aggregate_tree(
-            tree, H, interpret=impl == "pallas_interpret"
+            tree,
+            H,
+            variant="sort" if impl == "pallas_sort" else "select",
+            interpret=impl == "pallas_interpret",
         )
-    return jax.tree.map(lambda v: resilient_aggregate(v, H), tree)
+    return jax.tree.map(lambda v: resilient_aggregate(v, H, impl), tree)

@@ -2,27 +2,40 @@
 
 Same semantics as :func:`rcmarl_tpu.ops.aggregation.resilient_aggregate`
 (the reference's ``_resilient_aggregation``, ``resilient_CAC_agents.py:
-42-58``): sort over the leading neighbor axis, clip every value into
-``[min(sorted[H], own), max(sorted[n_in-H-1], own)]`` with own value at
-index 0, then mean over neighbors.
+42-58``): find the trim bounds ``[min(sorted[H], own),
+max(sorted[n_in-H-1], own)]`` over the leading neighbor axis with own
+value at index 0, clip every value into them, then mean over neighbors.
 
 Why a kernel at all: at reference scale (5 agents, 20-unit MLPs) XLA's
-``sort -> clip -> mean`` is already fine (SURVEY.md §7 hard part (e)).
+``select -> clip -> mean`` is already fine (SURVEY.md §7 hard part (e)).
 At scale-out (N=64 agents, 256x256 trunks — BASELINE.json config 5) the
-consensus pass is HBM-bandwidth-bound: XLA materializes the full sorted
-copy of the gathered (n_in, P) parameter block in HBM between the sort
-and the clip/mean. This kernel streams each (n_in, rows, 128) tile
-through VMEM once, runs an odd-even transposition sorting network over
-the tiny static neighbor axis entirely in registers/VMEM (n_in
-compare-exchange rounds of (rows, 128) ``minimum``/``maximum`` VPU ops
-— no data-dependent control flow), and writes only the aggregated tile
+consensus pass is HBM-bandwidth-bound: XLA materializes intermediate
+copies of the gathered (n_in, P) parameter block in HBM between the
+bound computation and the clip/mean. This kernel streams each (n_in,
+rows, 128) tile through VMEM once and writes only the aggregated tile
 back — one HBM read + one HBM write total.
+
+Two trim-bound variants share the clip/mean epilogue:
+
+- ``variant='select'`` (default): dual top-(H+1) selection with
+  2(H+1) running min/max registers streamed over the n_in rows
+  (:func:`rcmarl_tpu.ops.aggregation._running_extrema` — the same
+  helper the XLA path uses, pure vectorized ``minimum``/``maximum`` VPU
+  ops). Only ~2(H+1) live (rows, 128) register arrays instead of the
+  n_in-array sorted block, which shrinks VMEM pressure and lets the
+  default tile grow to ``block_rows=64``.
+- ``variant='sort'``: the original odd-even transposition sorting
+  network (n_in compare-exchange rounds, the full sorted block live) —
+  kept as the measured-comparison arm for refitting crossovers.
+
+Both variants produce bitwise-identical bounds (selection picks exact
+input values, just fewer of them).
 
 The public entry points mirror the XLA versions and are exact drop-ins:
 
 - :func:`fused_resilient_aggregate` — one (n_in, ...) array.
 - :func:`fused_resilient_aggregate_tree` — a whole pytree with (n_in,
-  ...) leaves, flattened into ONE kernel launch (vs one XLA sort per
+  ...) leaves, flattened into ONE kernel launch (vs one selection per
   leaf), then split back.
 
 Both fall back to nothing special on CPU: pass ``interpret=True`` (the
@@ -37,50 +50,71 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from rcmarl_tpu.ops.aggregation import _running_extrema, _sorting_network
+
 _LANES = 128
 
-
-def _sorting_network(rows):
-    """Odd-even transposition sort of a static list of equal-shape arrays.
-
-    n rounds of adjacent compare-exchange; fully unrolled (n is tiny and
-    static), so it lowers to pure vectorized min/max with no control flow.
-    """
-    s = list(rows)
-    n = len(s)
-    for rnd in range(n):
-        for j in range(rnd % 2, n - 1, 2):
-            lo = jnp.minimum(s[j], s[j + 1])
-            hi = jnp.maximum(s[j], s[j + 1])
-            s[j], s[j + 1] = lo, hi
-    return s
+#: Default sublane rows per grid step, per variant: the selection kernel
+#: keeps only ~2(H+1) live register arrays so it affords a 2x larger
+#: tile than the sorting network (which holds all n_in rows twice —
+#: input block + sorted copy).
+_DEFAULT_BLOCK_ROWS = {"select": 64, "sort": 32}
 
 
-def _agg_kernel(vals_ref, out_ref, *, n_in: int, H: int):
-    """One (n_in, rows, LANES) tile: sort over axis 0, clip, mean."""
+def _clip_mean(rows, lower, upper):
+    """Shared epilogue: clip every row into [lower, upper], mean."""
+    acc = jnp.clip(rows[0], lower, upper)
+    for r in rows[1:]:
+        acc = acc + jnp.clip(r, lower, upper)
+    return acc * (1.0 / len(rows))
+
+
+def _sort_bounds(rows, H: int):
+    """Raw trim bounds from the full odd-even sorting network: all n_in
+    rows stay live twice (input + sorted copy)."""
+    s = _sorting_network(rows)
+    return s[H], s[len(rows) - 1 - H]
+
+
+def _select_bounds(rows, H: int):
+    """Raw trim bounds from dual top-(H+1) register selection: the
+    2(H+1) running min/max registers replace the materialized sorted
+    block — O((H+1)·n_in) compare-exchanges instead of the network's
+    O(n_in²), and the only live arrays besides the input tile are the
+    registers and the accumulator."""
+    small, large = _running_extrema(rows, H + 1)
+    return small[H], large[0]
+
+
+_BOUNDS = {"select": _select_bounds, "sort": _sort_bounds}
+
+
+def _agg_kernel(vals_ref, out_ref, *, n_in: int, H: int, bounds):
+    """One (n_in, rows, LANES) tile: trim bounds via ``bounds`` (the
+    variant's strategy), clip, mean."""
     rows = [vals_ref[i] for i in range(n_in)]  # each (rows, LANES)
     own = rows[0]
     if H > 0:
-        s = _sorting_network(rows)
-        lower = jnp.minimum(s[H], own)
-        upper = jnp.maximum(s[n_in - 1 - H], own)
-        clipped = [jnp.clip(r, lower, upper) for r in rows]
+        lo, hi = bounds(rows, H)
+        lower = jnp.minimum(lo, own)
+        upper = jnp.maximum(hi, own)
+        out_ref[...] = _clip_mean(rows, lower, upper)
     else:  # H=0: clip bounds span the whole range -> plain mean
-        clipped = rows
-    acc = clipped[0]
-    for r in clipped[1:]:
-        acc = acc + r
-    out_ref[...] = acc * (1.0 / n_in)
+        acc = rows[0]
+        for r in rows[1:]:
+            acc = acc + r
+        out_ref[...] = acc * (1.0 / n_in)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("H", "block_rows", "interpret")
+    jax.jit, static_argnames=("H", "variant", "block_rows", "interpret")
 )
 def fused_resilient_aggregate(
     values: jnp.ndarray,
     H: int,
     *,
-    block_rows: int = 32,
+    variant: str = "select",
+    block_rows: int | None = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Pallas twin of :func:`~rcmarl_tpu.ops.aggregation.resilient_aggregate`.
@@ -88,16 +122,28 @@ def fused_resilient_aggregate(
     Args:
       values: (n_in, ...) stacked neighbor values, own value at index 0.
       H: trim parameter (static); 0 <= 2H <= n_in-1.
+      variant: 'select' (default; dual top-(H+1) running registers) or
+        'sort' (the original sorting network) — bitwise-identical
+        outputs, kept side by side for measured comparisons.
       block_rows: sublane rows per grid step (VMEM tile is
-        n_in x block_rows x 128 floats).
+        n_in x block_rows x 128 floats); default per variant
+        (:data:`_DEFAULT_BLOCK_ROWS`).
       interpret: run in the Pallas interpreter (for CPU tests).
 
     Returns:
-      (...) aggregated values in ``values.dtype``. Sort/clip/mean are
-      computed in f32 (the VPU-native width) regardless of input dtype
-      and cast back: exact for f32, an upcast for bf16, and a silent
-      precision LOSS for f64 inputs under x64 — use the XLA path there.
+      (...) aggregated values in ``values.dtype``. Selection/clip/mean
+      are computed in f32 (the VPU-native width) regardless of input
+      dtype and cast back: exact for f32, an upcast for bf16, and a
+      silent precision LOSS for f64 inputs under x64 — use the XLA path
+      there.
     """
+    if variant not in _BOUNDS:
+        raise ValueError(
+            f"unknown kernel variant {variant!r}; expected one of "
+            f"{tuple(_BOUNDS)}"
+        )
+    if block_rows is None:
+        block_rows = _DEFAULT_BLOCK_ROWS[variant]
     n_in = values.shape[0]
     if not 0 <= 2 * H <= n_in - 1:
         raise ValueError(f"H={H} invalid for n_in={n_in}: need 0 <= 2H <= n_in-1")
@@ -112,7 +158,7 @@ def fused_resilient_aggregate(
     v3 = flat.reshape(n_in, rows_total, _LANES)
     grid = (rows_total // block_rows,)
     out = pl.pallas_call(
-        functools.partial(_agg_kernel, n_in=n_in, H=H),
+        functools.partial(_agg_kernel, n_in=n_in, H=H, bounds=_BOUNDS[variant]),
         out_shape=jax.ShapeDtypeStruct((rows_total, _LANES), jnp.float32),
         in_specs=[
             pl.BlockSpec((n_in, block_rows, _LANES), lambda i: (0, i, 0))
@@ -125,7 +171,12 @@ def fused_resilient_aggregate(
 
 
 def fused_resilient_aggregate_tree(
-    tree, H: int, *, block_rows: int = 32, interpret: bool = False
+    tree,
+    H: int,
+    *,
+    variant: str = "select",
+    block_rows: int | None = None,
+    interpret: bool = False,
 ):
     """Aggregate every (n_in, ...) leaf of ``tree`` in ONE kernel launch.
 
@@ -133,7 +184,7 @@ def fused_resilient_aggregate_tree(
     single (n_in, P) block, runs :func:`fused_resilient_aggregate` once,
     and splits back — the whole hidden-layer consensus of an agent's
     trunk (reference ``resilient_CAC_agents.py:142-166``) becomes a
-    single HBM pass instead of one sort per weight array.
+    single HBM pass instead of one selection per weight array.
     """
     leaves, treedef = jax.tree.flatten(tree)
     n_in = leaves[0].shape[0]
@@ -148,7 +199,7 @@ def fused_resilient_aggregate_tree(
         [l.reshape(n_in, -1) for l in leaves], axis=1
     )
     agg = fused_resilient_aggregate(
-        flat, H, block_rows=block_rows, interpret=interpret
+        flat, H, variant=variant, block_rows=block_rows, interpret=interpret
     )
     out, off = [], 0
     for leaf, size in zip(leaves, sizes):

@@ -78,7 +78,7 @@ def _check_fusable(base: Config, cells: Sequence[Config]) -> None:
                 "the fused matrix requires a uniform-degree graph "
                 "(traced H excludes the padded-neighborhood path)"
             )
-    if base.consensus_impl not in ("xla", "auto"):
+    if base.consensus_impl not in ("xla", "xla_sort", "auto"):
         raise ValueError(
             "the fused matrix runs consensus on the XLA path (traced H); "
             f"consensus_impl={base.consensus_impl!r} cannot apply"

@@ -90,7 +90,9 @@ def gather_neighbor_messages(cfg: Config, tree):
       collective-permute of only the halo rows — measured at N=64 deg 4
       over 8 shards: 6 halo rows moved per leaf vs 64 with the general
       path (PARALLELISM.md). Safe because aggregation is
-      permutation-invariant past index 0 (it sorts).
+      permutation-invariant past index 0 (its trim bounds are order
+      statistics of the gathered block — dual top-(H+1) selection or a
+      full sort, ops/aggregation.py).
     - arbitrary graphs: advanced indexing ``l[in_arr]`` (rows padded to
       max degree for ragged graphs), which XLA lowers to an all-gather
       of the full stacked params when sharded.

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Tier-1 CI gate: install the package WITH the `test` extra (pytest +
+# hypothesis) and run the exact ROADMAP.md tier-1 verify command on CPU.
+#
+# Why the extra matters: the property-test modules import hypothesis.
+# They guard it with pytest.importorskip so a bare environment skips
+# them instead of dying at collection — but CI must run them, not skip
+# them, so this script installs `.[test]` first and then FAILS if any
+# module still errors at collection (pytest propagates collection
+# errors into a nonzero exit code even under
+# --continue-on-collection-errors).
+#
+#   bash scripts/ci_tier1.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m pip install -e '.[test]'
+
+# The exact tier-1 verify command from ROADMAP.md. errexit is lifted
+# around the pipeline so a failing run still reaches the DOTS_PASSED
+# diagnostic and the collection-error guard below (the captured rc is
+# re-raised at the end).
+set -o pipefail
+rm -f /tmp/_t1.log
+set +e
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+set -e
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+
+# Belt and braces: a collection error must fail CI loudly even if a
+# future pytest version stops reflecting it in the exit code.
+if grep -aq "ERROR collecting\|errors during collection" /tmp/_t1.log; then
+    echo "collection errors detected" >&2
+    exit 1
+fi
+exit "$rc"

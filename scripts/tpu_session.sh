@@ -9,7 +9,10 @@
 # runs. After that every step runs INDEPENDENTLY — one failing or
 # timed-out measurement must not cost the rest of the session — and a
 # status summary prints at the end. In order of value:
-#   1. the N=64 / N=256 scaling rows x {xla, pallas} (BENCH_SCALING.jsonl)
+#   1. the N=64 / N=256 scaling rows x {xla, xla_sort, pallas,
+#      pallas_sort} (BENCH_SCALING.jsonl; the sort arms are the
+#      comparison rows for refitting PALLAS_CROSSOVER_VOLUME and the
+#      sort-vs-select crossover on-chip)
 #   2. per-phase TPU profile rows (PERF.jsonl; completes PERF.md's table)
 #   3. a bfloat16 row for the 256-wide config (the MXU-native compute
 #      mode; its float32 comparator is step 1's n64_large_h2/xla row)
@@ -45,14 +48,15 @@ run_step() {
     fi
 }
 
-run_step "1. scaling rows (n64/n256 x xla/pallas)" \
+run_step "1. scaling rows (n64/n256 x sort/select x xla/pallas)" \
     timeout 5400 python -m rcmarl_tpu bench \
     --configs n64_ring n64_full n64_large_h2 n256_ring \
-    --impl xla pallas --out BENCH_SCALING.jsonl
+    --impl xla xla_sort pallas pallas_sort --out BENCH_SCALING.jsonl
 
-run_step "2. per-phase profile rows" \
+run_step "2. per-phase profile rows (sort-vs-select arms)" \
     timeout 3600 python -m rcmarl_tpu profile \
-    --configs ref5_ring n64_large_h2 --impl xla pallas --out PERF.jsonl
+    --configs ref5_ring n64_large_h2 --impl xla xla_sort pallas pallas_sort \
+    --out PERF.jsonl
 
 run_step "3. bfloat16 row (256-wide config)" \
     timeout 1800 python -m rcmarl_tpu bench \

@@ -9,12 +9,16 @@
 #   nohup bash scripts/tpu_watch.sh > /tmp/tpu_watch.log 2>&1 &
 #
 # A marker file guards against double-running the session; remove it to
-# re-arm the watcher after editing the session script.
+# re-arm the watcher after editing the session script. The marker lives
+# in the repo root (.tpu_session_done, gitignored), NOT in a fixed
+# global /tmp path: two checkouts/branches (or a stale marker from a
+# prior machine session) must not silently disarm each other's watcher.
+# Override with TPU_SESSION_MARKER if needed.
 set -u
 cd "$(dirname "$0")/.."
 
 INTERVAL="${TPU_WATCH_INTERVAL:-600}"
-MARKER="/tmp/tpu_session_done"
+MARKER="${TPU_SESSION_MARKER:-$(pwd)/.tpu_session_done}"
 
 while true; do
     if [ -e "$MARKER" ]; then
@@ -30,7 +34,11 @@ while true; do
         echo "$(date -Is) session finished rc=$rc"
         if [ "$rc" -eq 2 ]; then
             # the session's own probe failed before any measurement
-            # (window closed between our probe and its) — stay armed
+            # (window closed between our probe and its) — stay armed,
+            # but back off first: a flapping tunnel that passes our
+            # probe and fails the session's must not re-probe
+            # back-to-back in a tight loop
+            sleep "$INTERVAL"
             continue
         fi
         # rc 0 (all steps) or 1 (ran with some failures): measurements

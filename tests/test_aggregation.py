@@ -211,20 +211,30 @@ class TestAutoImpl:
     def test_resolution_rules(self, monkeypatch):
         from rcmarl_tpu.ops import aggregation as agg
 
-        # non-TPU backend: always the XLA sort, any volume
+        # non-TPU backend: the XLA family, selection vs sort by the
+        # measured n_in crossover (tests/test_selection.py pins the
+        # full 3-way policy)
         monkeypatch.setattr(agg.jax, "default_backend", lambda: "cpu")
         assert agg.resolve_impl("auto", 4) == "xla"
-        assert agg.resolve_impl("auto", 64, n_agents=64) == "xla"
+        assert agg.resolve_impl("auto", 64, n_agents=64) == "xla_sort"
         # TPU backend: pallas from the measured volume crossover up
+        # (n_in * n_agents is the key, so hold n_in at a selection-
+        # friendly size and scale the agent axis)
         monkeypatch.setattr(agg.jax, "default_backend", lambda: "tpu")
         v = agg.PALLAS_CROSSOVER_VOLUME
-        assert agg.resolve_impl("auto", v - 1) == "xla"
-        assert agg.resolve_impl("auto", v) == "pallas"
+        assert agg.resolve_impl("auto", 16, n_agents=v // 16 - 1) == "xla"
+        assert agg.resolve_impl("auto", 16, n_agents=v // 16) == "pallas"
         # f64 never routes to the f32-computing kernel
-        assert agg.resolve_impl("auto", 64, np.float64, n_agents=64) == "xla"
+        assert (
+            agg.resolve_impl("auto", 64, np.float64, n_agents=64)
+            == "xla_sort"
+        )
+        assert agg.resolve_impl("auto", 16, np.float64, n_agents=64) == "xla"
         # explicit impls pass through untouched on every backend
         assert agg.resolve_impl("xla", 64) == "xla"
+        assert agg.resolve_impl("xla_sort", 4) == "xla_sort"
         assert agg.resolve_impl("pallas", 4) == "pallas"
+        assert agg.resolve_impl("pallas_sort", 4) == "pallas_sort"
 
     def test_crossover_matches_measured_rows(self, monkeypatch):
         """Pin 'auto' to every measured TPU row in BENCH_SCALING.jsonl.

@@ -13,10 +13,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from hypothesis import given, settings
-from hypothesis import strategies as st
 
-from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+from rcmarl_tpu.config import Roles, circulant_in_nodes
 from rcmarl_tpu.training import (
     init_agent_params,
     init_train_state,
@@ -66,7 +64,17 @@ class TestSpecEquivalence:
     def test_update_block(self, name):
         """update_block(cfg) == update_block(cfg, spec=spec_from_config(cfg))
         — same RNG stream structure, compute-all-then-mask selects the
-        same values the static path computes."""
+        same values the static path computes.
+
+        The H>0 cells are pinned bitwise. The H=0 cells compare at
+        float32-rounding tolerance: their static program short-circuits
+        consensus to a plain mean while the traced-H program runs the
+        general clip/mean with dynamic trim indices — the aggregation
+        outputs themselves are bitwise-equal (tests/test_selection.py),
+        but the structurally different consensus graphs fuse the
+        SURROUNDING epoch ops (projection einsum, fits) differently,
+        the same ~1e-8 fusion-order effect documented on
+        TestSpecEquivalenceProperty and test_train_block."""
         cfg = CELLS[name]
         params = init_agent_params(jax.random.PRNGKey(3), cfg)
         batch, fresh = _fresh(cfg, 0.1), _fresh(cfg, 0.2)
@@ -75,7 +83,10 @@ class TestSpecEquivalence:
         traced = update_block(
             cfg, params, batch, fresh, key, spec_from_config(cfg)
         )
-        _assert_trees_equal(static, traced, rtol=0, atol=0)
+        if cfg.H > 0:
+            _assert_trees_equal(static, traced, rtol=0, atol=0)
+        else:
+            _assert_trees_equal(static, traced, rtol=1e-5, atol=1e-7)
 
     @pytest.mark.parametrize("name", ["coop_h1_common", "malicious_h1"])
     def test_train_block(self, name):
@@ -326,42 +337,8 @@ class TestFusableChecks:
             train_matrix(base, [base], [0], n_blocks=1)
 
 
-class TestSpecEquivalenceProperty:
-    """Random scenario knobs, not just the five hand-picked cells: ANY
-    role composition x H x reward mode must match the static path
-    (cfg-specialized, compiled per composition) to float32 rounding.
-
-    Tolerance note: the hand-picked cells in TestSpecEquivalence are
-    bitwise-equal, but that is not guaranteed in general — e.g. the
-    traced ``jnp.where(common_reward, r_team, r_agents)`` select and the
-    static broadcast compile to differently-fused programs, which can
-    differ by ~1e-8 under common_reward with adversaries present
-    (hypothesis found roles=[C,C,C,G,G], H=0, common=True). Semantics
-    are identical; only XLA fusion order differs."""
-
-    @pytest.mark.slow
-    @settings(max_examples=6, deadline=None)
-    @given(
-        roles=st.lists(
-            st.sampled_from(
-                [Roles.COOPERATIVE, Roles.GREEDY, Roles.FAULTY,
-                 Roles.MALICIOUS]
-            ),
-            min_size=5,
-            max_size=5,
-        ),
-        H=st.integers(min_value=0, max_value=1),
-        common=st.booleans(),
-        seed=st.integers(min_value=0, max_value=2**16),
-    )
-    def test_random_cell_matches_static(self, roles, H, common, seed):
-        cfg = _cell_cfg(roles=tuple(roles), H=H, common_reward=common)
-        base = _cell_cfg()  # all-cooperative, H=0, private reward
-        params = init_agent_params(jax.random.PRNGKey(seed), cfg)
-        batch, fresh = _fresh(cfg, 0.1), _fresh(cfg, 0.3)
-        key = jax.random.PRNGKey(seed + 1)
-        static = update_block(cfg, params, batch, fresh, key)
-        traced = update_block(
-            base, params, batch, fresh, key, spec_from_config(cfg)
-        )
-        _assert_trees_equal(static, traced, rtol=1e-5, atol=1e-7)
+# The randomized spec-equivalence property test lives in
+# tests/test_matrix_properties.py: it needs hypothesis (the `test`
+# extra), and keeping the optional import out of THIS module means a
+# missing hypothesis skips only the property test instead of taking the
+# whole fused-matrix suite down as a collection error.

@@ -16,6 +16,13 @@ pinned as properties over randomized inputs:
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
+# Optional dependency (the `test` extra — `pip install -e .[test]`):
+# without the guard a missing hypothesis is a COLLECTION ERROR that
+# fails the whole suite, not a skip.
+pytest.importorskip("hypothesis")
+
 from hypothesis import given, settings
 from hypothesis import strategies as st
 from hypothesis.extra.numpy import arrays

@@ -268,6 +268,35 @@ class TestQualityTable:
         only = table[table.scenario == "mineonly"].iloc[0]
         assert not only.asymmetric and not only.degenerate
 
+    def test_minority_spanning_seeds_do_not_take_hard_label(self, tmp_path):
+        """One full-length seed among truncated ones must NOT be enough
+        for the hard asymmetric label: the smoothed seed-mean averages
+        every curve, so its tail rests on partial data when most seeds
+        are in-progress. A MAJORITY of the side's curves must span the
+        rolling window (ADVICE round-5 finding, quality.py)."""
+        ref = tmp_path / "ref"
+        mine = tmp_path / "mine"
+        rolling = 50
+        flat = np.full(400, -7.0)  # at threshold from the start
+        never = np.full(400, -9.0)  # full-length, never crosses
+        _write_run(ref / "part" / "H=0" / "seed=100", flat)
+        # mine: ONE spanning seed, two truncated in-progress seeds
+        _write_run(mine / "part" / "H=0" / "seed=100", never)
+        _write_run(mine / "part" / "H=0" / "seed=200", never[:30])
+        _write_run(mine / "part" / "H=0" / "seed=300", never[:30])
+        table = quality_table(mine, ref, window=100, tol=0.05, rolling=rolling)
+        row = table[table.scenario == "part"].iloc[0]
+        assert np.isnan(row.ep_mine)
+        assert not row.asymmetric and not row.degenerate
+        # with a majority spanning (2 of 3), the finding DOES surface
+        _write_run(mine / "maj" / "H=0" / "seed=100", never)
+        _write_run(mine / "maj" / "H=0" / "seed=200", never)
+        _write_run(mine / "maj" / "H=0" / "seed=300", never[:30])
+        _write_run(ref / "maj" / "H=0" / "seed=100", flat)
+        table = quality_table(mine, ref, window=100, tol=0.05, rolling=rolling)
+        maj = table[table.scenario == "maj"].iloc[0]
+        assert maj.asymmetric and not maj.degenerate
+
 
     def test_index_zero_crossing_ratio(self, tmp_path):
         """With rolling=1 a legitimate crossing at index 0 is possible;

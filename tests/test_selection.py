@@ -1,0 +1,250 @@
+"""Selection-based trim bounds vs the full sort: BITWISE equivalence.
+
+The selection impls (``xla``, and the registers inside the Pallas
+kernel) compute the same two order statistics the sort-based paths read
+— ``sorted[H]`` and ``sorted[n_in-H-1]`` — by dual top-(H+1) running
+min/max registers (``ops/aggregation.py:_running_extrema``). Both
+strategies pick exact input values, so the contract pinned here is
+bitwise equality (``==``, not allclose) of the full aggregation output
+across every (H, n_in, masked, traced-H) combination the training paths
+exercise. tests/test_selection_properties.py covers the same contract
+over randomized hypothesis inputs; this module is the deterministic,
+dependency-free matrix that always runs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rcmarl_tpu.ops.aggregation import (
+    PALLAS_CROSSOVER_VOLUME,
+    _running_extrema,
+    resilient_aggregate,
+    resilient_aggregate_tree,
+    resolve_impl,
+)
+
+N_INS = [3, 5, 9, 64]
+HS = [0, 1, 2]
+
+
+def _vals(n_in, m=23, seed=0, ties=True):
+    rng = np.random.default_rng(seed + 100 * n_in)
+    v = jnp.asarray(rng.normal(size=(n_in, m)).astype(np.float32))
+    if ties and n_in > 1:
+        # duplicated entries stress tie-handling: selection and sort
+        # must still pick identical representatives
+        v = v.at[1].set(v[0])
+    return v
+
+
+class TestRunningExtrema:
+    """The register helper itself: small == sorted[:k], large ==
+    sorted[-k:], bitwise, for every k up to the legal maximum."""
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 5, 9, 16])
+    def test_matches_sorted_prefix_suffix(self, n):
+        vals = _vals(n, m=17)
+        ref = np.sort(np.asarray(vals), axis=0)
+        for k in range(1, n + 1):
+            small, large = _running_extrema([vals[i] for i in range(n)], k)
+            np.testing.assert_array_equal(
+                np.stack([np.asarray(s) for s in small]), ref[:k]
+            )
+            np.testing.assert_array_equal(
+                np.stack([np.asarray(l) for l in large]), ref[n - k:]
+            )
+
+
+@pytest.mark.parametrize("n_in", N_INS)
+@pytest.mark.parametrize("H", HS)
+class TestSelectMatchesSortBitwise:
+    def test_static_h(self, n_in, H):
+        if 2 * H > n_in - 1:
+            pytest.skip("H invalid for this n_in")
+        vals = _vals(n_in)
+        a = resilient_aggregate(vals, H, impl="xla_sort")
+        b = resilient_aggregate(vals, H, impl="xla")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_masked(self, n_in, H):
+        if n_in < 4:
+            pytest.skip("needs padding room")
+        d = n_in - 2  # true degree; 2 padded slots
+        if 2 * H > d - 1:
+            pytest.skip("H invalid for the valid count")
+        vals = _vals(n_in, seed=1)
+        # non-finite garbage in the padded slots must not matter
+        vals = vals.at[d:].set(jnp.nan)
+        valid = jnp.asarray([1.0] * d + [0.0] * (n_in - d))
+        a = resilient_aggregate(vals, H, impl="xla_sort", valid=valid)
+        b = resilient_aggregate(vals, H, impl="xla", valid=valid)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # and both equal the unpadded prefix aggregation
+        want = resilient_aggregate(_vals(n_in, seed=1)[:d], H, impl="xla_sort")
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(want), rtol=1e-6, atol=1e-6
+        )
+
+    def test_traced_h(self, n_in, H):
+        if 2 * H > n_in - 1:
+            pytest.skip("H invalid for this n_in")
+        if n_in > 16:
+            # the traced-H selection variant carries k_max = (n_in-1)//2+1
+            # registers; at n_in=64 that is a 4096-op unroll whose compile
+            # time has no place in tier-1 (and 'auto' routes it to the
+            # sort variant anyway — pinned in test_traced_h_auto below)
+            pytest.skip("large-n traced selection excluded from tier-1")
+        vals = _vals(n_in, seed=2)
+        want = resilient_aggregate(vals, H, impl="xla_sort")
+        sel = jax.jit(
+            lambda v, h: resilient_aggregate(v, h, impl="xla")
+        )(vals, jnp.int32(H))
+        srt = jax.jit(
+            lambda v, h: resilient_aggregate(v, h, impl="xla_sort")
+        )(vals, jnp.int32(H))
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(sel))
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(srt))
+
+
+def test_traced_h_auto_large_n_routes_to_sort():
+    """'auto' with a traced H keys on the STATIC worst-case register
+    count: at n_in=64 the sort variant lowers (no 4096-op unroll), and
+    the result still matches the static path bitwise."""
+    vals = _vals(64, seed=3)
+    out = jax.jit(
+        lambda v, h: resilient_aggregate(v, h, impl="auto")
+    )(vals, jnp.int32(2))
+    want = resilient_aggregate(vals, 2, impl="xla_sort")
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+
+def test_tree_select_matches_sort_bitwise():
+    rng = np.random.default_rng(9)
+    tree = {
+        "W": jnp.asarray(rng.normal(size=(5, 3, 4)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(5, 4)).astype(np.float32)),
+    }
+    a = resilient_aggregate_tree(tree, 2, impl="xla_sort")
+    b = resilient_aggregate_tree(tree, 2, impl="xla")
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
+
+
+def test_select_under_vmap_matches_sort():
+    """The consensus layer's shape: vmapped over agents."""
+    rng = np.random.default_rng(11)
+    vals = jnp.asarray(rng.normal(size=(6, 5, 13)).astype(np.float32))
+    a = jax.vmap(lambda v: resilient_aggregate(v, 2, impl="xla_sort"))(vals)
+    b = jax.vmap(lambda v: resilient_aggregate(v, 2, impl="xla"))(vals)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestThreeWayAutoPolicy:
+    """resolve_impl's 3-way (H, n_in, volume) crossover policy."""
+
+    def test_cpu_selection_vs_sort_by_measured_rule(self, monkeypatch):
+        from rcmarl_tpu.ops import aggregation as agg
+
+        monkeypatch.setattr(agg.jax, "default_backend", lambda: "cpu")
+        # measured epoch rows: selection wins every measured n_in up to
+        # 16 (ref5_ring 1.22x, n16_full 1.65x), for every legal H
+        assert agg.resolve_impl("auto", 4, H=1) == "xla"
+        assert agg.resolve_impl("auto", 16, H=7) == "xla"
+        # measured: n64_full epoch LOSES even at the friendliest k=2
+        # (0.64x) — the row-slice traffic swamps the saved ops — so H
+        # cannot flip the verdict above the n_in threshold
+        assert agg.resolve_impl("auto", 64, H=1) == "xla_sort"
+        assert agg.resolve_impl("auto", 64, H=31) == "xla_sort"
+        assert agg.resolve_impl("auto", 64) == "xla_sort"
+
+    def test_tpu_volume_beats_xla_family(self, monkeypatch):
+        from rcmarl_tpu.ops import aggregation as agg
+
+        monkeypatch.setattr(agg.jax, "default_backend", lambda: "tpu")
+        v = PALLAS_CROSSOVER_VOLUME
+        assert agg.resolve_impl("auto", v, H=1) == "pallas"
+        # below the volume crossover the CPU rule applies on TPU too
+        assert agg.resolve_impl("auto", 5, H=1) == "xla"
+        # f64 never routes to the f32-computing kernel, any volume
+        assert (
+            agg.resolve_impl("auto", 16, np.float64, n_agents=64, H=1)
+            == "xla"
+        )
+        assert (
+            agg.resolve_impl("auto", 64, np.float64, n_agents=64, H=5)
+            == "xla_sort"
+        )
+
+    def test_explicit_impls_stick(self):
+        for impl in ("xla", "xla_sort", "pallas", "pallas_sort"):
+            assert resolve_impl(impl, 64, H=5) == impl
+
+    def test_masked_path_resolution_is_xla_only(self, monkeypatch):
+        """Padded graphs never lower the Pallas kernel: 'auto' on the
+        masked path applies the n_in crossover (never the TPU volume
+        rule), pallas-family impls map to their XLA strategy twin, and
+        every combination still aggregates correctly."""
+        from rcmarl_tpu.ops import aggregation as agg
+
+        assert agg._resolve_masked("auto", 5, 1) == "xla"
+        assert agg._resolve_masked("auto", 64, 1) == "xla_sort"
+        assert agg._resolve_masked("pallas", 5, 1) == "xla"
+        assert agg._resolve_masked("pallas_interpret", 5, 1) == "xla"
+        assert agg._resolve_masked("pallas_sort", 5, 1) == "xla_sort"
+        assert agg._resolve_masked("xla_sort", 5, 1) == "xla_sort"
+        # behavioral: a volume that resolves to pallas unmasked must
+        # still aggregate (XLA-only) on the masked path, identically
+        monkeypatch.setattr(agg.jax, "default_backend", lambda: "tpu")
+        vals = _vals(5, seed=7)
+        valid = jnp.asarray([1.0, 1.0, 1.0, 1.0, 0.0])
+        want = resilient_aggregate(vals, 1, impl="xla_sort", valid=valid)
+        for impl in ("auto", "pallas", "pallas_sort"):
+            got = resilient_aggregate(
+                vals, 1, impl=impl, valid=valid, n_agents=1000
+            )
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_unknown_impl_rejected(self):
+        with pytest.raises(ValueError, match="unknown consensus impl"):
+            resolve_impl("topk", 4, H=1)
+
+
+def test_end_to_end_block_select_vs_sort():
+    """One full update block: consensus_impl='xla' (selection) must
+    reproduce consensus_impl='xla_sort' exactly — the bounds are
+    bitwise-equal, so the whole training trajectory is."""
+    from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+    from rcmarl_tpu.training.trainer import init_train_state, train_block
+
+    kw = dict(
+        n_agents=4,
+        agent_roles=(Roles.COOPERATIVE,) * 3 + (Roles.GREEDY,),
+        in_nodes=circulant_in_nodes(4, 4),
+        H=1,
+        nrow=3,
+        ncol=3,
+        max_ep_len=4,
+        n_ep_fixed=2,
+        n_epochs=2,
+        buffer_size=16,
+        hidden=(8, 8),
+        coop_fit_steps=2,
+        adv_fit_epochs=1,
+        adv_fit_batch=4,
+        batch_size=4,
+        n_episodes=2,
+    )
+    cfg_sel = Config(**kw, consensus_impl="xla")
+    cfg_srt = Config(**kw, consensus_impl="xla_sort")
+    s0 = init_train_state(cfg_sel, jax.random.PRNGKey(0))
+    s_sel, m_sel = train_block(cfg_sel, s0)
+    s_srt, m_srt = train_block(cfg_srt, s0)
+    for a, b in zip(jax.tree.leaves(s_sel.params), jax.tree.leaves(s_srt.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(m_sel.true_team_returns),
+        np.asarray(m_srt.true_team_returns),
+        atol=1e-6,
+    )

@@ -1,0 +1,69 @@
+"""Property-based sort-vs-selection equivalence (hypothesis).
+
+Randomized twin of tests/test_selection.py's deterministic matrix: over
+arbitrary f32 inputs (including duplicates and adversarial magnitudes),
+the selection-based trim bounds must reproduce the sort-based
+aggregation BITWISE for every legal (H, n_in), masked and unmasked,
+static and traced H. Guarded like the other property modules: a missing
+hypothesis (the `test` extra) is a skip, never a collection error.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+from hypothesis.extra.numpy import arrays
+
+from rcmarl_tpu.ops.aggregation import resilient_aggregate
+
+finite = st.floats(-1e6, 1e6, allow_nan=False, width=32)
+
+
+@st.composite
+def vals_and_h(draw, min_n=3, max_n=9, m=5):
+    n = draw(st.integers(min_n, max_n))
+    H = draw(st.integers(0, (n - 1) // 2))
+    vals = draw(arrays(np.float32, (n, m), elements=finite))
+    return vals, H
+
+
+@settings(max_examples=40, deadline=None)
+@given(vals_and_h())
+def test_select_matches_sort_bitwise(case):
+    vals, H = case
+    a = resilient_aggregate(jnp.asarray(vals), H, impl="xla_sort")
+    b = resilient_aggregate(jnp.asarray(vals), H, impl="xla")
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@settings(max_examples=25, deadline=None)
+@given(vals_and_h())
+def test_traced_h_select_matches_sort_bitwise(case):
+    vals, H = case
+    v = jnp.asarray(vals)
+    want = resilient_aggregate(v, H, impl="xla_sort")
+    sel = jax.jit(lambda x, h: resilient_aggregate(x, h, impl="xla"))(
+        v, jnp.int32(H)
+    )
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(sel))
+
+
+@settings(max_examples=25, deadline=None)
+@given(vals_and_h(min_n=3, max_n=7), st.integers(1, 3))
+def test_masked_select_matches_sort_bitwise(case, pad):
+    vals, H = case
+    d = vals.shape[0]
+    padded = np.concatenate(
+        [vals, np.full((pad, vals.shape[1]), np.inf, np.float32)], axis=0
+    )
+    valid = jnp.asarray([1.0] * d + [0.0] * pad)
+    a = resilient_aggregate(
+        jnp.asarray(padded), H, impl="xla_sort", valid=valid
+    )
+    b = resilient_aggregate(jnp.asarray(padded), H, impl="xla", valid=valid)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
