@@ -1,13 +1,21 @@
 """The point of the framework, in miniature: H-trimmed consensus defeats
-a Byzantine agent.
+a Byzantine agent — and the hardened transport survives NaN bombs.
 
-Trains the published "malicious" scenario (4 cooperative + 1 malicious
-agent that transmits a critic/team-reward trained toward MINUS the team
-reward — reference ``adversarial_CAC_agents.py:74-182``) twice: once
-with no defense (H=0) and once with the paper's trimming defense (H=1),
-plus an all-cooperative control. All three casts run as ONE vmapped,
-jitted program via the replica machinery (each cast is a different
-Config, so they share compiled structure but not a batch — we just loop).
+Part 1 trains the published "malicious" scenario (4 cooperative + 1
+malicious agent that transmits a critic/team-reward trained toward MINUS
+the team reward — reference ``adversarial_CAC_agents.py:74-182``) twice:
+once with no defense (H=0) and once with the paper's trimming defense
+(H=1), plus an all-cooperative control. All three casts run as ONE
+vmapped, jitted program via the replica machinery (each cast is a
+different Config, so they share compiled structure but not a batch — we
+just loop).
+
+Part 2 swaps the behavioral adversary for a TRANSPORT one
+(rcmarl_tpu.faults): a cooperative cast whose consensus links drop
+payloads and deliver NaN bombs. Unsanitized, a single bomb destroys the
+run's parameters; with ``consensus_sanitize`` the poisoned entries
+become trim-exclusions and training degrades gracefully (the trainer's
+guard rails catch whatever slips through).
 
 Sized for CPU (~2 minutes: ``JAX_PLATFORMS=cpu python
 examples/resilience_demo.py``); the separation grows with episode count
@@ -58,3 +66,39 @@ print(
 )
 if defended > attacked:
     print("=> trimming recovered most of the attack damage (the paper's claim)")
+
+# ---- Part 2: transport faults (dropped links + NaN payload bombs) ----
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from rcmarl_tpu.faults import FaultPlan  # noqa: E402
+
+print("\ntransport faults: 10% dropped links + 5% NaN payload bombs")
+plan = FaultPlan(drop_p=0.1, nan_p=0.05)
+for sanitize in (False, True):
+    cfg = Config(
+        agent_roles=(Roles.COOPERATIVE,) * 5,
+        in_nodes=circulant_in_nodes(5, 4),
+        H=1,
+        slow_lr=0.002,
+        n_episodes=EPISODES,
+        seed=100,
+        fault_plan=plan,
+        consensus_sanitize=sanitize,
+    )
+    # guard=False shows the raw kernel behavior; the default (guarded)
+    # trainer would keep even the unsanitized run's params finite by
+    # rolling back poisoned blocks.
+    state, sim_data = train(cfg, verbose=False, guard=sanitize)
+    finite = all(
+        bool(np.all(np.isfinite(np.asarray(l))))
+        for l in jax.tree.leaves(state.params)
+    )
+    ret = sim_data["True_team_returns"][-EPISODES // 4 :].mean()
+    label = "sanitized " if sanitize else "unsanitized"
+    print(
+        f"{label} params finite: {finite!s:5s}  "
+        f"final return {ret:+.2f}"
+        + (f"  vs clean control {coop:+.2f}" if sanitize else "")
+    )
+print("=> sanitize turns a run-destroying NaN bomb into graceful degradation")

@@ -24,6 +24,13 @@ from rcmarl_tpu.config import (  # noqa: F401
     circulant_in_nodes,
     full_in_nodes,
 )
+from rcmarl_tpu.faults import (  # noqa: F401
+    FaultDiag,
+    FaultPlan,
+    apply_link_faults,
+    fault_diagnostics,
+    tree_all_finite,
+)
 
 # Heavier layers (jax-compiled trainers, the reference compat twins) are
 # imported lazily so `import rcmarl_tpu` stays cheap; the canonical entry

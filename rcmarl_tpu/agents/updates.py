@@ -253,6 +253,10 @@ def consensus_update_one(
     # no extra plumbing at this layer.
     H = cfg.H if H is None else H
     impl = cfg.consensus_impl
+    # cfg.consensus_sanitize hardens BOTH aggregation calls against
+    # non-finite neighbor payloads (transport faults, diverged peers):
+    # bombs become exclusions, degree deficits keep the own value.
+    sanitize = cfg.consensus_sanitize
     # b) hidden-layer consensus over trunk arrays
     trunk_agg = resilient_aggregate_tree(
         tuple(nbr_msgs[i] for i in range(n_trunk)),
@@ -260,6 +264,7 @@ def consensus_update_one(
         impl,
         valid=valid,
         n_agents=cfg.n_agents,
+        sanitize=sanitize,
     )
     new_params: MLPParams = tuple(trunk_agg) + (own[-1],)
     # c) projection: phi with aggregated trunk, all neighbor heads at once
@@ -267,7 +272,9 @@ def consensus_update_one(
     W_nbr, b_nbr = nbr_msgs[-1]  # (n_in, h, 1), (n_in, 1)
     proj = einsum("bh,nho->nbo", phi, W_nbr, dtype=cfg.dot_dtype)
     vals = proj + b_nbr[:, None, :]  # (n_in, B, 1)
-    agg = resilient_aggregate(vals, H, impl, valid=valid, n_agents=cfg.n_agents)  # (B, 1)
+    agg = resilient_aggregate(
+        vals, H, impl, valid=valid, n_agents=cfg.n_agents, sanitize=sanitize
+    )  # (B, 1)
     agg = jax.lax.stop_gradient(agg)
     # d) normalized team update of the head only
     new_head = team_head_update(new_params[-1], phi, agg, cfg, mask=mask)

@@ -532,6 +532,32 @@ def write_quality_md(
         ]
     lines += [
         "",
+        "## Reading degradation-under-injection curves",
+        "",
+        "Sweeps run with a transport-fault plan (`sweep --fault_nan_p "
+        "... --sanitize`, rcmarl_tpu.faults) produce the SAME sim_data "
+        "layout, so this pipeline applies unchanged — but the rows "
+        "measure graceful degradation, not clean-run parity. Read them "
+        "against the clean baseline above, not against the reference. "
+        "Cells whose metrics go non-finite (a fault plan without "
+        "`--sanitize`) are never written as results: the sweep records "
+        "and skips them and exits nonzero, so every row below is a "
+        "genuinely completed run. Then: "
+        "(1) the delta in converged return between a faulted cell and "
+        "its clean twin is the cost of the injected fault rate; "
+        "(2) a faulted cell that still CROSSES the clean threshold "
+        "shows the sanitize/guard stack contains the fault class at "
+        "that rate; (3) a curve that flattens far below threshold "
+        "while the trainer's guard counters (`train` prints retries / "
+        "skipped blocks / non-finite payloads / degree-deficit "
+        "fallbacks) stay near zero means the faults are absorbed as "
+        "silent trim-exclusions — raise `--fault_*` rates or drop "
+        "`--sanitize` to locate the cliff; (4) skipped blocks > 0 "
+        "means degradation came from ROLLBACK (lost update blocks), "
+        "not from consensus noise, so episodes-to-threshold inflates "
+        "roughly by the skip fraction. Degenerate/asymmetric labels "
+        "keep their clean-run meaning.",
+        "",
         "## Related artifacts",
         "",
         "- `PARITY.md` — converged-return parity matrix (same trees, "

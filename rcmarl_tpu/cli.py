@@ -124,6 +124,57 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         help="matmul compute precision: float32 = reference-parity, "
         "bfloat16 = MXU-native inputs with f32 accumulation (scale-out)",
     )
+    _add_fault_flags(p)
+
+
+def _add_fault_flags(p: argparse.ArgumentParser) -> None:
+    """Transport-fault injection + graceful-degradation knobs
+    (rcmarl_tpu.faults; all probabilities are per directed link per
+    consensus epoch, the self link is never faulted)."""
+    g = p.add_argument_group("transport faults")
+    g.add_argument("--fault_drop_p", type=float, default=0.0,
+                   help="P(link delivers nothing -> NaN payload)")
+    g.add_argument("--fault_stale_p", type=float, default=0.0,
+                   help="P(link replays the sender's stale pre-fit weights)")
+    g.add_argument("--fault_corrupt_p", type=float, default=0.0,
+                   help="P(additive Gaussian corruption of the payload)")
+    g.add_argument("--fault_corrupt_scale", type=float, default=1.0,
+                   help="stddev of the additive corruption noise")
+    g.add_argument("--fault_flip_p", type=float, default=0.0,
+                   help="P(sign-flip corruption of the payload)")
+    g.add_argument("--fault_nan_p", type=float, default=0.0,
+                   help="P(all-NaN payload bomb)")
+    g.add_argument("--fault_inf_p", type=float, default=0.0,
+                   help="P(±Inf payload bomb, random sign)")
+    g.add_argument("--fault_seed", type=int, default=0,
+                   help="fault-stream namespace (independent of the "
+                   "training seed)")
+    g.add_argument(
+        "--sanitize",
+        action="store_true",
+        help="non-finite-hardened consensus: NaN/±Inf neighbor entries "
+        "become per-element exclusions; elements with fewer than 2H+1 "
+        "finite survivors keep the agent's own value "
+        "(ops/aggregation.py sanitize mode)",
+    )
+
+
+def fault_plan_from_args(args):
+    """The CLI fault flags as a FaultPlan, or None when all-zero (the
+    clean transport — bit-for-bit the unfaulted seed behavior)."""
+    from rcmarl_tpu.faults import FaultPlan
+
+    plan = FaultPlan(
+        drop_p=args.fault_drop_p,
+        stale_p=args.fault_stale_p,
+        corrupt_p=args.fault_corrupt_p,
+        corrupt_scale=args.fault_corrupt_scale,
+        flip_p=args.fault_flip_p,
+        nan_p=args.fault_nan_p,
+        inf_p=args.fault_inf_p,
+        seed=args.fault_seed,
+    )
+    return plan if plan.active else None
 
 
 def config_from_args(args) -> Config:
@@ -176,6 +227,8 @@ def config_from_args(args) -> Config:
         seed=getattr(args, "random_seed", 300),
         consensus_impl=args.consensus_impl,
         compute_dtype=args.compute_dtype,
+        fault_plan=fault_plan_from_args(args),
+        consensus_sanitize=args.sanitize,
     )
 
 
@@ -215,6 +268,21 @@ def cmd_train(argv) -> int:
     )
     p.add_argument("--quiet", action="store_true")
     p.add_argument(
+        "--guard",
+        type=str,
+        default="auto",
+        choices=["auto", "on", "off"],
+        help="per-block non-finite guard rails (rollback to the last "
+        "good state, bounded retry, then skip); auto = on exactly when "
+        "a fault plan is active",
+    )
+    p.add_argument(
+        "--max_retries",
+        type=int,
+        default=1,
+        help="guard retry budget per block before the block is skipped",
+    )
+    p.add_argument(
         "--profile",
         action="store_true",
         help="print per-phase timing breakdown before training "
@@ -233,7 +301,7 @@ def cmd_train(argv) -> int:
     from rcmarl_tpu.training.trainer import init_train_state, train
     from rcmarl_tpu.utils.checkpoint import (
         import_reference_weights,
-        load_checkpoint,
+        load_checkpoint_with_fallback,
         save_checkpoint,
         save_reference_artifacts,
     )
@@ -248,8 +316,15 @@ def cmd_train(argv) -> int:
         if not src.exists():
             raise SystemExit(f"--pretrained_agents: {src} does not exist")
         if src.is_file():  # our checkpoint
-            state, ckpt_cfg = load_checkpoint(src, cfg)
-            print(f"resumed checkpoint {src} at block {int(state.block)}")
+            # Checksum-verified; a corrupted/truncated file falls back to
+            # the rotated <src>.prev instead of crashing the resume.
+            state, ckpt_cfg, loaded = load_checkpoint_with_fallback(src, cfg)
+            if loaded != src:
+                print(
+                    f"WARNING: {src} is corrupted; resumed the previous "
+                    f"good checkpoint {loaded}"
+                )
+            print(f"resumed checkpoint {loaded} at block {int(state.block)}")
             # Shapes were validated by load_checkpoint; non-structural
             # hyperparameters (H, lrs, gamma, schedule...) come from the
             # CLI and may silently differ from the stored run — surface it.
@@ -293,9 +368,21 @@ def cmd_train(argv) -> int:
 
             stack.enter_context(profiler_trace(args.trace_dir))
         state, sim_data = train(
-            cfg, state=state, verbose=not args.quiet, block_callback=checkpoint_cb
+            cfg,
+            state=state,
+            verbose=not args.quiet,
+            block_callback=checkpoint_cb,
+            guard={"auto": None, "on": True, "off": False}[args.guard],
+            max_retries=args.max_retries,
         )
     dt = time.perf_counter() - t0
+    if "guard" in sim_data.attrs:
+        g = sim_data.attrs["guard"]
+        print(
+            f"guard: {g['retries']} retries, {g['skipped']} skipped "
+            f"blocks, {g['nonfinite']} non-finite payload entries, "
+            f"{g['deficit']} degree-deficit fallbacks"
+        )
 
     phase = args.phase
     if phase is None:  # next free number: phase 1 fresh, 2 after resume, ...
@@ -321,13 +408,56 @@ def cmd_train(argv) -> int:
 # --------------------------------------------------------------------------
 
 
+class _CellUnhealthy(RuntimeError):
+    """A sweep cell produced non-finite params or metrics — it diverged
+    (or an injected fault plan poisoned it). Deterministic in the
+    cell's seeds, so the isolation loop records it WITHOUT the retry it
+    grants crashes; nothing is written for the cell."""
+
+
+def _replica_param_health(states) -> np.ndarray:
+    """(n_replicas,) bool: per-replica all-finite check over the batched
+    final params (leading axis = replica). The sharded in-jit trainers
+    have no host loop to roll back in, so divergence is detected here,
+    after the fact."""
+    import jax
+
+    ok = None
+    for l in jax.tree.leaves(states.params):
+        a = np.asarray(l)
+        if not np.issubdtype(a.dtype, np.floating):
+            continue
+        fin = np.isfinite(a).reshape(a.shape[0], -1).all(axis=1)
+        ok = fin if ok is None else (ok & fin)
+    return np.ones(1, bool) if ok is None else ok
+
+
+def _check_cell_finite(states, phase_metrics, label: str) -> None:
+    """The sweep-side guard rail: non-finite final params or metric rows
+    fail the cell loudly BEFORE any artifact is written, instead of
+    exiting rc=0 over silently corrupt sim_data. (Metrics alone are not
+    enough: a poisoning in the run's LAST update block never reaches a
+    rollout row.) Injected-fault sweeps want --sanitize."""
+    bad = not _replica_param_health(states).all()
+    bad = bad or any(
+        not all(np.all(np.isfinite(np.asarray(l))) for l in metrics)
+        for metrics in phase_metrics
+    )
+    if bad:
+        raise _CellUnhealthy(
+            f"{label}: non-finite params/metrics (diverged or "
+            "fault-poisoned; for fault-injection sweeps run with "
+            "--sanitize)"
+        )
+
+
 def _run_phases(phases: int, train_fresh, train_resume, reset):
     """The published multi-phase restart protocol, shared by the
     sequential and fused sweeps: phase 1 trains fresh; each later phase
     applies the restart boundary (weights + goal kept; Adam moments,
     buffer, RNG reset) and resumes. The host fetch per phase is the
-    completion barrier (dispatch is async). Returns (host-side metrics
-    per phase, wall seconds)."""
+    completion barrier (dispatch is async). Returns (final batched
+    states, host-side metrics per phase, wall seconds)."""
     t0 = time.perf_counter()
     states, out = None, []
     for _ in range(phases):
@@ -336,7 +466,7 @@ def _run_phases(phases: int, train_fresh, train_resume, reset):
         else:
             states, metrics = train_resume(reset(states))
         out.append(type(metrics)(*(np.asarray(l) for l in metrics)))
-    return out, time.perf_counter() - t0
+    return states, out, time.perf_counter() - t0
 
 
 def _write_sim_data(out_root, scen, H, seed, df, phase_no) -> None:
@@ -384,7 +514,7 @@ def _sweep_fused(args, cell_config, cell_done, out_root) -> int:
     except ValueError as e:
         raise SystemExit(f"sweep --fused: {e}")
 
-    phase_metrics, dt = _run_phases(
+    states, phase_metrics, dt = _run_phases(
         args.phases,
         train_fresh=lambda: train_matrix(base, cfgs, args.seeds, n_blocks),
         train_resume=lambda st: train_matrix(
@@ -393,10 +523,22 @@ def _sweep_fused(args, cell_config, cell_done, out_root) -> int:
         reset=lambda st: reset_matrix_for_phase(base, st, cfgs, args.seeds),
     )
 
+    # Same guard rail as the sequential sweep's _check_cell_finite, at
+    # replica granularity (cell-major layout): never write non-finite
+    # results (fault-injection sweeps want --sanitize). Params checked
+    # too — a poisoning in the last update block never reaches metrics.
+    healthy = _replica_param_health(states)
+    unhealthy = set()
     for ph, metrics in enumerate(phase_metrics):
         rows = split_matrix_metrics(metrics, len(cells), len(args.seeds))
-        for (scen, H), row in zip(cells, rows):
-            for seed, m in zip(args.seeds, row):
+        for c, ((scen, H), row) in enumerate(zip(cells, rows)):
+            for s, (seed, m) in enumerate(zip(args.seeds, row)):
+                ok = healthy[c * len(args.seeds) + s] and all(
+                    np.all(np.isfinite(np.asarray(l))) for l in m
+                )
+                if not ok:
+                    unhealthy.add((scen, H, seed))
+                    continue
                 _write_sim_data(
                     out_root, scen, H, seed,
                     metrics_to_dataframe(m), args.phase + ph,
@@ -410,6 +552,16 @@ def _sweep_fused(args, cell_config, cell_done, out_root) -> int:
         f"as one program per phase in {dt:.1f}s "
         f"({sps:.0f} env-steps/s aggregate)"
     )
+    if unhealthy:
+        print(
+            f"sweep --fused: {len(unhealthy)} replica(s) produced "
+            "non-finite metrics and were NOT written (diverged or "
+            "fault-poisoned params; injected-fault sweeps want "
+            "--sanitize): "
+            + ", ".join(f"{s} H={h} seed={sd}" for s, h, sd in sorted(unhealthy)),
+            file=sys.stderr,
+        )
+        return 1
     return 0
 
 
@@ -477,6 +629,7 @@ def cmd_sweep(argv) -> int:
         "parallel/matrix.py) instead of one program per cell; requires "
         "consensus_impl xla/auto",
     )
+    _add_fault_flags(p)
     args = p.parse_args(argv)
     if args.n_episodes <= 0 or args.n_episodes % args.n_ep_fixed != 0:
         raise SystemExit(
@@ -504,6 +657,8 @@ def cmd_sweep(argv) -> int:
             fast_lr=args.fast_lr,
             eps_explore=args.eps,
             consensus_impl=args.consensus_impl,
+            fault_plan=fault_plan_from_args(args),
+            consensus_sanitize=args.sanitize,
         )
 
     out_root = Path(args.out)
@@ -521,42 +676,84 @@ def cmd_sweep(argv) -> int:
     if args.fused:
         return _sweep_fused(args, cell_config, cell_done, out_root)
 
+    def run_cell(scen: str, H: int) -> None:
+        cfg = cell_config(scen, H)
+        n_blocks = args.n_episodes // cfg.n_ep_fixed
+        # all seeds of a cell run as ONE sharded/vmapped program
+        states, phase_metrics, dt = _run_phases(
+            args.phases,
+            train_fresh=lambda cfg=cfg: train_parallel(
+                cfg, seeds=args.seeds, n_blocks=n_blocks
+            ),
+            train_resume=lambda st, cfg=cfg: train_parallel(
+                cfg, states=st, n_blocks=n_blocks
+            ),
+            reset=lambda st, cfg=cfg: reset_states_for_phase(
+                cfg, st, args.seeds
+            ),
+        )
+        _check_cell_finite(states, phase_metrics, f"{scen} H={H}")
+        for ph, metrics in enumerate(phase_metrics):
+            for i, seed in enumerate(args.seeds):
+                _write_sim_data(
+                    out_root, scen, H, seed,
+                    metrics_to_dataframe(
+                        type(metrics)(*(l[i] for l in metrics))
+                    ),
+                    args.phase + ph,
+                )
+        total_eps = args.n_episodes * args.phases
+        sps = len(args.seeds) * total_eps * cfg.max_ep_len / dt
+        print(
+            f"{scen} H={H}: {len(args.seeds)} seeds x {total_eps} eps "
+            f"({args.phases} phase(s)) in {dt:.1f}s "
+            f"({sps:.0f} env-steps/s aggregate)"
+        )
+
+    # Per-cell fault isolation (same contract as `bench`/`profile`): one
+    # failing cell is retried once, then recorded and skipped, so a
+    # crash (OOM, lowering failure, a fault-plan run diverging past the
+    # guard) costs that cell — not the rest of the matrix.
+    failed = []
     for scen in args.scenarios:
         for H in args.H:
             if args.skip_existing and cell_done(scen, H):
                 print(f"{scen} H={H}: complete on disk, skipping")
                 continue
-            cfg = cell_config(scen, H)
-            n_blocks = args.n_episodes // cfg.n_ep_fixed
-            # all seeds of a cell run as ONE sharded/vmapped program
-            phase_metrics, dt = _run_phases(
-                args.phases,
-                train_fresh=lambda cfg=cfg: train_parallel(
-                    cfg, seeds=args.seeds, n_blocks=n_blocks
-                ),
-                train_resume=lambda st, cfg=cfg: train_parallel(
-                    cfg, states=st, n_blocks=n_blocks
-                ),
-                reset=lambda st, cfg=cfg: reset_states_for_phase(
-                    cfg, st, args.seeds
-                ),
-            )
-            for ph, metrics in enumerate(phase_metrics):
-                for i, seed in enumerate(args.seeds):
-                    _write_sim_data(
-                        out_root, scen, H, seed,
-                        metrics_to_dataframe(
-                            type(metrics)(*(l[i] for l in metrics))
-                        ),
-                        args.phase + ph,
+            for attempt in (0, 1):
+                try:
+                    run_cell(scen, H)
+                    break
+                except _CellUnhealthy as e:
+                    # deterministic in the cell's seeds — a retry would
+                    # reproduce the same divergence; record and move on
+                    failed.append((scen, H, str(e)))
+                    print(f"{e} — skipping cell", file=sys.stderr)
+                    break
+                except Exception as e:  # noqa: BLE001 — cell isolation
+                    if attempt == 0:
+                        print(
+                            f"{scen} H={H}: {type(e).__name__}: "
+                            f"{str(e)[:200]} — retrying once",
+                            file=sys.stderr,
+                        )
+                        continue
+                    failed.append((scen, H, f"{type(e).__name__}: {e}"))
+                    print(
+                        f"{scen} H={H}: failed twice, skipping cell "
+                        f"({type(e).__name__}: {str(e)[:200]})",
+                        file=sys.stderr,
                     )
-            total_eps = args.n_episodes * args.phases
-            sps = len(args.seeds) * total_eps * cfg.max_ep_len / dt
-            print(
-                f"{scen} H={H}: {len(args.seeds)} seeds x {total_eps} eps "
-                f"({args.phases} phase(s)) in {dt:.1f}s "
-                f"({sps:.0f} env-steps/s aggregate)"
-            )
+    if failed:
+        print(
+            f"sweep: {len(failed)} cell(s) failed: "
+            + ", ".join(f"{s} H={h}" for s, h, _ in failed),
+            file=sys.stderr,
+        )
+        # Completed cells' artifacts are already on disk; a nonzero rc
+        # tells drivers the matrix is incomplete (re-issue with
+        # --skip_existing to compute only the missing cells).
+        return 1
     return 0
 
 

@@ -15,7 +15,9 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass
-from typing import Tuple
+from typing import Optional, Tuple
+
+from rcmarl_tpu.faults import FaultPlan
 
 
 #: Valid consensus aggregation backends (see ops/aggregation.py):
@@ -148,6 +150,18 @@ class Config:
     # the CPU-measured selection crossover elsewhere
     # (ops/aggregation.py:resolve_impl, BENCH_SCALING.md, PERF.md).
     consensus_impl: str = "xla"
+    # --- transport faults / graceful degradation ---
+    # fault_plan: per-link transport-fault injection on the consensus
+    # exchange (drop / stale replay / corruption / NaN-Inf bombs —
+    # rcmarl_tpu.faults.FaultPlan), applied between the neighbor gather
+    # and the aggregation. None (default) = clean transport, bit-for-bit
+    # the seed behavior. consensus_sanitize: harden the aggregation
+    # against non-finite payloads (NaN/±Inf entries become per-element
+    # exclusions; < 2H+1 finite survivors keep the agent's own value) —
+    # the defense arm for fault_plan, and for genuinely diverged
+    # neighbors in clean runs.
+    fault_plan: Optional[FaultPlan] = None
+    consensus_sanitize: bool = False
     # --- matmul compute precision ---
     # 'float32' (default): true-fp32 dots, the reference-parity path.
     # 'bfloat16': opt-in scale-out mode — matmul inputs in the MXU's
@@ -184,6 +198,14 @@ class Config:
             raise ValueError(
                 f"compute_dtype={self.compute_dtype!r}: expected "
                 "'float32' or 'bfloat16'"
+            )
+        if self.fault_plan is not None and not isinstance(
+            self.fault_plan, FaultPlan
+        ):
+            raise ValueError(
+                "fault_plan must be a rcmarl_tpu.faults.FaultPlan "
+                f"(got {type(self.fault_plan).__name__}); dicts don't "
+                "hash and would break jit-staticness"
             )
 
     # ---- derived (static) quantities ----

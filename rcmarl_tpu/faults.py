@@ -1,0 +1,285 @@
+"""Transport-fault injection for the consensus exchange.
+
+The paper's threat model (RPBCAC, arXiv:2111.06776) is *behavioral*:
+greedy / faulty / malicious neighbors that send well-formed but wrong
+weights. Real decentralized training adds a second, *transport-level*
+threat model — gossip links drop, replay stale payloads, or deliver
+corrupted bytes (gossip actor-learners, arXiv:1906.04585; preemption-
+tolerant Podracer pods, arXiv:2104.06272) — that the scripted
+adversaries never exercise. This module makes those faults a
+first-class, reproducible experiment knob:
+
+- :class:`FaultPlan`: a frozen, hashable description of per-link fault
+  probabilities. It lives inside :class:`~rcmarl_tpu.config.Config`
+  (``cfg.fault_plan``), so a faulted run is as pinned and resumable as a
+  clean one.
+- :func:`apply_link_faults`: a pure PRNG-driven transform on the
+  GATHERED neighbor block — leaves ``(N, n_in, ...)``, own payload at
+  slot 0 — applied between the exchange and the aggregation
+  (``training/update.py``). Because it only sees the post-gather block,
+  it traces identically under vmap (per-agent and per-replica), the
+  fused experiment matrix (traced :class:`CellSpec`), and both gather
+  lowerings (rotation-symmetric rolls and the general advanced-index
+  path).
+- :func:`fault_diagnostics`: per-block counters (non-finite payload
+  entries; elementwise degree-deficit events where fewer than ``2H+1``
+  finite values survive) surfaced by the trainer instead of silently
+  undefined clipping.
+- :func:`tree_all_finite`: the trainer guard's per-block detector.
+
+Fault semantics, per directed link = (receiving agent ``i``, neighbor
+slot ``j >= 1``) — slot 0 is the agent's own payload and is NEVER
+faulted (there is no transport hop to itself):
+
+1. ``stale_p``   — the link replays the sender's stale pre-fit weights
+                   (the epoch-carry nets) instead of the fresh message.
+2. ``corrupt_p`` — additive Gaussian corruption of the payload
+                   (scale ``corrupt_scale``), elementwise noise.
+3. ``flip_p``    — sign-flip corruption (the whole payload negated).
+4. ``drop_p``    — the link delivers nothing; the receiver sees a NaN
+                   payload (with ``sanitize`` consensus the row is
+                   excluded; without it, this is the NaN poisoning the
+                   guard rails exist for).
+5. ``nan_p`` / ``inf_p`` — adversarial payload bombs: all-NaN, or ±Inf
+                   with a per-link random sign.
+
+Stages compose in that order (a stale payload can still be corrupted
+and then bombed), each drawn independently per link per epoch from a
+dedicated fault stream (``jax.random.fold_in`` off the epoch key — the
+clean run's RNG stream is untouched, so ``fault_plan=None`` reproduces
+the seed behavior bit-for-bit).
+
+jax is imported inside the functions that trace, not at module level,
+so ``rcmarl_tpu.config`` (which owns a :class:`FaultPlan` field) stays
+importable without pulling in jax — the CLI's fast ``--help`` path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import NamedTuple
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """Per-link transport-fault probabilities (see module docstring).
+
+    Frozen + scalar fields only, so it is hashable and can live inside
+    the jit-static :class:`~rcmarl_tpu.config.Config`. ``seed``
+    namespaces the fault stream: two plans differing only in ``seed``
+    draw independent fault patterns over the same training run.
+    """
+
+    drop_p: float = 0.0
+    stale_p: float = 0.0
+    corrupt_p: float = 0.0
+    corrupt_scale: float = 1.0
+    flip_p: float = 0.0
+    nan_p: float = 0.0
+    inf_p: float = 0.0
+    seed: int = 0
+
+    def __post_init__(self):
+        for name in ("drop_p", "stale_p", "corrupt_p", "flip_p", "nan_p", "inf_p"):
+            p = getattr(self, name)
+            if not 0.0 <= float(p) <= 1.0:
+                raise ValueError(f"FaultPlan.{name}={p} must be in [0, 1]")
+        if not float(self.corrupt_scale) >= 0.0:
+            raise ValueError(
+                f"FaultPlan.corrupt_scale={self.corrupt_scale} must be >= 0"
+            )
+
+    @property
+    def active(self) -> bool:
+        """True when any fault can actually fire (an all-zero plan is a
+        no-op and callers skip the transform entirely)."""
+        return any(
+            float(getattr(self, n)) > 0.0
+            for n in ("drop_p", "stale_p", "corrupt_p", "flip_p", "nan_p", "inf_p")
+        )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FaultDiag(NamedTuple):
+    """Per-block degradation counters (int32 scalars, summable across
+    epochs/trees): ``nonfinite`` = NaN/±Inf payload entries seen in the
+    gathered blocks; ``deficit`` = elementwise aggregation slots where
+    fewer than ``2H+1`` finite values survive, i.e. where the sanitize
+    kernel fell back to the agent's own value."""
+
+    nonfinite: object
+    deficit: object
+
+
+def zero_diag() -> FaultDiag:
+    import jax.numpy as jnp
+
+    z = jnp.zeros((), jnp.int32)
+    return FaultDiag(nonfinite=z, deficit=z)
+
+
+def sum_diags(diags: FaultDiag) -> FaultDiag:
+    """Collapse a stacked (e.g. per-epoch scanned) FaultDiag to scalars."""
+    import jax.numpy as jnp
+
+    return FaultDiag(
+        nonfinite=jnp.sum(diags.nonfinite).astype(jnp.int32),
+        deficit=jnp.sum(diags.deficit).astype(jnp.int32),
+    )
+
+
+def _link_masks(key, plan: FaultPlan, shape):
+    """Draw the per-link (N, n_in) fault masks for one epoch. Slot 0
+    (self) is structurally exempt from every fault."""
+    import jax
+    import jax.numpy as jnp
+
+    k_drop, k_stale, k_cor, k_flip, k_nan, k_inf, k_sign = jax.random.split(key, 7)
+    not_self = (jnp.arange(shape[1]) != 0)[None, :]
+
+    def bern(k, p):
+        if float(p) <= 0.0:
+            return jnp.zeros(shape, bool)
+        return jax.random.bernoulli(k, p, shape) & not_self
+
+    inf_sign = jnp.where(
+        jax.random.bernoulli(k_sign, 0.5, shape), jnp.inf, -jnp.inf
+    )
+    return {
+        "drop": bern(k_drop, plan.drop_p),
+        "stale": bern(k_stale, plan.stale_p),
+        "corrupt": bern(k_cor, plan.corrupt_p),
+        "flip": bern(k_flip, plan.flip_p),
+        "nan": bern(k_nan, plan.nan_p),
+        "inf": bern(k_inf, plan.inf_p),
+        "inf_sign": inf_sign,
+    }
+
+
+def apply_link_faults(key, fresh_tree, stale_tree, plan: FaultPlan):
+    """Apply ``plan`` to a gathered neighbor-message pytree.
+
+    Args:
+      key: PRNG key for this (epoch, tree) fault draw. Derive it by
+        ``fold_in`` from the epoch key so the clean-run stream is
+        untouched (see module docstring).
+      fresh_tree: gathered messages, leaves ``(N, n_in, ...)``, own
+        payload at slot 0.
+      stale_tree: the same gather over the sender's PRE-FIT weights
+        (the epoch carry) — what a stale link replays. Pass
+        ``fresh_tree`` again to disable replay content-wise.
+      plan: the fault plan; an inactive plan returns ``fresh_tree``
+        unchanged (bitwise).
+
+    Returns the faulted pytree, same structure/shapes/dtypes. A fault
+    hits a LINK: the same (agent, slot) draw applies to every leaf
+    (whole payloads drop/replay/flip together), while additive
+    corruption noise is drawn per element per leaf.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if not plan.active:
+        return fresh_tree
+
+    leaves = jax.tree.leaves(fresh_tree)
+    if not leaves:
+        return fresh_tree
+    shape = leaves[0].shape[:2]  # (N, n_in), shared by every leaf
+    key = jax.random.fold_in(key, plan.seed)
+    masks = _link_masks(key, plan, shape)
+
+    def bcast(m, leaf):
+        return m.reshape(shape + (1,) * (leaf.ndim - 2))
+
+    def fault_leaf(i, fresh, stale):
+        v = fresh
+        if float(plan.stale_p) > 0.0:
+            v = jnp.where(bcast(masks["stale"], v), stale, v)
+        if float(plan.corrupt_p) > 0.0:
+            noise = jax.random.normal(
+                jax.random.fold_in(key, i + 1), v.shape, v.dtype
+            )
+            v = jnp.where(
+                bcast(masks["corrupt"], v),
+                v + jnp.asarray(plan.corrupt_scale, v.dtype) * noise,
+                v,
+            )
+        if float(plan.flip_p) > 0.0:
+            v = jnp.where(bcast(masks["flip"], v), -v, v)
+        if float(plan.drop_p) > 0.0 or float(plan.nan_p) > 0.0:
+            bomb = masks["drop"] | masks["nan"]
+            v = jnp.where(bcast(bomb, v), jnp.nan, v)
+        if float(plan.inf_p) > 0.0:
+            v = jnp.where(
+                bcast(masks["inf"], v),
+                bcast(masks["inf_sign"], v).astype(v.dtype),
+                v,
+            )
+        return v
+
+    fresh_leaves, treedef = jax.tree.flatten(fresh_tree)
+    stale_leaves = jax.tree.leaves(stale_tree)
+    if len(stale_leaves) != len(fresh_leaves):
+        raise ValueError(
+            "fresh_tree and stale_tree must share a structure: "
+            f"{len(fresh_leaves)} vs {len(stale_leaves)} leaves"
+        )
+    out = [
+        fault_leaf(i, f, s)
+        for i, (f, s) in enumerate(zip(fresh_leaves, stale_leaves))
+    ]
+    return jax.tree.unflatten(treedef, out)
+
+
+def fault_diagnostics(tree, H, valid=None) -> FaultDiag:
+    """Count degradation events in a gathered neighbor block.
+
+    ``nonfinite``: NaN/±Inf entries across all leaves (padded-invalid
+    slots excluded when ``valid`` is given — pad garbage is not a
+    fault). ``deficit``: elementwise slots where fewer than ``2H+1``
+    finite values survive — exactly the condition under which the
+    sanitize kernel keeps the agent's own value
+    (:func:`rcmarl_tpu.ops.aggregation.resilient_aggregate`). ``H`` may
+    be a traced scalar (the fused-matrix path); ``valid`` is the
+    (N, n_in) or (n_in,) edge-validity mask of padded ragged graphs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    need = 2 * jnp.asarray(H, jnp.int32) + 1
+    nonfinite = jnp.zeros((), jnp.int32)
+    deficit = jnp.zeros((), jnp.int32)
+    for leaf in jax.tree.leaves(tree):
+        finite = jnp.isfinite(leaf)
+        if valid is not None:
+            vb = (jnp.asarray(valid) > 0).reshape(
+                valid.shape + (1,) * (leaf.ndim - valid.ndim)
+            )
+            finite = finite & vb
+            bad = ~finite & vb
+        else:
+            bad = ~finite
+        nonfinite = nonfinite + jnp.sum(bad).astype(jnp.int32)
+        count = jnp.sum(finite.astype(jnp.int32), axis=1)  # drop n_in axis
+        deficit = deficit + jnp.sum(count < need).astype(jnp.int32)
+    return FaultDiag(nonfinite=nonfinite, deficit=deficit)
+
+
+def tree_all_finite(tree):
+    """() bool: every leaf of ``tree`` is fully finite — the trainer
+    guard's per-block health check (cheap: one fused reduction)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = [
+        jnp.all(jnp.isfinite(l))
+        for l in jax.tree.leaves(tree)
+        if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+    ]
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack(leaves).all()

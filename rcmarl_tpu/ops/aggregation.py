@@ -225,12 +225,137 @@ def _trim_bounds(values: jnp.ndarray, H: int, impl: str):
     return small[H], large[0]
 
 
+# --------------------------------------------------------------------------
+# Sanitized (non-finite-hardened) aggregation
+# --------------------------------------------------------------------------
+#
+# Transport faults (rcmarl_tpu.faults) and genuinely diverged neighbors
+# deliver NaN/±Inf payloads. The plain kernel has NO defense: a single
+# NaN poisons the sort/selection bounds and then the clipped mean of
+# every backend. ``sanitize=True`` converts non-finite entries into
+# per-element EXCLUSIONS via the same ±inf-sentinel trick the masked
+# (padded-graph) path already uses — non-finite values sink to +inf on
+# the lower-bound side and -inf on the upper-bound side, so the trim
+# bounds are order statistics of the surviving finite values only — and
+# the mean runs over the finite entries. When fewer than ``2H+1`` finite
+# values survive at an element (a degree deficit: the H-trimming
+# guarantee needs 2H+1 honest-capable inputs), the aggregate gracefully
+# KEEPS THE AGENT'S OWN VALUE instead of computing undefined clipping;
+# rcmarl_tpu.faults.fault_diagnostics counts exactly these events for
+# the trainer's per-block diagnostics.
+#
+# Cross-backend contract (pinned by tests/test_faults.py): the sanitize
+# epilogue below is written as an explicit slot-ordered chain of adds —
+# the same association order the Pallas kernel's accumulator uses — and
+# the bounds are exact selections on the sinked arrays, so all six
+# impls (xla, xla_sort, masked, traced-H, pallas select, pallas sort)
+# produce BITWISE-identical f32 aggregates.
+
+
+def _sanitize_parts(values: jnp.ndarray, valid: jnp.ndarray | None):
+    """(finite, sink_lo, sink_hi): the elementwise finite mask (ANDed
+    with the padded-graph edge validity, when given) and the ±inf-sunk
+    copies whose order statistics see only surviving entries."""
+    n_in = values.shape[0]
+    finite = jnp.isfinite(values)
+    if valid is not None:
+        shape = (n_in,) + (1,) * (values.ndim - 1)
+        finite = finite & (valid.reshape(shape) > 0)
+    sink_lo = jnp.where(finite, values, jnp.inf)
+    sink_hi = jnp.where(finite, values, -jnp.inf)
+    return finite, sink_lo, sink_hi
+
+
+def _sanitized_epilogue(values, finite, count, lower_raw, upper_raw, need):
+    """Shared tail of every sanitized backend: own-anchored bounds over
+    surviving entries, slot-ordered clip-and-accumulate, finite-count
+    mean, and the degree-deficit fallback to the agent's own value.
+    ``need`` may be traced (the fused-matrix path's 2H+1)."""
+    n_in = values.shape[0]
+    own = values[0]
+    # Own-anchoring (own value always inside the bounds) via the sunk
+    # own row: a non-finite own value anchors nothing instead of
+    # poisoning both bounds.
+    lower = jnp.minimum(lower_raw, jnp.where(finite[0], own, jnp.inf))
+    upper = jnp.maximum(upper_raw, jnp.where(finite[0], own, -jnp.inf))
+    acc = jnp.where(finite[0], jnp.clip(values[0], lower, upper), 0.0)
+    for i in range(1, n_in):
+        acc = acc + jnp.where(
+            finite[i], jnp.clip(values[i], lower, upper), 0.0
+        )
+    # Deficit fallback: < 2H+1 finite survivors void the H-trimming
+    # guarantee — keep own value (which may itself be non-finite; the
+    # trainer guard, not the kernel, owns that failure).
+    return jnp.where(count >= need, acc / count, own)
+
+
+def _finite_count(finite, dtype):
+    """Slot-ordered sequential count of surviving entries — the same
+    association order as the Pallas kernel's accumulator (bitwise
+    contract, see the section comment)."""
+    n_in = finite.shape[0]
+    count = finite[0].astype(dtype)
+    for i in range(1, n_in):
+        count = count + finite[i].astype(dtype)
+    return count
+
+
+def _sanitized_aggregate(
+    values: jnp.ndarray, H: int, impl: str, valid: jnp.ndarray | None = None
+) -> jnp.ndarray:
+    """Static-H sanitized clip-and-average (xla / xla_sort / masked)."""
+    n_in = values.shape[0]
+    if not 0 <= 2 * H <= n_in - 1:
+        raise ValueError(f"H={H} invalid for n_in={n_in}: need 0 <= 2H <= n_in-1")
+    finite, sink_lo, sink_hi = _sanitize_parts(values, valid)
+    count = _finite_count(finite, values.dtype)
+    if impl == "xla_sort":
+        lower_raw = jnp.sort(sink_lo, axis=0)[H]
+        upper_raw = jnp.sort(sink_hi, axis=0)[n_in - 1 - H]
+    else:
+        small = _running_small([sink_lo[i] for i in range(n_in)], H + 1)
+        large = _running_large([sink_hi[i] for i in range(n_in)], H + 1)
+        lower_raw, upper_raw = small[H], large[0]
+    return _sanitized_epilogue(
+        values, finite, count, lower_raw, upper_raw, 2 * H + 1
+    )
+
+
+def _sanitized_dynamic(values: jnp.ndarray, H, impl: str) -> jnp.ndarray:
+    """Traced-H sanitized clip-and-average: the legal-range trick of
+    :func:`_dynamic_h_aggregate` (k_max registers / dynamic sort index)
+    over the ±inf-sunk copies, same epilogue, traced deficit threshold."""
+    if impl not in ("xla", "xla_sort"):
+        raise ValueError(
+            f"traced H requires the xla consensus family (xla/xla_sort), "
+            f"got {impl!r} (the Pallas kernel fixes its trim indices at "
+            "lowering time)"
+        )
+    H = jnp.asarray(H, jnp.int32)
+    n_in = values.shape[0]
+    finite, sink_lo, sink_hi = _sanitize_parts(values, None)
+    count = _finite_count(finite, values.dtype)
+    if impl == "xla_sort":
+        lower_raw = jnp.take(jnp.sort(sink_lo, axis=0), H, axis=0)
+        upper_raw = jnp.take(jnp.sort(sink_hi, axis=0), n_in - 1 - H, axis=0)
+    else:
+        k_max = (n_in - 1) // 2 + 1
+        small = _running_small([sink_lo[i] for i in range(n_in)], k_max)
+        large = _running_large([sink_hi[i] for i in range(n_in)], k_max)
+        lower_raw = jnp.take(jnp.stack(small), H, axis=0)
+        upper_raw = jnp.take(jnp.stack(large), k_max - 1 - H, axis=0)
+    return _sanitized_epilogue(
+        values, finite, count, lower_raw, upper_raw, 2 * H + 1
+    )
+
+
 def resilient_aggregate(
     values: jnp.ndarray,
     H: int,
     impl: str = "xla",
     valid: jnp.ndarray | None = None,
     n_agents: int = 1,
+    sanitize: bool = False,
 ) -> jnp.ndarray:
     """Clip-and-average over the leading neighbor axis.
 
@@ -264,6 +389,12 @@ def resilient_aggregate(
         kernel (irregular graphs are host-defined, small-scale usage).
       n_agents: vmapped agent-axis size of the calling consensus layer,
         used only to resolve ``'auto'`` (see :func:`resolve_impl`).
+      sanitize: harden against non-finite payloads — NaN/±Inf entries
+        become per-element exclusions (±inf-sentinel sinks, like padded
+        slots), the mean runs over surviving finite entries, and an
+        element with fewer than 2H+1 finite survivors keeps the agent's
+        own value (degree-deficit fallback). Bitwise-identical across
+        every backend; see the "Sanitized aggregation" section comment.
 
     Returns:
       (...) aggregated values.
@@ -274,13 +405,15 @@ def resilient_aggregate(
                 "traced H is not supported together with a padded-graph "
                 "validity mask (matrix cells must share one uniform graph)"
             )
-        return _dynamic_h_aggregate(
-            values, H, _resolve_dynamic(impl, values.shape[0])
-        )
+        concrete = _resolve_dynamic(impl, values.shape[0])
+        if sanitize:
+            return _sanitized_dynamic(values, H, concrete)
+        return _dynamic_h_aggregate(values, H, concrete)
     if valid is not None:
-        return _masked_aggregate(
-            values, H, valid, _resolve_masked(impl, values.shape[0], H)
-        )
+        concrete = _resolve_masked(impl, values.shape[0], H)
+        if sanitize:
+            return _sanitized_aggregate(values, H, concrete, valid=valid)
+        return _masked_aggregate(values, H, valid, concrete)
     impl = resolve_impl(impl, values.shape[0], values.dtype, n_agents, H)
     if impl not in ("xla", "xla_sort"):
         from rcmarl_tpu.ops.pallas_aggregation import fused_resilient_aggregate
@@ -290,7 +423,10 @@ def resilient_aggregate(
             H,
             variant="sort" if impl == "pallas_sort" else "select",
             interpret=impl == "pallas_interpret",
+            sanitize=sanitize,
         )
+    if sanitize:
+        return _sanitized_aggregate(values, H, impl)
     n_in = values.shape[0]
     if not 0 <= 2 * H <= n_in - 1:
         raise ValueError(f"H={H} invalid for n_in={n_in}: need 0 <= 2H <= n_in-1")
@@ -440,6 +576,7 @@ def resilient_aggregate_tree(
     impl: str = "xla",
     valid: jnp.ndarray | None = None,
     n_agents: int = 1,
+    sanitize: bool = False,
 ):
     """Apply :func:`resilient_aggregate` to every leaf of a pytree whose
     leaves carry a leading neighbor axis (e.g. a gathered parameter
@@ -448,7 +585,8 @@ def resilient_aggregate_tree(
     leaf. ``valid`` masks padded neighbor slots (see
     :func:`resilient_aggregate`; masked trees take the XLA path).
     ``n_agents`` is the vmapped agent-axis size, used only to resolve
-    ``'auto'``."""
+    ``'auto'``. ``sanitize`` hardens every leaf against non-finite
+    payloads (see :func:`resilient_aggregate`)."""
     leaves = jax.tree.leaves(tree)
     if not leaves:  # e.g. the trunk tree of a head-only (hidden=()) net
         _check_impl(impl)
@@ -460,11 +598,20 @@ def resilient_aggregate_tree(
                 "validity mask (matrix cells must share one uniform graph)"
             )
         concrete = _resolve_dynamic(impl, leaves[0].shape[0])
+        if sanitize:
+            return jax.tree.map(
+                lambda v: _sanitized_dynamic(v, H, concrete), tree
+            )
         return jax.tree.map(
             lambda v: _dynamic_h_aggregate(v, H, concrete), tree
         )
     if valid is not None:
         concrete = _resolve_masked(impl, leaves[0].shape[0], H)
+        if sanitize:
+            return jax.tree.map(
+                lambda v: _sanitized_aggregate(v, H, concrete, valid=valid),
+                tree,
+            )
         return jax.tree.map(
             lambda v: _masked_aggregate(v, H, valid, concrete), tree
         )
@@ -481,5 +628,8 @@ def resilient_aggregate_tree(
             H,
             variant="sort" if impl == "pallas_sort" else "select",
             interpret=impl == "pallas_interpret",
+            sanitize=sanitize,
         )
+    if sanitize:
+        return jax.tree.map(lambda v: _sanitized_aggregate(v, H, impl), tree)
     return jax.tree.map(lambda v: resilient_aggregate(v, H, impl), tree)

@@ -50,7 +50,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from rcmarl_tpu.ops.aggregation import _running_extrema, _sorting_network
+from rcmarl_tpu.ops.aggregation import (
+    _running_extrema,
+    _running_large,
+    _running_small,
+    _sorting_network,
+)
 
 _LANES = 128
 
@@ -106,8 +111,40 @@ def _agg_kernel(vals_ref, out_ref, *, n_in: int, H: int, bounds):
         out_ref[...] = acc * (1.0 / n_in)
 
 
+def _sanitized_agg_kernel(vals_ref, out_ref, *, n_in: int, H: int, variant: str):
+    """Non-finite-hardened tile: NaN/±Inf entries become per-element
+    exclusions (±inf-sentinel sinks), the mean runs over surviving
+    finite entries, and elements with fewer than 2H+1 finite survivors
+    keep the agent's own value. The op sequence — sinks, exact-selection
+    bounds, slot-ordered accumulate, count division, deficit select —
+    mirrors ``aggregation._sanitized_aggregate`` exactly, so the outputs
+    are BITWISE identical to the XLA backends (the cross-backend
+    contract tests/test_faults.py pins)."""
+    rows = [vals_ref[i] for i in range(n_in)]  # each (rows, LANES)
+    own = rows[0]
+    finite = [jnp.isfinite(r) for r in rows]
+    count = finite[0].astype(jnp.float32)
+    for f in finite[1:]:
+        count = count + f.astype(jnp.float32)
+    sink_lo = [jnp.where(f, r, jnp.inf) for f, r in zip(finite, rows)]
+    sink_hi = [jnp.where(f, r, -jnp.inf) for f, r in zip(finite, rows)]
+    if variant == "sort":
+        lower_raw = _sorting_network(sink_lo)[H]
+        upper_raw = _sorting_network(sink_hi)[n_in - 1 - H]
+    else:
+        lower_raw = _running_small(sink_lo, H + 1)[H]
+        upper_raw = _running_large(sink_hi, H + 1)[0]
+    lower = jnp.minimum(lower_raw, sink_lo[0])
+    upper = jnp.maximum(upper_raw, sink_hi[0])
+    acc = jnp.where(finite[0], jnp.clip(rows[0], lower, upper), 0.0)
+    for r, f in zip(rows[1:], finite[1:]):
+        acc = acc + jnp.where(f, jnp.clip(r, lower, upper), 0.0)
+    out_ref[...] = jnp.where(count >= 2 * H + 1, acc / count, own)
+
+
 @functools.partial(
-    jax.jit, static_argnames=("H", "variant", "block_rows", "interpret")
+    jax.jit,
+    static_argnames=("H", "variant", "block_rows", "interpret", "sanitize"),
 )
 def fused_resilient_aggregate(
     values: jnp.ndarray,
@@ -116,6 +153,7 @@ def fused_resilient_aggregate(
     variant: str = "select",
     block_rows: int | None = None,
     interpret: bool = False,
+    sanitize: bool = False,
 ) -> jnp.ndarray:
     """Pallas twin of :func:`~rcmarl_tpu.ops.aggregation.resilient_aggregate`.
 
@@ -129,6 +167,10 @@ def fused_resilient_aggregate(
         n_in x block_rows x 128 floats); default per variant
         (:data:`_DEFAULT_BLOCK_ROWS`).
       interpret: run in the Pallas interpreter (for CPU tests).
+      sanitize: non-finite-hardened epilogue (NaN/±Inf entries excluded
+        per element, degree-deficit fallback to own value) — bitwise
+        identical to the XLA backends' sanitize mode
+        (:func:`_sanitized_agg_kernel`).
 
     Returns:
       (...) aggregated values in ``values.dtype``. Selection/clip/mean
@@ -157,8 +199,16 @@ def fused_resilient_aggregate(
     rows_total = padded // _LANES
     v3 = flat.reshape(n_in, rows_total, _LANES)
     grid = (rows_total // block_rows,)
+    if sanitize:
+        kernel = functools.partial(
+            _sanitized_agg_kernel, n_in=n_in, H=H, variant=variant
+        )
+    else:
+        kernel = functools.partial(
+            _agg_kernel, n_in=n_in, H=H, bounds=_BOUNDS[variant]
+        )
     out = pl.pallas_call(
-        functools.partial(_agg_kernel, n_in=n_in, H=H, bounds=_BOUNDS[variant]),
+        kernel,
         out_shape=jax.ShapeDtypeStruct((rows_total, _LANES), jnp.float32),
         in_specs=[
             pl.BlockSpec((n_in, block_rows, _LANES), lambda i: (0, i, 0))
@@ -177,6 +227,7 @@ def fused_resilient_aggregate_tree(
     variant: str = "select",
     block_rows: int | None = None,
     interpret: bool = False,
+    sanitize: bool = False,
 ):
     """Aggregate every (n_in, ...) leaf of ``tree`` in ONE kernel launch.
 
@@ -199,7 +250,12 @@ def fused_resilient_aggregate_tree(
         [l.reshape(n_in, -1) for l in leaves], axis=1
     )
     agg = fused_resilient_aggregate(
-        flat, H, variant=variant, block_rows=block_rows, interpret=interpret
+        flat,
+        H,
+        variant=variant,
+        block_rows=block_rows,
+        interpret=interpret,
+        sanitize=sanitize,
     )
     out, off = [], 0
     for leaf, size in zip(leaves, sizes):

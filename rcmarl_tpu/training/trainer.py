@@ -24,6 +24,7 @@ import numpy as np
 from rcmarl_tpu.agents.updates import AgentParams
 from rcmarl_tpu.config import Config
 from rcmarl_tpu.envs.grid_world import GridWorld, env_reset
+from rcmarl_tpu.faults import tree_all_finite
 from rcmarl_tpu.training.buffer import (
     ReplayBuffer,
     buffer_init,
@@ -86,9 +87,9 @@ def init_train_state(
     )
 
 
-@partial(jax.jit, static_argnums=0)
+@partial(jax.jit, static_argnums=0, static_argnames=("with_diag",))
 def train_block(
-    cfg: Config, state: TrainState, spec=None
+    cfg: Config, state: TrainState, spec=None, with_diag: bool = False
 ) -> Tuple[TrainState, EpisodeMetrics]:
     """One block: rollout ``n_ep_fixed`` episodes, update, push to buffer.
 
@@ -97,6 +98,8 @@ def train_block(
     :class:`~rcmarl_tpu.agents.updates.CellSpec`) switches the scenario
     knobs (roles/H/common_reward) from trace-time constants to data —
     the fused-matrix path (:mod:`rcmarl_tpu.parallel.matrix`).
+    ``with_diag`` (static) additionally returns the block's
+    :class:`~rcmarl_tpu.faults.FaultDiag` degradation counters.
     """
     env = make_env(cfg)
     key, k_roll, k_upd = jax.random.split(state.key, 3)
@@ -104,14 +107,19 @@ def train_block(
         cfg, env, state.params, state.desired, k_roll, state.initial, spec
     )
     batch = update_batch(state.buffer, fresh)
-    params = update_block(cfg, state.params, batch, fresh, k_upd, spec)
+    if with_diag:
+        params, diag = update_block(
+            cfg, state.params, batch, fresh, k_upd, spec, with_diag=True
+        )
+    else:
+        params = update_block(cfg, state.params, batch, fresh, k_upd, spec)
     buffer = buffer_push_block(state.buffer, fresh)
-    return (
-        TrainState(
-            params, buffer, state.desired, state.initial, key, state.block + 1
-        ),
-        metrics,
+    out_state = TrainState(
+        params, buffer, state.desired, state.initial, key, state.block + 1
     )
+    if with_diag:
+        return out_state, metrics, diag
+    return out_state, metrics
 
 
 def train_scanned(
@@ -147,12 +155,20 @@ def metrics_to_dataframe(metrics: EpisodeMetrics):
     )
 
 
+def _block_healthy(state: TrainState, metrics) -> bool:
+    """Guard predicate: params AND the block's metric rows are fully
+    finite (one fused device reduction, one host bool)."""
+    return bool(tree_all_finite((state.params, metrics)))
+
+
 def train(
     cfg: Config,
     n_episodes: Optional[int] = None,
     state: Optional[TrainState] = None,
     verbose: bool = False,
     block_callback=None,
+    guard: Optional[bool] = None,
+    max_retries: int = 1,
 ):
     """Host-looped training run (the ``train_RPBCAC`` equivalent).
 
@@ -164,21 +180,89 @@ def train(
         the ``exp_buffer`` feature of ``train_agents.py:15``).
       block_callback: called as ``f(state, block_idx)`` after each block
         (checkpoint hook).
+      guard: per-block non-finite guard rails — after each block, params
+        and metrics are checked for NaN/±Inf; an unhealthy block ROLLS
+        BACK to the last good state and retries with a perturbed RNG
+        stream (up to ``max_retries`` times), then SKIPS: the run keeps
+        the last good parameters, records the degraded metrics row, and
+        moves on. An injected (or real) fault therefore degrades the
+        run's metrics instead of destroying its parameters. ``None``
+        (default) auto-enables exactly when ``cfg.fault_plan`` is set,
+        so clean runs keep the seed behavior bit-for-bit.
+      max_retries: bounded retry budget per block under ``guard``.
 
-    Returns (state, sim_data DataFrame with one row per episode).
+    Returns (state, sim_data DataFrame with one row per episode). The
+    frame's ``.attrs['guard']`` records the guard/diagnostic counters
+    (retries, skipped blocks, non-finite payload entries, degree-deficit
+    fallbacks) when the guard or a fault plan is active.
     """
     n_eps = cfg.n_episodes if n_episodes is None else n_episodes
     if n_eps % cfg.n_ep_fixed != 0:
         raise ValueError(
             f"n_episodes={n_eps} must be a multiple of n_ep_fixed={cfg.n_ep_fixed}"
         )
+    if max_retries < 0:
+        raise ValueError(f"max_retries={max_retries} must be >= 0")
     n_blocks = n_eps // cfg.n_ep_fixed
     if state is None:
         state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
 
+    if guard is None:
+        guard = cfg.fault_plan is not None
+    with_diag = cfg.fault_plan is not None and cfg.fault_plan.active
+    stats = {"retries": 0, "skipped": 0, "nonfinite": 0, "deficit": 0}
+
     all_metrics = []
     for b in range(n_blocks):
-        state, m = train_block(cfg, state)
+        attempt = 0
+        while True:
+            base = state
+            if attempt:
+                # Perturbed RNG stream for the retry: different rollout,
+                # adversary-shuffle, and fault draws — deterministic in
+                # (key, block, attempt), so guarded runs stay replayable.
+                base = base._replace(
+                    key=jax.random.fold_in(base.key, attempt)
+                )
+            diag = None
+            if with_diag:
+                new_state, m, diag = train_block(cfg, base, with_diag=True)
+            else:
+                new_state, m = train_block(cfg, base)
+            if not guard or _block_healthy(new_state, m):
+                state = new_state
+                break
+            if attempt < max_retries:
+                attempt += 1
+                stats["retries"] += 1
+                if verbose:
+                    print(
+                        f"| Block {b + 1} | non-finite params/metrics — "
+                        f"rolling back (retry {attempt}/{max_retries})"
+                    )
+                continue
+            # Retries exhausted: SKIP. Keep the last good parameters and
+            # buffer, record the degraded metrics row, advance the RNG
+            # (folded on the block index so the next block does not
+            # replay the failing draw) and the block counter.
+            stats["skipped"] += 1
+            if verbose:
+                print(
+                    f"| Block {b + 1} | still non-finite after "
+                    f"{max_retries} retries — skipping (params rolled back)"
+                )
+            state = state._replace(
+                key=jax.random.fold_in(state.key, 0x5C1B + b),
+                block=state.block + 1,
+            )
+            break
+        if diag is not None:
+            # Count the RECORDED attempt only (the accepted block, or the
+            # final skipped attempt whose degraded metrics row is kept):
+            # discarded retry attempts must not inflate the per-run fault
+            # rates QUALITY.md derives from these counters.
+            stats["nonfinite"] += int(diag.nonfinite)
+            stats["deficit"] += int(diag.deficit)
         all_metrics.append(m)
         if verbose:
             tt = float(jnp.mean(m.true_team_returns))
@@ -191,4 +275,7 @@ def train(
             block_callback(state, b)
 
     metrics = jax.tree.map(lambda *xs: jnp.concatenate(xs), *all_metrics)
-    return state, metrics_to_dataframe(metrics)
+    df = metrics_to_dataframe(metrics)
+    if guard or with_diag:
+        df.attrs["guard"] = stats
+    return state, df

@@ -40,8 +40,20 @@ from rcmarl_tpu.agents.updates import (
     select_tree,
 )
 from rcmarl_tpu.config import Config, Roles
+from rcmarl_tpu.faults import (
+    FaultDiag,
+    apply_link_faults,
+    fault_diagnostics,
+    sum_diags,
+    zero_diag,
+)
 from rcmarl_tpu.models.mlp import init_stacked_mlp
 from rcmarl_tpu.ops.optim import adam_init
+
+#: fold_in tag deriving the transport-fault stream from the epoch key —
+#: a DEDICATED stream, so the clean run's split structure (and therefore
+#: every golden-pinned trajectory) is untouched when fault_plan is None.
+_FAULT_STREAM = 0xFA17
 
 
 def init_agent_params(key: jax.Array, cfg: Config) -> AgentParams:
@@ -132,6 +144,7 @@ def critic_tr_epoch(
     r_coop: jnp.ndarray,
     ekey: jax.Array,
     spec: CellSpec | None = None,
+    with_diag: bool = False,
 ):
     """One epoch of phases I+II over stacked params.
 
@@ -144,6 +157,14 @@ def critic_tr_epoch(
     fused-matrix path). Identical RNG stream structure in both modes —
     the epoch key is split the same way regardless of which branches
     run — so a spec replica reproduces its solo twin exactly.
+
+    With ``cfg.fault_plan`` active, the gathered neighbor blocks pass
+    through :func:`rcmarl_tpu.faults.apply_link_faults` between the
+    exchange and the aggregation (the transport boundary); the fault
+    stream is folded off ``ekey`` under a dedicated tag so the clean-run
+    RNG is untouched. ``with_diag`` (static) additionally returns a
+    :class:`~rcmarl_tpu.faults.FaultDiag` of degradation counters for
+    this epoch.
     """
     critic, tr, critic_local = carry
     s, ns, sa, mask = batch.s, batch.ns, batch.sa, batch.mask
@@ -212,6 +233,7 @@ def critic_tr_epoch(
         new_critic_local = select_tree(m, mal_local, new_critic_local)
 
     # ---- Phase II: resilient consensus, cooperative agents only
+    diag = zero_diag() if with_diag else None
     if traced or cfg.n_coop:
         # Heterogeneous in-degree graphs (reference main.py:28 accepts
         # arbitrary adjacency lists): rows padded to max degree with the
@@ -222,6 +244,33 @@ def critic_tr_epoch(
         H = spec.H if traced else None
         nbr_c = gather_neighbor_messages(cfg, msg_critic)  # (N, n_in, ...)
         nbr_t = gather_neighbor_messages(cfg, msg_tr)
+        plan = cfg.fault_plan
+        if plan is not None and plan.active:
+            # Transport boundary: fault the gathered blocks. A stale
+            # link replays the sender's PRE-FIT epoch-carry weights —
+            # gather the carry nets as the replay payload. Pure PRNG
+            # transform on (N, n_in, ...) blocks, so it traces the same
+            # under vmap, the fused matrix, and both gather lowerings.
+            fkey = jax.random.fold_in(ekey, _FAULT_STREAM)
+            stale_c = gather_neighbor_messages(cfg, critic)
+            stale_t = gather_neighbor_messages(cfg, tr)
+            nbr_c = apply_link_faults(
+                jax.random.fold_in(fkey, 0), nbr_c, stale_c, plan
+            )
+            nbr_t = apply_link_faults(
+                jax.random.fold_in(fkey, 1), nbr_t, stale_t, plan
+            )
+        if with_diag:
+            H_diag = H if traced else cfg.H
+            valid_diag = (
+                None if valid_pad is None else jnp.asarray(np.array(valid_pad))
+            )
+            d_c = fault_diagnostics(nbr_c, H_diag, valid_diag)
+            d_t = fault_diagnostics(nbr_t, H_diag, valid_diag)
+            diag = FaultDiag(
+                nonfinite=d_c.nonfinite + d_t.nonfinite,
+                deficit=d_c.deficit + d_t.deficit,
+            )
         if valid_pad is None:
             cons = jax.vmap(
                 lambda own, nbr, x: consensus_update_one(
@@ -248,6 +297,8 @@ def critic_tr_epoch(
         new_critic = select_tree(m, cons(new_critic, nbr_c, s), new_critic)
         new_tr = select_tree(m, cons(new_tr, nbr_t, sa), new_tr)
 
+    if with_diag:
+        return (new_critic, new_tr, new_critic_local), diag
     return new_critic, new_tr, new_critic_local
 
 
@@ -303,7 +354,7 @@ def actor_phase(
     return new_actor, new_opt
 
 
-@partial(jax.jit, static_argnums=0)
+@partial(jax.jit, static_argnums=0, static_argnames=("with_diag",))
 def update_block(
     cfg: Config,
     params: AgentParams,
@@ -311,6 +362,7 @@ def update_block(
     fresh: Batch,
     key: jax.Array,
     spec: CellSpec | None = None,
+    with_diag: bool = False,
 ) -> AgentParams:
     """Full update block: ``n_epochs`` x (phase I + II) then phase III.
 
@@ -321,18 +373,28 @@ def update_block(
       key: PRNG key for adversary fit shuffles and actor minibatching.
       spec: optional traced scenario knobs (roles/H/common_reward) —
         the fused-matrix path; None = static-Config specialization.
+      with_diag: (static) also return a block-summed
+        :class:`~rcmarl_tpu.faults.FaultDiag` of transport-degradation
+        counters — ``(params, diag)`` instead of ``params``.
     """
     r_coop = team_average_reward(cfg, batch.r, spec)
     k_epochs, k_actor = jax.random.split(key)
 
     def epoch(carry, ekey):
+        if with_diag:
+            return critic_tr_epoch(
+                cfg, carry, batch, r_coop, ekey, spec, with_diag=True
+            )
         return critic_tr_epoch(cfg, carry, batch, r_coop, ekey, spec), None
 
-    (critic, tr, critic_local), _ = jax.lax.scan(
+    (critic, tr, critic_local), diags = jax.lax.scan(
         epoch,
         (params.critic, params.tr, params.critic_local),
         jax.random.split(k_epochs, cfg.n_epochs),
     )
     params = params._replace(critic=critic, tr=tr, critic_local=critic_local)
     actor, actor_opt = actor_phase(cfg, params, fresh, k_actor, spec)
-    return params._replace(actor=actor, actor_opt=actor_opt)
+    params = params._replace(actor=actor, actor_opt=actor_opt)
+    if with_diag:
+        return params, sum_diags(diags)
+    return params

@@ -26,6 +26,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import zlib
 from pathlib import Path
 from typing import Optional, Tuple
 
@@ -34,7 +35,16 @@ import numpy as np
 
 from rcmarl_tpu.agents.updates import AgentParams
 from rcmarl_tpu.config import Config
+from rcmarl_tpu.faults import FaultPlan
 from rcmarl_tpu.training.trainer import TrainState, init_train_state
+
+
+class CheckpointError(ValueError):
+    """A checkpoint file is unreadable, truncated, or fails its payload
+    checksum — i.e. the FILE is bad, as opposed to a structure/shape
+    mismatch against the caller's config (plain ``ValueError``). Resume
+    paths catch exactly this to fall back to the previous good
+    checkpoint (:func:`load_checkpoint_with_fallback`)."""
 
 
 # --------------------------------------------------------------------------
@@ -51,16 +61,39 @@ def config_from_json(s: str) -> Config:
     d["agent_roles"] = tuple(d["agent_roles"])
     d["in_nodes"] = tuple(tuple(n) for n in d["in_nodes"])
     d["hidden"] = tuple(d["hidden"])
+    # dataclasses.asdict recursed into the nested FaultPlan dataclass;
+    # rebuild it (absent in pre-fault checkpoints: default None).
+    if d.get("fault_plan") is not None:
+        d["fault_plan"] = FaultPlan(**d["fault_plan"])
     return Config(**d)
 
 
+def _payload_checksum(arrays: dict) -> np.uint32:
+    """CRC32 over every array's dtype/shape/bytes in key order — cheap
+    (~GB/s) and catches the silent-corruption cases that matter
+    (truncated writes, bit rot, partial copies). The ``__checksum__``
+    entry itself is excluded."""
+    crc = 0
+    for k in sorted(arrays):
+        if k == "__checksum__":
+            continue
+        a = np.ascontiguousarray(arrays[k])
+        crc = zlib.crc32(f"{k}:{a.dtype.str}:{a.shape}:".encode(), crc)
+        crc = zlib.crc32(a.tobytes(), crc)
+    return np.uint32(crc & 0xFFFFFFFF)
+
+
 def save_checkpoint(path, state: TrainState, cfg: Config) -> None:
-    """Write the full TrainState to ``path`` (.npz) with a Config header."""
+    """Write the full TrainState to ``path`` (.npz) with a Config header
+    and a payload checksum (verified by :func:`load_checkpoint`). The
+    previous checkpoint at ``path``, if any, is rotated to
+    ``<path>.prev`` so resume paths always have a fallback."""
     leaves = jax.tree.leaves(state)
     arrays = {f"leaf_{i:03d}": np.asarray(l) for i, l in enumerate(leaves)}
     arrays["__config__"] = np.frombuffer(
         _config_to_json(cfg).encode(), dtype=np.uint8
     )
+    arrays["__checksum__"] = np.asarray([_payload_checksum(arrays)])
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     # Write-then-rename so a crash mid-write can't destroy the previous
@@ -68,6 +101,23 @@ def save_checkpoint(path, state: TrainState, cfg: Config) -> None:
     tmp = path.with_suffix(path.suffix + ".tmp")
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
+    if path.exists():
+        # Rotate the current file to <path>.prev WITHOUT ever unlinking
+        # the primary: hardlink (or copy) it, then atomically replace.
+        # Every crash window leaves a loadable primary — the invariant
+        # the plain write-then-rename had, which a rename-based rotation
+        # would break (kill between the two renames = no primary file).
+        prev = Path(str(path) + ".prev")
+        try:
+            os.unlink(prev)
+        except FileNotFoundError:
+            pass
+        try:
+            os.link(path, prev)
+        except OSError:  # cross-device/filesystem without hardlinks
+            import shutil
+
+            shutil.copy2(path, prev)
     os.replace(tmp, path)
 
 
@@ -79,9 +129,48 @@ def load_checkpoint(path, cfg: Optional[Config] = None) -> Tuple[TrainState, Con
     Config is used. The returned Config is always the STORED one, so
     callers can detect hyperparameter drift between the checkpointed run
     and their active config.
+
+    Raises :class:`CheckpointError` when the file is unreadable,
+    truncated, or fails its payload checksum (a bad FILE — resume via
+    :func:`load_checkpoint_with_fallback` to fall back to ``.prev``),
+    and plain ``ValueError`` on a structure/shape mismatch against
+    ``cfg`` (a bad CONFIG).
     """
-    with np.load(path) as z:
-        stored_cfg = config_from_json(bytes(z["__config__"]).decode())
+    try:
+        z = np.load(path)
+    except FileNotFoundError:
+        # A missing file is a caller error (typo'd path), not a corrupted
+        # checkpoint — keep it distinguishable and outside the .prev
+        # fallback, which would otherwise silently resume older state.
+        raise
+    except Exception as e:  # zipfile/OSError: truncated or not an npz
+        raise CheckpointError(
+            f"checkpoint {path} is unreadable ({type(e).__name__}: {e}) — "
+            "likely truncated by an interrupted write; resume from the "
+            "rotated <path>.prev fallback"
+        ) from None
+    with z:
+        try:
+            arrays = {k: z[k] for k in z.files}
+        except Exception as e:  # per-member decompression failure
+            raise CheckpointError(
+                f"checkpoint {path} is corrupted ({type(e).__name__}: {e})"
+            ) from None
+        if "__checksum__" in arrays:
+            want = np.uint32(arrays["__checksum__"][0])
+            got = _payload_checksum(arrays)
+            if want != got:
+                raise CheckpointError(
+                    f"checkpoint {path} failed its payload checksum "
+                    f"(stored {int(want):#010x}, recomputed {int(got):#010x})"
+                    " — the file is corrupted; resume from <path>.prev"
+                )
+        # (pre-checksum checkpoints load unverified, for compatibility)
+        if "__config__" not in arrays:
+            raise CheckpointError(
+                f"checkpoint {path} has no __config__ header"
+            )
+        stored_cfg = config_from_json(bytes(arrays["__config__"]).decode())
         if cfg is None:
             cfg = stored_cfg
         template = jax.eval_shape(
@@ -89,13 +178,13 @@ def load_checkpoint(path, cfg: Optional[Config] = None) -> Tuple[TrainState, Con
         )
         t_leaves, treedef = jax.tree.flatten(template)
         keys = [f"leaf_{i:03d}" for i in range(len(t_leaves))]
-        missing = [k for k in keys if k not in z]
+        missing = [k for k in keys if k not in arrays]
         if missing:
             raise ValueError(
                 f"checkpoint {path} does not match config structure: "
                 f"missing {missing[:3]}... ({len(missing)} leaves)"
             )
-        leaves = [z[k] for k in keys]
+        leaves = [arrays[k] for k in keys]
         for k, leaf, tmpl in zip(keys, leaves, t_leaves):
             if tuple(leaf.shape) != tuple(tmpl.shape):
                 raise ValueError(
@@ -103,6 +192,31 @@ def load_checkpoint(path, cfg: Optional[Config] = None) -> Tuple[TrainState, Con
                     f"config expects {tmpl.shape}"
                 )
     return jax.tree.unflatten(treedef, leaves), stored_cfg
+
+
+def load_checkpoint_with_fallback(
+    path, cfg: Optional[Config] = None
+) -> Tuple[TrainState, Config, Path]:
+    """:func:`load_checkpoint`, falling back to the rotated
+    ``<path>.prev`` when the primary file is corrupted/truncated
+    (:class:`CheckpointError` only — a structure mismatch would fail on
+    the fallback too, and should stay loud). Returns
+    ``(state, stored_cfg, actually_loaded_path)`` so callers can report
+    which file served the resume; re-raises the PRIMARY error when no
+    fallback exists or the fallback is bad too."""
+    path = Path(path)
+    try:
+        state, stored = load_checkpoint(path, cfg)
+        return state, stored, path
+    except CheckpointError as primary_err:
+        prev = Path(str(path) + ".prev")
+        if not prev.exists():
+            raise
+        try:
+            state, stored = load_checkpoint(prev, cfg)
+        except CheckpointError:
+            raise primary_err from None
+        return state, stored, prev
 
 
 # --------------------------------------------------------------------------

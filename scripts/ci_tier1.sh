@@ -36,4 +36,21 @@ if grep -aq "ERROR collecting\|errors during collection" /tmp/_t1.log; then
     echo "collection errors detected" >&2
     exit 1
 fi
-exit "$rc"
+if [ "$rc" -ne 0 ]; then
+    exit "$rc"
+fi
+
+# Fault-injection smoke cell (kept tiny to stay inside the tier-1 time
+# budget: 3 agents, 3x3 grid, 2 blocks): a drop+NaN transport plan with
+# the sanitize kernel and the rollback guard must complete rc=0 with
+# finite parameters — the end-to-end wire-up of rcmarl_tpu.faults that
+# unit tests can't cover (CLI flag plumbing -> Config -> update block ->
+# guard -> checkpoint with a FaultPlan header).
+smoke_dir=$(mktemp -d)
+trap 'rm -rf "$smoke_dir"' EXIT
+timeout -k 10 180 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
+    --n_agents 3 --in_degree 3 --nrow 3 --ncol 3 \
+    --n_episodes 4 --n_ep_fixed 2 --max_ep_len 4 --n_epochs 2 --H 1 \
+    --fault_drop_p 0.2 --fault_nan_p 0.2 --sanitize \
+    --summary_dir "$smoke_dir" --quiet
+echo "fault-injection smoke cell OK"

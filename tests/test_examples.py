@@ -58,6 +58,9 @@ def test_reference_program():
 def test_resilience_demo():
     out = _run("resilience_demo.py", timeout=900)
     assert "attack cost without defense" in out
+    # part 2: transport faults — unsanitized poisons, sanitized survives
+    assert "unsanitized params finite: False" in out
+    assert "sanitized  params finite: True" in out
 
 
 @pytest.mark.slow

@@ -1,0 +1,117 @@
+"""Property-based sanitize-mode contracts (hypothesis).
+
+Randomized twin of tests/test_faults.py's deterministic matrix: over
+arbitrary f32 inputs with arbitrary NaN/±Inf poisoning patterns, the
+sanitized aggregate must (a) bitwise-agree across every backend,
+(b) stay inside the surviving finite values' range whenever enough of
+them exist, and (c) fall back to the own value under a degree deficit.
+Guarded like the other property modules: a missing hypothesis (the
+`test` extra) is a skip, never a collection error.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+pytest.importorskip("hypothesis")
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+from hypothesis.extra.numpy import arrays
+
+from rcmarl_tpu.ops.aggregation import resilient_aggregate
+from rcmarl_tpu.ops.pallas_aggregation import fused_resilient_aggregate
+
+finite = st.floats(-1e6, 1e6, allow_nan=False, width=32)
+
+
+@st.composite
+def poisoned_vals_and_h(draw, min_n=3, max_n=8, m=4):
+    """(values, H) with a random subset of elements replaced by a random
+    choice of NaN/+Inf/-Inf (possibly none, possibly all non-self)."""
+    n = draw(st.integers(min_n, max_n))
+    H = draw(st.integers(0, (n - 1) // 2))
+    vals = draw(arrays(np.float32, (n, m), elements=finite))
+    poison = draw(arrays(np.int8, (n, m), elements=st.integers(0, 3)))
+    bombs = np.asarray([0.0, np.nan, np.inf, -np.inf], np.float32)
+    vals = np.where(poison > 0, bombs[poison], vals)
+    return vals, H
+
+
+@settings(max_examples=40, deadline=None)
+@given(poisoned_vals_and_h())
+def test_sanitized_backends_agree_bitwise(case):
+    vals, H = case
+    v = jnp.asarray(vals)
+    outs = [
+        resilient_aggregate(v, H, impl="xla", sanitize=True),
+        resilient_aggregate(v, H, impl="xla_sort", sanitize=True),
+        resilient_aggregate(
+            v, H, impl="xla", valid=jnp.ones(v.shape[0]), sanitize=True
+        ),
+        jax.jit(
+            lambda x, h: resilient_aggregate(x, h, impl="xla", sanitize=True)
+        )(v, jnp.int32(H)),
+        fused_resilient_aggregate(
+            v, H, variant="select", interpret=True, sanitize=True
+        ),
+        fused_resilient_aggregate(
+            v, H, variant="sort", interpret=True, sanitize=True
+        ),
+    ]
+    base = np.asarray(outs[0])
+    for out in outs[1:]:
+        np.testing.assert_array_equal(base, np.asarray(out), err_msg=f"H={H}")
+
+
+@settings(max_examples=40, deadline=None)
+@given(poisoned_vals_and_h())
+def test_sanitized_output_bounded_or_own(case):
+    """Elementwise: with >= 2H+1 finite survivors the aggregate is
+    finite and inside their range; otherwise it IS the own value
+    (bitwise, including a non-finite own value)."""
+    vals, H = case
+    out = np.asarray(resilient_aggregate(jnp.asarray(vals), H, sanitize=True))
+    fin = np.isfinite(vals)
+    count = fin.sum(axis=0)
+    for c in range(vals.shape[1]):
+        if count[c] >= 2 * H + 1:
+            col = vals[fin[:, c], c]
+            assert np.isfinite(out[c])
+            assert col.min() - 1e-4 <= out[c] <= col.max() + 1e-4
+        else:
+            np.testing.assert_array_equal(out[c], vals[0, c])
+
+
+@settings(max_examples=25, deadline=None)
+@given(poisoned_vals_and_h(), st.integers(1, 3))
+def test_masked_sanitize_ignores_pad_garbage(case, pad):
+    """Appending pad slots full of garbage (finite or not) to a
+    sanitized masked aggregate changes nothing."""
+    vals, H = case
+    n = vals.shape[0]
+    padded = np.concatenate(
+        [vals, np.full((pad, vals.shape[1]), np.inf, np.float32)], axis=0
+    )
+    valid = jnp.asarray([1.0] * n + [0.0] * pad)
+    a = resilient_aggregate(
+        jnp.asarray(padded), H, valid=valid, sanitize=True
+    )
+    b = resilient_aggregate(
+        jnp.asarray(vals), H, valid=jnp.ones(n), sanitize=True
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@settings(max_examples=25, deadline=None)
+@given(arrays(np.float32, (5, 3), elements=finite))
+def test_clean_inputs_unchanged_by_sanitize(vals):
+    """On all-finite inputs sanitize is semantically the plain kernel."""
+    v = jnp.asarray(vals)
+    np.testing.assert_allclose(
+        np.asarray(resilient_aggregate(v, 1, sanitize=True)),
+        np.asarray(resilient_aggregate(v, 1)),
+        rtol=1e-6,
+        atol=1e-6,
+    )

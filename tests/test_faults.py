@@ -1,0 +1,601 @@
+"""Transport-fault injection + non-finite-hardened consensus + guard rails.
+
+Covers ISSUE 2's robustness surface end to end:
+
+- sanitize mode: NaN/±Inf-poisoned neighbor blocks produce BITWISE-
+  identical finite aggregates across all six impls (xla, xla_sort,
+  masked, traced-H, pallas select, pallas sort) and equal the
+  mask-excluded reference; degree deficits fall back to the own value.
+- the unguarded seed behavior — one NaN bomb poisons every backend —
+  is pinned as a regression test (the failure mode the subsystem
+  defends against must stay reproducible).
+- FaultPlan semantics: per-link draws shared across leaves, self slot
+  exempt, stage composition, determinism, inactive-plan identity.
+- trainer guard rails: injected-fault runs complete with finite params
+  via rollback/retry/skip; sanitize keeps the run healthy with
+  degradation counters instead.
+- checkpoint integrity: payload checksum, corruption/truncation
+  detection, rotation + fallback resume.
+- sweep per-cell fault isolation (one failing cell is retried, then
+  recorded and skipped).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+from rcmarl_tpu.faults import (
+    FaultPlan,
+    apply_link_faults,
+    fault_diagnostics,
+    tree_all_finite,
+)
+from rcmarl_tpu.ops.aggregation import resilient_aggregate
+from rcmarl_tpu.ops.pallas_aggregation import fused_resilient_aggregate
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        n_agents=3,
+        agent_roles=(Roles.COOPERATIVE,) * 3,
+        in_nodes=circulant_in_nodes(3, 3),
+        nrow=3,
+        ncol=3,
+        max_ep_len=4,
+        n_ep_fixed=2,
+        n_epochs=2,
+        buffer_size=16,
+        hidden=(8, 8),
+        coop_fit_steps=2,
+        adv_fit_epochs=1,
+        adv_fit_batch=4,
+        batch_size=4,
+        n_episodes=4,
+        H=1,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def params_finite(state) -> bool:
+    return all(
+        np.all(np.isfinite(np.asarray(l)))
+        for l in jax.tree.leaves(state.params)
+    )
+
+
+def poisoned_block(seed=0, n_in=7, m=23):
+    """A neighbor block with two whole-row bombs and scattered
+    element-level non-finites; returns (values, finite_row_indices,
+    clean_column_indices)."""
+    rng = np.random.default_rng(seed)
+    vals = rng.normal(size=(n_in, m)).astype(np.float32)
+    r_nan, r_inf = 2, n_in - 2
+    vals[r_nan] = np.nan
+    vals[r_inf] = np.inf
+    c1, c2 = min(3, m - 1), m - 1
+    vals[1, c1] = -np.inf
+    vals[n_in - 1, c2] = np.nan
+    keep = [i for i in range(n_in) if i not in (r_nan, r_inf)]
+    clean = [c for c in range(m) if c not in (c1, c2)]
+    return jnp.asarray(vals), keep, clean
+
+
+def six_impl_outputs(v, H):
+    """The sanitized aggregate by every backend (static, masked,
+    traced-H, and both Pallas kernel variants in interpret mode)."""
+    n_in = v.shape[0]
+    ones = jnp.ones((n_in,))
+    return {
+        "xla": resilient_aggregate(v, H, impl="xla", sanitize=True),
+        "xla_sort": resilient_aggregate(v, H, impl="xla_sort", sanitize=True),
+        "masked": resilient_aggregate(
+            v, H, impl="xla", valid=ones, sanitize=True
+        ),
+        "masked_sort": resilient_aggregate(
+            v, H, impl="xla_sort", valid=ones, sanitize=True
+        ),
+        "traced": jax.jit(
+            lambda x, h: resilient_aggregate(x, h, impl="xla", sanitize=True)
+        )(v, jnp.int32(H)),
+        "traced_sort": jax.jit(
+            lambda x, h: resilient_aggregate(
+                x, h, impl="xla_sort", sanitize=True
+            )
+        )(v, jnp.int32(H)),
+        "pallas": fused_resilient_aggregate(
+            v, H, variant="select", interpret=True, sanitize=True
+        ),
+        "pallas_sort": fused_resilient_aggregate(
+            v, H, variant="sort", interpret=True, sanitize=True
+        ),
+    }
+
+
+class TestSanitizedAggregation:
+    def test_unsanitized_nan_poisons_every_backend(self):
+        """The seed behavior this subsystem exists for: WITHOUT sanitize,
+        a single NaN payload poisons the trim bounds and the clipped
+        mean of every backend (regression pin)."""
+        rng = np.random.default_rng(3)
+        vals = rng.normal(size=(5, 4)).astype(np.float32)
+        vals[2, 1] = np.nan
+        v = jnp.asarray(vals)
+        for out in [
+            resilient_aggregate(v, 1, impl="xla"),
+            resilient_aggregate(v, 1, impl="xla_sort"),
+            fused_resilient_aggregate(v, 1, variant="select", interpret=True),
+            fused_resilient_aggregate(v, 1, variant="sort", interpret=True),
+        ]:
+            assert not np.isfinite(np.asarray(out)[1])
+
+    @pytest.mark.parametrize("H", [0, 1, 2])
+    def test_bitwise_cross_backend_agreement(self, H):
+        """Acceptance criterion: with NaN/Inf payloads active, all
+        sanitized backends produce IDENTICAL finite aggregates."""
+        v, _, _ = poisoned_block(seed=10 + H)
+        outs = six_impl_outputs(v, H)
+        base = np.asarray(outs["xla"])
+        assert np.all(np.isfinite(base))
+        for name, out in outs.items():
+            np.testing.assert_array_equal(
+                base, np.asarray(out), err_msg=f"impl {name} diverges"
+            )
+
+    def test_whole_row_bombs_equal_mask_excluded_reference(self):
+        """Sanitizing whole-row bombs == aggregating only the surviving
+        rows with the plain kernel (the semantics contract)."""
+        v, keep, clean = poisoned_block(seed=2)
+        for H in (0, 1, 2):
+            out = np.asarray(resilient_aggregate(v, H, sanitize=True))
+            # columns with element-level poison differ from the row-level
+            # reference; compare on the clean columns only
+            ref = resilient_aggregate(v[jnp.asarray(keep)], H)
+            np.testing.assert_allclose(
+                out[np.asarray(clean)],
+                np.asarray(ref)[np.asarray(clean)],
+                rtol=1e-5,
+                atol=1e-6,
+            )
+
+    def test_elementwise_exclusion(self):
+        """A single poisoned ELEMENT only affects its own column, which
+        then equals the reference over that column's finite entries."""
+        rng = np.random.default_rng(7)
+        vals = rng.normal(size=(6, 5)).astype(np.float32)
+        vals[3, 2] = np.inf
+        v = jnp.asarray(vals)
+        out = np.asarray(resilient_aggregate(v, 1, sanitize=True))
+        clean = np.asarray(resilient_aggregate(jnp.asarray(vals), 1, sanitize=False))
+        # unpoisoned columns: sanitize == plain kernel up to mean-order
+        for c in (0, 1, 3, 4):
+            np.testing.assert_allclose(out[c], clean[c], rtol=1e-5, atol=1e-6)
+        # poisoned column: equals the 5-surviving-entry reference
+        keep = jnp.asarray([0, 1, 2, 4, 5])
+        ref = resilient_aggregate(v[keep][:, 2:3], 1)
+        np.testing.assert_allclose(out[2], np.asarray(ref)[0], rtol=1e-5, atol=1e-6)
+
+    def test_degree_deficit_keeps_own_value(self):
+        """Fewer than 2H+1 finite survivors -> the agent keeps its own
+        value instead of undefined clipping."""
+        vals = np.full((4, 3), np.nan, np.float32)
+        vals[0] = [1.0, 2.0, 3.0]
+        vals[1] = [5.0, 6.0, 7.0]  # 2 finite < 2H+1 = 3
+        out = resilient_aggregate(jnp.asarray(vals), 1, sanitize=True)
+        np.testing.assert_array_equal(np.asarray(out), vals[0])
+
+    def test_all_neighbors_poisoned_keeps_own_value(self):
+        vals = np.full((5, 2), np.inf, np.float32)
+        vals[0] = [3.0, -4.0]
+        out = resilient_aggregate(jnp.asarray(vals), 2, sanitize=True)
+        np.testing.assert_array_equal(np.asarray(out), vals[0])
+
+    def test_h0_sanitize_is_finite_mean(self):
+        rng = np.random.default_rng(11)
+        vals = rng.normal(size=(5, 6)).astype(np.float32)
+        vals[2, 0] = np.nan
+        vals[4] = np.inf
+        out = resilient_aggregate(jnp.asarray(vals), 0, sanitize=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.nanmean(np.where(np.isfinite(vals), vals, np.nan), axis=0),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+
+    def test_masked_sanitize_excludes_pads_and_bombs(self):
+        """valid-mask exclusion (padded ragged graphs) composes with
+        finite exclusion: pad garbage AND bombs both drop out."""
+        rng = np.random.default_rng(13)
+        vals = rng.normal(size=(7, 4)).astype(np.float32)
+        vals[2] = np.nan  # bomb inside the valid region
+        vals[5] = 1e9  # pad garbage (finite but invalid)
+        vals[6] = -np.inf  # pad garbage (non-finite)
+        valid = jnp.asarray([1.0, 1.0, 1.0, 1.0, 1.0, 0.0, 0.0])
+        out = resilient_aggregate(
+            jnp.asarray(vals), 1, valid=valid, sanitize=True
+        )
+        ref = resilient_aggregate(jnp.asarray(vals[[0, 1, 3, 4]]), 1)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6
+        )
+
+    def test_clean_inputs_sanitize_matches_plain(self):
+        """On all-finite inputs the sanitized aggregate equals the plain
+        kernel (same bounds, mean over all n_in entries)."""
+        rng = np.random.default_rng(17)
+        v = jnp.asarray(rng.normal(size=(5, 9)).astype(np.float32))
+        for H in (0, 1, 2):
+            np.testing.assert_allclose(
+                np.asarray(resilient_aggregate(v, H, sanitize=True)),
+                np.asarray(resilient_aggregate(v, H)),
+                rtol=1e-5,
+                atol=1e-6,
+            )
+
+    def test_own_row_poisoned_recovers_from_neighbors(self):
+        """A non-finite OWN value is excluded like any other entry: with
+        enough finite neighbors the aggregate is their trimmed mean —
+        the agent can recover from its own divergence."""
+        rng = np.random.default_rng(19)
+        vals = rng.normal(size=(6, 4)).astype(np.float32)
+        vals[0] = np.nan
+        out = np.asarray(resilient_aggregate(jnp.asarray(vals), 1, sanitize=True))
+        assert np.all(np.isfinite(out))
+        fin = vals[1:]
+        assert (out >= fin.min(0) - 1e-6).all() and (out <= fin.max(0) + 1e-6).all()
+
+    def test_vmap_over_agents(self):
+        v1, _, _ = poisoned_block(seed=23, n_in=5, m=8)
+        v2, _, _ = poisoned_block(seed=29, n_in=5, m=8)
+        stacked = jnp.stack([v1, v2])
+        out = jax.vmap(
+            lambda v: resilient_aggregate(v, 1, sanitize=True)
+        )(stacked)
+        for i, v in enumerate([v1, v2]):
+            np.testing.assert_array_equal(
+                np.asarray(out[i]),
+                np.asarray(resilient_aggregate(v, 1, sanitize=True)),
+            )
+
+    def test_tree_version_sanitized(self):
+        from rcmarl_tpu.ops.aggregation import resilient_aggregate_tree
+
+        v1, _, _ = poisoned_block(seed=31, n_in=5, m=6)
+        v2, _, _ = poisoned_block(seed=37, n_in=5, m=4)
+        tree = {"a": v1, "b": v2}
+        out = resilient_aggregate_tree(tree, 1, sanitize=True)
+        for k in tree:
+            np.testing.assert_array_equal(
+                np.asarray(out[k]),
+                np.asarray(resilient_aggregate(tree[k], 1, sanitize=True)),
+            )
+
+
+class TestFaultPlan:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="drop_p"):
+            FaultPlan(drop_p=1.5)
+        with pytest.raises(ValueError, match="corrupt_scale"):
+            FaultPlan(corrupt_scale=-1.0)
+
+    def test_hashable_and_active(self):
+        assert hash(FaultPlan(drop_p=0.1)) != hash(FaultPlan(drop_p=0.2))
+        assert not FaultPlan().active
+        assert FaultPlan(nan_p=0.01).active
+        # corrupt_scale alone does not activate (no probability set)
+        assert not FaultPlan(corrupt_scale=5.0).active
+
+    def test_config_rejects_non_faultplan(self):
+        with pytest.raises(ValueError, match="FaultPlan"):
+            tiny_cfg(fault_plan={"drop_p": 0.1})
+
+    def test_config_hashable_with_plan(self):
+        cfg = tiny_cfg(fault_plan=FaultPlan(drop_p=0.1))
+        hash(cfg)  # jit-staticness requirement
+
+
+class TestApplyLinkFaults:
+    def _trees(self, key):
+        N, n_in = 4, 3
+        fresh = {
+            "W": jax.random.normal(key, (N, n_in, 2, 5)),
+            "b": jax.random.normal(jax.random.fold_in(key, 1), (N, n_in, 5)),
+        }
+        stale = jax.tree.map(lambda l: l * 100.0, fresh)
+        return fresh, stale
+
+    def test_inactive_plan_is_identity(self):
+        key = jax.random.PRNGKey(0)
+        fresh, stale = self._trees(key)
+        out = apply_link_faults(key, fresh, stale, FaultPlan())
+        for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(fresh)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_self_slot_never_faulted(self):
+        key = jax.random.PRNGKey(1)
+        fresh, stale = self._trees(key)
+        plan = FaultPlan(
+            drop_p=1.0, stale_p=1.0, corrupt_p=1.0, flip_p=1.0,
+            nan_p=1.0, inf_p=1.0,
+        )
+        out = apply_link_faults(key, fresh, stale, plan)
+        for o, f in zip(jax.tree.leaves(out), jax.tree.leaves(fresh)):
+            np.testing.assert_array_equal(
+                np.asarray(o)[:, 0], np.asarray(f)[:, 0]
+            )
+            # every non-self link carries the bomb
+            assert not np.isfinite(np.asarray(o)[:, 1:]).any()
+
+    def test_link_masks_shared_across_leaves(self):
+        key = jax.random.PRNGKey(2)
+        fresh, stale = self._trees(key)
+        out = apply_link_faults(key, fresh, stale, FaultPlan(nan_p=0.5))
+        bad_W = ~np.isfinite(np.asarray(out["W"])).all(axis=(2, 3))
+        bad_b = ~np.isfinite(np.asarray(out["b"])).all(axis=2)
+        assert np.array_equal(bad_W, bad_b)
+        assert bad_W.any()
+
+    def test_stale_replay_uses_stale_payload(self):
+        key = jax.random.PRNGKey(3)
+        fresh, stale = self._trees(key)
+        out = apply_link_faults(key, fresh, stale, FaultPlan(stale_p=0.6))
+        W, Wf, Ws = (np.asarray(t["W"]) for t in (out, fresh, stale))
+        is_stale = np.isclose(W, Ws).all(axis=(2, 3))
+        is_fresh = np.isclose(W, Wf).all(axis=(2, 3))
+        assert (is_stale | is_fresh).all()
+        assert is_stale.any() and is_fresh[:, 0].all()
+
+    def test_deterministic_and_seed_namespaced(self):
+        key = jax.random.PRNGKey(4)
+        fresh, stale = self._trees(key)
+
+        def leaves(plan):
+            return jax.tree.leaves(apply_link_faults(key, fresh, stale, plan))
+
+        a1 = leaves(FaultPlan(nan_p=0.5))
+        a2 = leaves(FaultPlan(nan_p=0.5))
+        b = leaves(FaultPlan(nan_p=0.5, seed=1))
+        assert all(
+            np.array_equal(np.asarray(x), np.asarray(y), equal_nan=True)
+            for x, y in zip(a1, a2)
+        )
+        assert not all(
+            np.array_equal(np.asarray(x), np.asarray(y), equal_nan=True)
+            for x, y in zip(a1, b)
+        )
+
+    def test_diagnostics_count_nonfinite_and_deficit(self):
+        vals = np.ones((2, 4, 3), np.float32)  # (N, n_in, P)
+        vals[0, 1] = np.nan  # 3 entries; 3 finite left per element >= 2H+1=3
+        vals[1, 1:] = np.inf  # 9 entries; 1 finite left < 3 -> 3 deficits
+        diag = fault_diagnostics({"x": jnp.asarray(vals)}, H=1)
+        assert int(diag.nonfinite) == 12
+        assert int(diag.deficit) == 3
+
+    def test_tree_all_finite(self):
+        assert bool(tree_all_finite({"a": jnp.ones(3)}))
+        assert not bool(tree_all_finite({"a": jnp.asarray([1.0, np.nan])}))
+        # int leaves don't participate
+        assert bool(tree_all_finite({"a": jnp.arange(3)}))
+
+
+class TestGuardedTraining:
+    PLAN = FaultPlan(nan_p=0.4, drop_p=0.2)
+
+    def test_unguarded_seed_behavior_poisons_params(self):
+        """Regression pin for the acceptance criterion: without sanitize
+        and without the guard, an injected NaN/drop plan destroys the
+        run's parameters."""
+        from rcmarl_tpu.training.trainer import train
+
+        cfg = tiny_cfg(fault_plan=self.PLAN)
+        state, df = train(cfg, guard=False)
+        assert not params_finite(state)
+
+    def test_guard_rolls_back_to_finite_params(self):
+        """Same plan, guard auto-on: the run completes, parameters stay
+        finite via rollback/retry/skip, and the stats record it."""
+        from rcmarl_tpu.training.trainer import train
+
+        cfg = tiny_cfg(fault_plan=self.PLAN)
+        state, df = train(cfg)
+        assert params_finite(state)
+        g = df.attrs["guard"]
+        assert g["retries"] + g["skipped"] > 0
+        assert g["nonfinite"] > 0
+        assert len(df) == cfg.n_episodes  # degraded rows recorded, not lost
+
+    def test_sanitize_absorbs_faults_without_rollback(self):
+        """With the hardened kernel the same plan degrades gracefully:
+        finite params, no skipped blocks, non-zero degradation counters."""
+        from rcmarl_tpu.training.trainer import train
+
+        cfg = tiny_cfg(fault_plan=self.PLAN, consensus_sanitize=True)
+        state, df = train(cfg)
+        assert params_finite(state)
+        g = df.attrs["guard"]
+        assert g["skipped"] == 0
+        assert g["nonfinite"] > 0
+
+    def test_clean_run_has_no_guard_overhead_and_identical_stream(self):
+        """fault_plan=None keeps the exact seed behavior: no guard attrs,
+        and bit-identical params to a run with sanitize knobs absent."""
+        from rcmarl_tpu.training.trainer import train
+
+        cfg = tiny_cfg()
+        state_a, df = train(cfg)
+        assert "guard" not in df.attrs
+        state_b, _ = train(tiny_cfg())
+        for a, b in zip(
+            jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.slow
+    def test_fused_matrix_with_faults(self):
+        """The fault transform traces under the fused-matrix path
+        (traced CellSpec, heterogeneous H) with sanitize on."""
+        from rcmarl_tpu.parallel.matrix import train_matrix
+
+        base = tiny_cfg(fault_plan=self.PLAN, consensus_sanitize=True)
+        cells = [base, base.replace(agent_roles=(0, 0, 3)), base.replace(H=0)]
+        states, metrics = train_matrix(base, cells, seeds=[0, 1], n_blocks=2)
+        assert np.asarray(metrics.true_team_returns).shape == (6, 4)
+
+
+class TestCheckpointIntegrity:
+    def _state(self, cfg):
+        from rcmarl_tpu.training.trainer import init_train_state
+
+        return init_train_state(cfg, jax.random.PRNGKey(0))
+
+    def test_checksum_roundtrip(self, tmp_path):
+        from rcmarl_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+        cfg = tiny_cfg(fault_plan=FaultPlan(drop_p=0.1), consensus_sanitize=True)
+        state = self._state(cfg)
+        p = tmp_path / "ck.npz"
+        save_checkpoint(p, state, cfg)
+        restored, rcfg = load_checkpoint(p)
+        assert rcfg == cfg  # incl. the nested FaultPlan JSON roundtrip
+        for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_corruption_detected(self, tmp_path):
+        from rcmarl_tpu.utils.checkpoint import (
+            CheckpointError,
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        cfg = tiny_cfg()
+        p = tmp_path / "ck.npz"
+        save_checkpoint(p, self._state(cfg), cfg)
+        data = bytearray(p.read_bytes())
+        data[len(data) // 2] ^= 0xFF
+        p.write_bytes(bytes(data))
+        with pytest.raises(CheckpointError):
+            load_checkpoint(p)
+
+    def test_truncation_detected(self, tmp_path):
+        from rcmarl_tpu.utils.checkpoint import (
+            CheckpointError,
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        cfg = tiny_cfg()
+        p = tmp_path / "ck.npz"
+        save_checkpoint(p, self._state(cfg), cfg)
+        p.write_bytes(p.read_bytes()[:200])
+        with pytest.raises(CheckpointError, match="unreadable"):
+            load_checkpoint(p)
+
+    def test_rotation_and_fallback(self, tmp_path):
+        from rcmarl_tpu.utils.checkpoint import (
+            load_checkpoint_with_fallback,
+            save_checkpoint,
+        )
+
+        cfg = tiny_cfg()
+        s1 = self._state(cfg)
+        s2 = jax.tree.map(lambda l: l, s1)._replace(block=s1.block + 1)
+        p = tmp_path / "ck.npz"
+        save_checkpoint(p, s1, cfg)
+        save_checkpoint(p, s2, cfg)  # rotates s1 -> ck.npz.prev
+        assert (tmp_path / "ck.npz.prev").exists()
+        data = bytearray(p.read_bytes())
+        data[len(data) // 2] ^= 0xFF
+        p.write_bytes(bytes(data))
+        state, _, loaded = load_checkpoint_with_fallback(p)
+        assert loaded == tmp_path / "ck.npz.prev"
+        assert int(state.block) == int(s1.block)
+
+    def test_fallback_reraises_without_prev(self, tmp_path):
+        from rcmarl_tpu.utils.checkpoint import (
+            CheckpointError,
+            load_checkpoint_with_fallback,
+        )
+
+        p = tmp_path / "nope.npz"
+        p.write_bytes(b"not a zip at all")
+        with pytest.raises(CheckpointError):
+            load_checkpoint_with_fallback(p)
+
+
+class TestSweepIsolation:
+    def test_one_failing_cell_does_not_abort_matrix(self, tmp_path, monkeypatch):
+        """`sweep` retries a failing cell once, records it, skips it, and
+        still completes (and writes) every other cell; rc is nonzero so
+        drivers see the matrix is incomplete."""
+        import rcmarl_tpu.parallel.seeds as seeds_mod
+        from rcmarl_tpu.cli import main
+
+        real = seeds_mod.train_parallel
+        calls = []
+
+        def flaky(cfg, *a, **kw):
+            roles = set(cfg.agent_roles)
+            calls.append(tuple(cfg.agent_roles))
+            if Roles.GREEDY in roles:
+                raise RuntimeError("injected cell failure")
+            return real(cfg, *a, **kw)
+
+        monkeypatch.setattr(seeds_mod, "train_parallel", flaky)
+        rc = main(
+            [
+                "sweep",
+                "--scenarios", "coop", "greedy",
+                "--H", "0",
+                "--seeds", "0",
+                "--n_episodes", "2",
+                "--n_ep_fixed", "2",
+                "--max_ep_len", "4",
+                "--n_epochs", "1",
+                "--buffer_size", "8",
+                "--out", str(tmp_path),
+            ]
+        )
+        assert rc == 1
+        # the healthy cell's artifact exists; the failed one was retried
+        assert (tmp_path / "coop" / "H=0" / "seed=0" / "sim_data1.pkl").exists()
+        assert not (tmp_path / "greedy" / "H=0" / "seed=0" / "sim_data1.pkl").exists()
+        greedy_calls = [c for c in calls if Roles.GREEDY in set(c)]
+        assert len(greedy_calls) == 2  # initial + one retry
+
+    def test_nonfinite_cell_recorded_not_written(self, tmp_path, monkeypatch):
+        """The sweep-side guard rail: a cell whose metrics go non-finite
+        (fault plan without --sanitize — no host loop to roll back in)
+        is recorded and skipped WITHOUT retry (deterministic in its
+        seeds) and its corrupt sim_data is never written; rc=1."""
+        import rcmarl_tpu.parallel.seeds as seeds_mod
+        from rcmarl_tpu.cli import main
+
+        calls = []
+        real = seeds_mod.train_parallel
+
+        def counting(cfg, *a, **kw):
+            calls.append(1)
+            return real(cfg, *a, **kw)
+
+        monkeypatch.setattr(seeds_mod, "train_parallel", counting)
+        rc = main(
+            [
+                "sweep",
+                "--scenarios", "coop",
+                "--H", "0",
+                "--seeds", "0",
+                "--n_episodes", "2",
+                "--n_ep_fixed", "2",
+                "--max_ep_len", "4",
+                "--n_epochs", "1",
+                "--buffer_size", "8",
+                "--fault_nan_p", "0.9",  # no --sanitize: poisons params
+                "--out", str(tmp_path),
+            ]
+        )
+        assert rc == 1
+        assert not (tmp_path / "coop" / "H=0" / "seed=0" / "sim_data1.pkl").exists()
+        assert len(calls) == 1  # _CellUnhealthy skips the crash-retry
