@@ -72,11 +72,12 @@ def _measure(n_seeds: int, n_blocks: int, reps: int) -> None:
     from rcmarl_tpu.training import train_scanned
 
     # Published-run hyperparameters (job.sh: slow_lr=0.002; BASELINE.md).
-    # consensus_impl stays the Config default ('xla' = dual top-(H+1)
-    # selection bounds since round 6 — bitwise-equal to the old full
-    # sort, so headline numbers remain trajectory-comparable across
-    # rounds; the sort-vs-select A/B arms live in `python -m rcmarl_tpu
-    # bench/profile --impl xla xla_sort pallas pallas_sort`).
+    # consensus_impl stays the Config default ('xla' = selection bounds
+    # since round 6, log-depth tournament on the flattened one-launch
+    # tree layout since round 7 — bitwise-equal to the old full sort, so
+    # headline numbers remain trajectory-comparable across rounds; the
+    # sort-vs-select A/B arms live in `python -m rcmarl_tpu bench/profile
+    # --impl xla xla_sort pallas pallas_sort [--layout flat per_leaf]`).
     cfg = Config(slow_lr=0.002, fast_lr=0.01, seed=100)
 
     def fetch(states, metrics):

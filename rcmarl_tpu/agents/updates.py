@@ -257,7 +257,11 @@ def consensus_update_one(
     # non-finite neighbor payloads (transport faults, diverged peers):
     # bombs become exclusions, degree deficits keep the own value.
     sanitize = cfg.consensus_sanitize
-    # b) hidden-layer consensus over trunk arrays
+    # b) hidden-layer consensus over trunk arrays: under the default
+    # cfg.consensus_layout='flat' the whole trunk tree is raveled into
+    # ONE (n_in, P_total) block, so the epoch issues a single
+    # select/clip/mean op sequence per message tree instead of one per
+    # weight array (bitwise identical either way).
     trunk_agg = resilient_aggregate_tree(
         tuple(nbr_msgs[i] for i in range(n_trunk)),
         H,
@@ -265,6 +269,7 @@ def consensus_update_one(
         valid=valid,
         n_agents=cfg.n_agents,
         sanitize=sanitize,
+        layout=cfg.consensus_layout,
     )
     new_params: MLPParams = tuple(trunk_agg) + (own[-1],)
     # c) projection: phi with aggregated trunk, all neighbor heads at once

@@ -117,6 +117,16 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         "3-way crossover (ops/aggregation.py)",
     )
     p.add_argument(
+        "--consensus_layout",
+        type=str,
+        default="flat",
+        choices=["flat", "per_leaf"],
+        help="consensus message-tree layout: flat = every leaf raveled "
+        "into one (n_in, P_total) launch per tree (default), per_leaf = "
+        "the historical leaf-by-leaf dispatch (comparison arm); bitwise "
+        "identical outputs",
+    )
+    p.add_argument(
         "--compute_dtype",
         type=str,
         default="float32",
@@ -226,6 +236,7 @@ def config_from_args(args) -> Config:
         reference_clip=args.reference_clip,
         seed=getattr(args, "random_seed", 300),
         consensus_impl=args.consensus_impl,
+        consensus_layout=getattr(args, "consensus_layout", "flat"),
         compute_dtype=args.compute_dtype,
         fault_plan=fault_plan_from_args(args),
         consensus_sanitize=args.sanitize,
@@ -786,7 +797,11 @@ def _emit(line: str, out_path: str | None, *, err: bool = False) -> None:
 
 
 def _bench_config(
-    name: str, impl: str, n_ep_fixed: int, compute_dtype: str = "float32"
+    name: str,
+    impl: str,
+    n_ep_fixed: int,
+    compute_dtype: str = "float32",
+    layout: str = "flat",
 ) -> Config:
     spec = BENCH_CONFIGS[name]
     n = spec["n_agents"]
@@ -807,6 +822,7 @@ def _bench_config(
         n_ep_fixed=n_ep_fixed,
         slow_lr=0.002,
         consensus_impl=impl,
+        consensus_layout=layout,
         compute_dtype=compute_dtype,
     )
 
@@ -833,6 +849,15 @@ def cmd_bench(argv) -> int:
     p.add_argument("--n_ep_fixed", type=int, default=10)
     p.add_argument("--blocks", type=int, default=3, help="timed blocks per rep")
     p.add_argument("--reps", type=int, default=3)
+    p.add_argument(
+        "--layout",
+        nargs="+",
+        default=["flat"],
+        choices=["flat", "per_leaf"],
+        help="consensus message-tree layout(s) to compare: flat = one "
+        "raveled (n_in, P_total) launch per tree, per_leaf = historical "
+        "leaf-by-leaf dispatch (bitwise-identical comparison arm)",
+    )
     p.add_argument(
         "--shard_agents",
         nargs="+",
@@ -872,10 +897,10 @@ def cmd_bench(argv) -> int:
 
     shard_modes = [None] if args.shard_agents is None else args.shard_agents
     n_failed = 0
-    for name, dtype, impl, shard in itertools.product(
-        args.configs, args.compute_dtype, args.impl, shard_modes
+    for name, dtype, impl, layout, shard in itertools.product(
+        args.configs, args.compute_dtype, args.impl, args.layout, shard_modes
     ):
-        cfg = _bench_config(name, impl, args.n_ep_fixed, dtype)
+        cfg = _bench_config(name, impl, args.n_ep_fixed, dtype, layout)
         if shard is None:
             state = init_train_state(cfg, jax.random.PRNGKey(0))
             run = jax.jit(
@@ -934,6 +959,7 @@ def cmd_bench(argv) -> int:
                 "config": name,
                 "impl": impl,
                 "impl_resolved": resolve_impl(impl, cfg.n_in, n_agents=cfg.n_agents, H=cfg.H),
+                "layout": cfg.consensus_layout,
                 "compute_dtype": cfg.compute_dtype,
                 "n_agents": cfg.n_agents,
                 "n_in": cfg.n_in,
@@ -996,6 +1022,23 @@ def cmd_profile(argv) -> int:
         choices=["float32", "bfloat16"],
         help="matmul compute precision(s) to profile",
     )
+    p.add_argument(
+        "--layout",
+        nargs="+",
+        default=["flat"],
+        choices=["flat", "per_leaf"],
+        help="consensus message-tree layout(s) to profile (flat = one "
+        "raveled launch per tree; per_leaf = comparison arm)",
+    )
+    p.add_argument(
+        "--consensus_micro",
+        action="store_true",
+        help="additionally emit a consensus micro-breakdown row per cell "
+        "(gather vs trim-bounds vs clip/mean vs phase-I fits, "
+        "utils/profiling.py:profile_consensus) tagged with n_in/H/"
+        "gathered volume — the component-level rows crossover refits "
+        "(SELECT_MAX_N_IN, PALLAS_CROSSOVER_VOLUME) key on",
+    )
     p.add_argument("--n_ep_fixed", type=int, default=10)
     p.add_argument("--reps", type=int, default=3)
     p.add_argument(
@@ -1011,20 +1054,30 @@ def cmd_profile(argv) -> int:
     import jax
 
     from rcmarl_tpu.ops.aggregation import resolve_impl
-    from rcmarl_tpu.utils.profiling import profile_phases
+    from rcmarl_tpu.utils.profiling import (
+        consensus_tags,
+        profile_consensus,
+        profile_phases,
+    )
 
     n_failed = 0
-    for name, dtype, impl in itertools.product(
-        args.configs, args.compute_dtype, args.impl
+    for name, dtype, impl, layout in itertools.product(
+        args.configs, args.compute_dtype, args.impl, args.layout
     ):
-        cfg = _bench_config(name, impl, args.n_ep_fixed, dtype)
+        cfg = _bench_config(name, impl, args.n_ep_fixed, dtype, layout)
         try:
             phases = profile_phases(cfg, reps=args.reps)
+            micro = (
+                profile_consensus(cfg, reps=args.reps)
+                if args.consensus_micro
+                else None
+            )
         except Exception as e:  # noqa: BLE001 — same fault isolation as bench
             err = json.dumps(
                 {
                     "config": name,
                     "impl": impl,
+                    "layout": layout,
                     "compute_dtype": dtype,
                     "error": f"{type(e).__name__}: {e}"[:300],
                 }
@@ -1046,6 +1099,7 @@ def cmd_profile(argv) -> int:
                 "config": name,
                 "impl": impl,
                 "impl_resolved": resolve_impl(impl, cfg.n_in, n_agents=cfg.n_agents, H=cfg.H),
+                "layout": cfg.consensus_layout,
                 "compute_dtype": cfg.compute_dtype,
                 "n_agents": cfg.n_agents,
                 "hidden": list(cfg.hidden),
@@ -1067,6 +1121,24 @@ def cmd_profile(argv) -> int:
             }
         )
         _emit(row, args.out)
+        if micro is not None:
+            mrow = json.dumps(
+                {
+                    "kind": "consensus_micro",
+                    "config": name,
+                    "impl": impl,
+                    "impl_resolved": resolve_impl(
+                        impl, cfg.n_in, n_agents=cfg.n_agents, H=cfg.H
+                    ),
+                    "layout": cfg.consensus_layout,
+                    "compute_dtype": cfg.compute_dtype,
+                    **consensus_tags(cfg),
+                    "ms": {k: round(v * 1e3, 3) for k, v in micro.items()},
+                    "platform": jax.devices()[0].platform,
+                    "timestamp": datetime.now().isoformat(timespec="seconds"),
+                }
+            )
+            _emit(mrow, args.out)
     return 1 if n_failed else 0
 
 

@@ -131,15 +131,13 @@ class Config:
     coop_fit_steps: int = 5
     seed: int = 300
     # --- consensus kernel implementation ---
-    # 'xla' (default): dual top-(H+1) selection bounds + clip/mean —
-    # bitwise-equal to the sort, and the measured epoch winner at the
-    # published-scenario scales this default serves (n_in <= 16:
-    # ref5_ring 1.22x, n16_full 1.65x — PERF.md "sort vs select").
-    # Dense scale-out graphs (n_in > 16) measure FASTER under the sort
-    # (n64_full epoch 0.64x for selection): pick 'auto' (or 'xla_sort')
-    # there — the crossover exists precisely for that regime.
-    # 'xla_sort': the original full jnp.sort bounds (comparison arm;
-    # measured winner in dense n_in=64 epochs, see ops/aggregation.py).
+    # 'xla' (default): log-depth tournament selection bounds + clip/mean
+    # — bitwise-equal to the sort, and the measured epoch winner at
+    # EVERY scale on CPU, including the dense n_in=64 graphs where the
+    # earlier register-chain selection lost 0.64x (tournament: ref5_ring
+    # 2.5x, n16_full 2.2x, n64_full 4.8x — PERF.md "sort vs select").
+    # 'xla_sort': the original full jnp.sort bounds (the measured-
+    # comparison arm for crossover refits, see ops/aggregation.py).
     # 'pallas': fused VMEM-resident selection kernel
     # (ops/pallas_aggregation.py), for large-N/large-model scale-out on
     # TPU. 'pallas_sort': the kernel's sorting-network arm.
@@ -147,9 +145,19 @@ class Config:
     # tests only).
     # 'auto': 3-way measured-crossover choice keyed on (H, n_in,
     # volume) — pallas on TPU from volume >= 256 up, xla vs xla_sort by
-    # the CPU-measured selection crossover elsewhere
+    # the CPU-measured selection crossover elsewhere (currently: xla
+    # everywhere — SELECT_MAX_N_IN is None)
     # (ops/aggregation.py:resolve_impl, BENCH_SCALING.md, PERF.md).
     consensus_impl: str = "xla"
+    # --- consensus message-tree layout ---
+    # 'flat' (default): every parameter leaf of a message tree is raveled
+    # into ONE (n_in, P_total) block so each consensus epoch issues a
+    # single select/clip/mean op sequence per tree (the layout the Pallas
+    # kernel always used; now shared by the XLA paths). 'per_leaf': the
+    # historical leaf-by-leaf dispatch, kept as the measured-comparison
+    # arm. Bitwise identical — raveling is elementwise-neutral
+    # (ops/aggregation.py:resilient_aggregate_tree).
+    consensus_layout: str = "flat"
     # --- transport faults / graceful degradation ---
     # fault_plan: per-link transport-fault injection on the consensus
     # exchange (drop / stale replay / corruption / NaN-Inf bombs —
@@ -193,6 +201,11 @@ class Config:
             raise ValueError(
                 f"consensus_impl={self.consensus_impl!r}: expected one of "
                 f"{CONSENSUS_IMPLS}"
+            )
+        if self.consensus_layout not in ("flat", "per_leaf"):
+            raise ValueError(
+                f"consensus_layout={self.consensus_layout!r}: expected "
+                "'flat' or 'per_leaf'"
             )
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(

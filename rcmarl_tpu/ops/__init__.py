@@ -1,4 +1,5 @@
 from rcmarl_tpu.ops.aggregation import (  # noqa: F401
+    ravel_neighbor_tree,
     resilient_aggregate,
     resilient_aggregate_tree,
     resolve_impl,

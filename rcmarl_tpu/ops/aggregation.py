@@ -17,25 +17,37 @@ bounds. H=0 degenerates to the plain mean.
 The aggregation only ever reads TWO order statistics out of the sort —
 ``sorted[H]`` (the (H+1)-th smallest) and ``sorted[n_in-H-1]`` (the
 (H+1)-th largest) — so the default implementation here computes exactly
-those via **dual top-(H+1) selection** (``impl='xla'``): an unrolled
-insertion network of 2(H+1) running min/max registers streamed over the
-n_in rows (:func:`_running_extrema`), O(k·n) vectorized compare-exchange
-ops with no data-dependent control flow, in place of the full
-O(n·log²n) sort XLA would lower. The bounds are **bitwise identical**
-to the sort's (both produce exact input values), so the two paths are
-interchangeable; ``impl='xla_sort'`` keeps the full sort as the
-measured-comparison arm and for the large-k corner where it wins (see
-:func:`resolve_impl`). ``lax.top_k`` was measured and rejected: on CPU
-the TopK custom call plus the neighbor-axis transpose runs ~2x SLOWER
-than the sort it would replace, while the register chain runs 1.4-16x
-faster (PERF.md "sort vs select").
+those via **log-depth tournament selection** (``impl='xla'``): the
+stacked neighbor axis is split into power-of-two chunks, each chunk is
+sorted by a bitonic network of whole-block ``jnp.minimum``/``maximum``
+ops on the STACKED arrays (strided axis-0 slices, never per-row
+unstacking), and the sorted k-prefixes/suffixes are pairwise-merged up a
+binary tree — ⌈log₂n⌉ merge levels of O(k) block ops
+(:func:`_k_smallest` / :func:`_k_largest`). The bounds are **bitwise
+identical** to the sort's (both produce exact input values), so the two
+paths are interchangeable; ``impl='xla_sort'`` keeps the full sort as
+the measured-comparison arm.
+
+History of the selection strategy (PERF.md "sort vs select"): the PR-1
+implementation streamed 2(H+1) running min/max registers over the n_in
+UNSTACKED rows — O(k·n) compare-exchanges, measurably faster than the
+sort up to n_in=16 but 0.64x at n_in=64, because inside the vmapped
+consensus layer XLA materialized all 64 unstacked row slices the
+register chain read. The tournament issues only whole-block ops on the
+stacked array, erasing that regression (measured: the n64_full epoch
+now wins vs the sort — see PERF.md); the register helpers remain in this
+module because the Pallas kernel still uses them (inside a kernel the
+rows live in VMEM registers and the slicing cost does not exist).
+``lax.top_k`` was measured and rejected earlier: on CPU the TopK custom
+call plus the neighbor-axis transpose ran ~2x SLOWER than the sort.
 
 TPU shape: one fused ``select -> clip -> mean`` over a small leading
-neighbor axis, batched over everything else (all parameters of a whole
-pytree in one call; all samples of a projection batch in another), and
-vmapped over the agent axis by the consensus layer. At scale-out the
-same selection trick runs inside the Pallas kernel's registers
-(:mod:`rcmarl_tpu.ops.pallas_aggregation`).
+neighbor axis, batched over everything else — all parameters of a whole
+pytree ride in ONE flattened (n_in, P_total) launch
+(:func:`resilient_aggregate_tree` ravels every leaf, the layout the
+Pallas path pioneered), and the consensus layer vmaps the whole thing
+over the agent axis. At scale-out the selection runs inside the Pallas
+kernel's registers (:mod:`rcmarl_tpu.ops.pallas_aggregation`).
 """
 
 from __future__ import annotations
@@ -45,7 +57,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from rcmarl_tpu.config import CONSENSUS_IMPLS
-
 
 #: Measured TPU crossover (BENCH_SCALING.jsonl, v5e), keyed on the total
 #: gathered-block volume ``n_in * n_agents`` — NOT on n_in alone: at
@@ -64,30 +75,25 @@ from rcmarl_tpu.config import CONSENSUS_IMPLS
 PALLAS_CROSSOVER_VOLUME = 256
 
 #: Measured CPU sort-vs-select crossover (PERF.md "sort vs select",
-#: 2026-08-04 rows), fit on EPOCH-level measurements, not the isolated
-#: kernel: selection wins the full critic_tr_epoch at every measured
-#: n_in up to 16 (ref5_ring 1.22x, n16_full 1.65x; isolated-kernel rows
-#: win 2.1-16x for every legal H there), but LOSES it at n_in = 64
-#: (n64_full epoch 0.64x even at the most favorable k = H+1 = 2) even
-#: though the isolated kernel still wins 1.38x at that shape — inside
-#: the vmapped consensus layer XLA materializes the n_in unstacked row
-#: slices the register chain reads, and at 64 rows that traffic swamps
-#: the saved compare-exchanges. H therefore cannot rescue selection
-#: above the n_in threshold (k = 2 is already the selection-friendliest
-#: trim), and the crossover keys on n_in alone; the isolated-kernel
-#: k-crossover (selection wins to k = 3 at n_in = 64, loses 0.24x at
-#: k = 32) is recorded in PERF.md for refitting if the slicing cost
-#: ever changes.
-SELECT_MAX_N_IN = 16
+#: 2026-08-04 tournament rows): with log-depth tournament selection the
+#: epoch-level measurement favors selection at EVERY measured n_in —
+#: ref5_ring, n16_full, and n64_full all win, including the dense
+#: n_in=64 shape where the PR-1 register chain lost 0.64x to its
+#: unstacked-row-slice traffic. ``None`` therefore means "no upper
+#: bound: selection always"; set a finite n_in to re-introduce a
+#: sort-above-threshold crossover if a future host/backend measures one
+#: (the comparison arm ``impl='xla_sort'`` exists exactly for that
+#: refit).
+SELECT_MAX_N_IN = None
 
 
 def _selection_favored(n_in: int, H: int) -> bool:
-    """Measured rule for where dual top-(H+1) selection beats the full
-    sort at epoch granularity (see :data:`SELECT_MAX_N_IN`; ``H`` stays
-    in the signature because the policy is keyed on (H, n_in, volume) —
-    the measured rows show H cannot flip the verdict on either side of
-    the n_in threshold, so it is currently unused)."""
-    return n_in <= SELECT_MAX_N_IN
+    """Measured rule for where tournament selection beats the full sort
+    at epoch granularity (see :data:`SELECT_MAX_N_IN`; ``H`` stays in
+    the signature because the policy is keyed on (H, n_in, volume) —
+    the measured tournament rows show neither H nor n_in flips the
+    verdict, so both are currently unused)."""
+    return SELECT_MAX_N_IN is None or n_in <= SELECT_MAX_N_IN
 
 
 def _check_impl(impl: str) -> None:
@@ -112,13 +118,12 @@ def resolve_impl(
        of at least :data:`PALLAS_CROSSOVER_VOLUME`, the fused Pallas
        selection kernel (``'pallas'``) — hardware measurement says the
        kernel wins there regardless of trim strategy;
-    2. otherwise the XLA selection path (``'xla'``) wherever the
-       measured CPU epoch rows favor dual top-(H+1) selection
-       (:func:`_selection_favored`: every measured n_in up to 16);
-    3. the full XLA sort (``'xla_sort'``) beyond, where the row-slice
-       traffic of the register chain inside the vmapped consensus
-       layer swamps the saved compare-exchanges (n64_full epoch
-       measured 0.64x even at the selection-friendliest k = 2).
+    2. otherwise the XLA tournament-selection path (``'xla'``) wherever
+       the measured CPU epoch rows favor it (:func:`_selection_favored`:
+       currently every measured shape);
+    3. the full XLA sort (``'xla_sort'``) beyond a measured
+       :data:`SELECT_MAX_N_IN` crossover, if one is ever refit (none
+       with the tournament strategy — the constant is ``None``).
 
     f64 inputs never route to the Pallas kernel (it computes in f32, a
     silent precision loss the XLA paths don't have — see
@@ -150,12 +155,35 @@ def resolve_impl(
     return select
 
 
+# --------------------------------------------------------------------------
+# Selection strategies
+# --------------------------------------------------------------------------
+#
+# Two interchangeable ways to read the k smallest / k largest rows out of
+# a stacked (n, ...) block, both bitwise-equal to ``jnp.sort`` (selection
+# returns exact input values):
+#
+# - the REGISTER CHAIN (:func:`_running_extrema`): 2k running min/max
+#   registers streamed over the n unstacked rows — O(k·n) vectorized
+#   compare-exchanges with only ~2k live arrays. This is what the Pallas
+#   kernel runs (rows are VMEM tiles there, unstacking is free), and the
+#   seed sorting network doubles as the kernel's 'sort' variant.
+# - the TOURNAMENT (:func:`_k_smallest` / :func:`_k_largest`): chunk the
+#   STACKED neighbor axis, bitonic-sort within chunks, then pairwise-
+#   merge sorted k-prefixes/suffixes up a binary tree — ⌈log₂n⌉ merge
+#   levels of O(k) whole-block ops with no unstacked row slices. This is
+#   what every XLA path runs: under the consensus layer's vmap, XLA
+#   materialized each unstacked slice the register chain read, and at
+#   n_in=64 that traffic measurably swamped the saved compare-exchanges
+#   (PERF.md "sort vs select").
+
+
 def _sorting_network(rows):
     """Odd-even transposition sort of a static list of equal-shape arrays.
 
     n rounds of adjacent compare-exchange; fully unrolled (n is tiny and
     static), so it lowers to pure vectorized min/max with no control
-    flow. Shared by :func:`_running_extrema`'s seed step and the Pallas
+    flow. Used by :func:`_running_extrema`'s seed step and the Pallas
     sort-variant kernel (:mod:`rcmarl_tpu.ops.pallas_aggregation`).
     """
     s = list(rows)
@@ -179,8 +207,8 @@ def _running_extrema(rows, k: int):
     ``minimum``/``maximum`` VPU ops total, fully unrolled (k and n are
     tiny and static), no data-dependent control flow, and only ~2k live
     register arrays instead of the n-array block a sort materializes.
-    Works identically inside a Pallas kernel (registers/VMEM) and in
-    plain XLA.
+    This is the Pallas kernel's strategy (registers/VMEM); the XLA paths
+    use the tournament instead (see the section comment).
 
     Returns ``(small, large)``: lists of length k, each sorted
     ascending. ``small[j]`` is the (j+1)-th smallest of the rows —
@@ -193,9 +221,7 @@ def _running_extrema(rows, k: int):
 
 
 def _running_small(rows, k: int):
-    """The ``small`` half of :func:`_running_extrema` alone — callers
-    that need only one side (the masked path feeds differently-masked
-    inputs to each) skip the other chain's compare-exchanges."""
+    """The ``small`` half of :func:`_running_extrema` alone."""
     small = _sorting_network(rows[:k])  # seed: first k rows, sorted
     for x in rows[k:]:
         for j in range(k):  # ascending insert: x carries the displaced max
@@ -212,6 +238,99 @@ def _running_large(rows, k: int):
     return large
 
 
+def _next_pow2(x: int) -> int:
+    return 1 if x <= 1 else 1 << (x - 1).bit_length()
+
+
+def _bitonic_merge(M: jnp.ndarray) -> jnp.ndarray:
+    """Sort each length-K bitonic sequence along axis 1 of ``(m, K, ...)``
+    ascending. K must be a power of two. The classic half-cleaner
+    recursion, expressed as reshape + two whole-block min/max per level:
+    compare rows j and j+step within groups of 2·step, halving step —
+    log₂K levels, every op touching the full (m, step, ...) block at
+    once. Outputs are exact input values (compare-exchange only)."""
+    K = M.shape[1]
+    step = K // 2
+    while step >= 1:
+        G = M.reshape(M.shape[0], K // (2 * step), 2, step, *M.shape[2:])
+        a, b = G[:, :, 0], G[:, :, 1]
+        M = jnp.stack([jnp.minimum(a, b), jnp.maximum(a, b)], axis=2).reshape(
+            M.shape
+        )
+        step //= 2
+    return M
+
+
+def _sort_stacked_chunks(S: jnp.ndarray) -> jnp.ndarray:
+    """Sort along axis 1 of ``(m, kp, ...)``, kp a power of two, by
+    doubling bitonic merges: adjacent sorted L-runs are joined as
+    ``concat(A, reverse(B))`` (a bitonic 2L-sequence) and merged — all
+    whole-block ops, vectorized over the m chunks."""
+    m, kp = S.shape[0], S.shape[1]
+    L = 1
+    while L < kp:
+        G = S.reshape(m, kp // (2 * L), 2, L, *S.shape[2:])
+        A, B = G[:, :, 0], G[:, :, 1][:, :, ::-1]
+        M = jnp.concatenate([A, B], axis=2)  # (m, kp//2L, 2L, ...) bitonic
+        M = _bitonic_merge(
+            M.reshape(m * (kp // (2 * L)), 2 * L, *S.shape[2:])
+        )
+        S = M.reshape(S.shape)
+        L *= 2
+    return S
+
+
+def _tournament(values: jnp.ndarray, k: int, largest: bool) -> jnp.ndarray:
+    """Log-depth tournament selection over axis 0 of a STACKED array.
+
+    Pads the neighbor axis to a multiple of ``kp = next_pow2(k)`` with
+    ±inf sentinels (which can never displace a surviving value — and
+    when the data itself carries ±inf sentinel sinks, a padded inf is
+    bitwise identical to a real one), sorts each kp-chunk with
+    :func:`_sort_stacked_chunks`, then pairwise-merges sorted
+    kp-prefixes (suffixes for ``largest``) up a binary tree: per merge,
+    one whole-block ``minimum``/``maximum`` of A against reversed B
+    yields the kp extreme values of the union as a bitonic sequence
+    (Batcher's half-cleaner lemma), and :func:`_bitonic_merge` re-sorts
+    it — ⌈log₂(n/kp)⌉ levels of O(kp) block ops. No unstacked row
+    slices anywhere: every op processes half the surviving rows at once.
+    """
+    n = values.shape[0]
+    kp = _next_pow2(k)
+    m = -(-n // kp)
+    pad = m * kp - n
+    if pad:
+        fill = jnp.full(
+            (pad,) + values.shape[1:],
+            -jnp.inf if largest else jnp.inf,
+            values.dtype,
+        )
+        values = jnp.concatenate([values, fill], axis=0)
+    S = _sort_stacked_chunks(values.reshape(m, kp, *values.shape[1:]))
+    while S.shape[0] > 1:
+        carry = None
+        if S.shape[0] % 2:
+            carry, S = S[-1:], S[:-1]
+        A, B = S[0::2], S[1::2][:, ::-1]
+        S = _bitonic_merge(jnp.maximum(A, B) if largest else jnp.minimum(A, B))
+        if carry is not None:
+            S = jnp.concatenate([S, carry], axis=0)
+    return S[0][kp - k :] if largest else S[0][:k]
+
+
+def _k_smallest(values: jnp.ndarray, k: int) -> jnp.ndarray:
+    """``sort(values, axis=0)[:k]`` as a stacked (k, ...) array, by
+    tournament selection — bitwise identical to the sort prefix."""
+    return _tournament(values, k, largest=False)
+
+
+def _k_largest(values: jnp.ndarray, k: int) -> jnp.ndarray:
+    """``sort(values, axis=0)[n-k:]`` as a stacked (k, ...) array
+    (ascending), by tournament selection — bitwise identical to the
+    sort suffix."""
+    return _tournament(values, k, largest=True)
+
+
 def _trim_bounds(values: jnp.ndarray, H: int, impl: str):
     """The raw trim bounds ``(sorted[H], sorted[n_in-H-1])`` over axis 0,
     by the impl's strategy — bitwise identical between the two."""
@@ -219,10 +338,7 @@ def _trim_bounds(values: jnp.ndarray, H: int, impl: str):
     if impl == "xla_sort":
         sorted_vals = jnp.sort(values, axis=0)
         return sorted_vals[H], sorted_vals[n_in - H - 1]
-    small, large = _running_extrema(
-        [values[i] for i in range(n_in)], H + 1
-    )
-    return small[H], large[0]
+    return _k_smallest(values, H + 1)[H], _k_largest(values, H + 1)[0]
 
 
 # --------------------------------------------------------------------------
@@ -249,7 +365,9 @@ def _trim_bounds(values: jnp.ndarray, H: int, impl: str):
 # the same association order the Pallas kernel's accumulator uses — and
 # the bounds are exact selections on the sinked arrays, so all six
 # impls (xla, xla_sort, masked, traced-H, pallas select, pallas sort)
-# produce BITWISE-identical f32 aggregates.
+# produce BITWISE-identical f32 aggregates. The tournament's ±inf pads
+# coexist with the sentinel sinks because identical infinities share one
+# bit pattern: a pad displacing a sunk entry changes nothing.
 
 
 def _sanitize_parts(values: jnp.ndarray, valid: jnp.ndarray | None):
@@ -313,9 +431,8 @@ def _sanitized_aggregate(
         lower_raw = jnp.sort(sink_lo, axis=0)[H]
         upper_raw = jnp.sort(sink_hi, axis=0)[n_in - 1 - H]
     else:
-        small = _running_small([sink_lo[i] for i in range(n_in)], H + 1)
-        large = _running_large([sink_hi[i] for i in range(n_in)], H + 1)
-        lower_raw, upper_raw = small[H], large[0]
+        lower_raw = _k_smallest(sink_lo, H + 1)[H]
+        upper_raw = _k_largest(sink_hi, H + 1)[0]
     return _sanitized_epilogue(
         values, finite, count, lower_raw, upper_raw, 2 * H + 1
     )
@@ -323,7 +440,7 @@ def _sanitized_aggregate(
 
 def _sanitized_dynamic(values: jnp.ndarray, H, impl: str) -> jnp.ndarray:
     """Traced-H sanitized clip-and-average: the legal-range trick of
-    :func:`_dynamic_h_aggregate` (k_max registers / dynamic sort index)
+    :func:`_dynamic_h_aggregate` (k_max selections / dynamic sort index)
     over the ±inf-sunk copies, same epilogue, traced deficit threshold."""
     if impl not in ("xla", "xla_sort"):
         raise ValueError(
@@ -340,10 +457,10 @@ def _sanitized_dynamic(values: jnp.ndarray, H, impl: str) -> jnp.ndarray:
         upper_raw = jnp.take(jnp.sort(sink_hi, axis=0), n_in - 1 - H, axis=0)
     else:
         k_max = (n_in - 1) // 2 + 1
-        small = _running_small([sink_lo[i] for i in range(n_in)], k_max)
-        large = _running_large([sink_hi[i] for i in range(n_in)], k_max)
-        lower_raw = jnp.take(jnp.stack(small), H, axis=0)
-        upper_raw = jnp.take(jnp.stack(large), k_max - 1 - H, axis=0)
+        lower_raw = jnp.take(_k_smallest(sink_lo, k_max), H, axis=0)
+        upper_raw = jnp.take(
+            _k_largest(sink_hi, k_max), k_max - 1 - H, axis=0
+        )
     return _sanitized_epilogue(
         values, finite, count, lower_raw, upper_raw, 2 * H + 1
     )
@@ -371,10 +488,9 @@ def resilient_aggregate(
         unrolls its trim indices at lowering time) and cannot be
         range-checked at trace time — callers validate 2H <= deg-1 per
         cell (Config does this for its static H).
-      impl: 'xla' (default; dual top-(H+1) selection, bitwise-equal to
-        the sort), 'xla_sort' (full jnp.sort — the measured-comparison
-        arm, and the winner only in the large-k corner), 'pallas'
-        (fused TPU selection kernel,
+      impl: 'xla' (default; log-depth tournament selection, bitwise-equal
+        to the sort), 'xla_sort' (full jnp.sort — the measured-comparison
+        arm), 'pallas' (fused TPU selection kernel,
         :mod:`rcmarl_tpu.ops.pallas_aggregation`), 'pallas_sort' (the
         kernel's sorting-network arm), 'pallas_interpret' (selection
         kernel in the interpreter, CPU tests), or 'auto' (the 3-way
@@ -453,8 +569,7 @@ def _resolve_masked(impl: str, n_in: int, H: int) -> str:
     ('xla_sort'/'pallas_sort') keep the sort strategy, every other
     concrete impl means selection, and 'auto' applies the measured n_in
     crossover — never the TPU volume rule, which would otherwise route
-    a dense masked graph into the selection branch the measured rows
-    reject."""
+    a dense masked graph to a kernel that cannot lower for it."""
     _check_impl(impl)
     if impl == "auto":
         return "xla" if _selection_favored(n_in, H) else "xla_sort"
@@ -464,11 +579,13 @@ def _resolve_masked(impl: str, n_in: int, H: int) -> str:
 def _resolve_dynamic(impl: str, n_in: int) -> str:
     """Impl resolution for the traced-H path: only the two XLA arms can
     lower (the Pallas kernel fixes its trim indices at lowering time),
-    and 'auto' applies the measured n_in crossover with the STATIC
-    worst-case trim k_max = (n_in-1)//2 + 1 — H is data here, so the
-    policy must hold for every H the cells might carry. An explicit
-    pallas choice still errors rather than silently downgrading
-    (callers' tests pin this)."""
+    and 'auto' applies the measured crossover with the STATIC worst-case
+    trim k_max = (n_in-1)//2 + 1 — H is data here, so the policy must
+    hold for every H the cells might carry. (With the tournament the
+    k_max selection is ⌈log₂n⌉ merge levels of block ops, so large-n
+    traced cells no longer force the sort the way the PR-1 register
+    chain's k_max·n unroll did.) An explicit pallas choice still errors
+    rather than silently downgrading (callers' tests pin this)."""
     _check_impl(impl)
     if impl == "auto":
         k_max = (n_in - 1) // 2 + 1
@@ -488,12 +605,12 @@ def _dynamic_h_aggregate(values: jnp.ndarray, H, impl: str) -> jnp.ndarray:
     (vmapped over the cell axis).
 
     Selection variant (``impl='xla'``): H is traced, but its legal range
-    is static — 2H <= n_in-1 — so k_max = (n_in-1)//2 + 1 running
-    registers cover every possible trim: ``small`` holds
-    ``sorted[0:k_max]`` and ``large`` holds ``sorted[n_in-k_max:]``, and
-    the traced H dynamic-indexes into the stacked registers
-    (``lower = small[H]``, ``upper = large[k_max-1-H]``) instead of into
-    a full sorted copy.
+    is static — 2H <= n_in-1 — so a k_max = (n_in-1)//2 + 1 tournament
+    covers every possible trim: :func:`_k_smallest` holds
+    ``sorted[0:k_max]`` stacked and :func:`_k_largest` holds
+    ``sorted[n_in-k_max:]``, and the traced H dynamic-indexes into the
+    stacked selections (``lower = small[H]``, ``upper =
+    large[k_max-1-H]``) instead of into a full sorted copy.
     """
     if impl not in ("xla", "xla_sort"):
         raise ValueError(
@@ -510,11 +627,8 @@ def _dynamic_h_aggregate(values: jnp.ndarray, H, impl: str) -> jnp.ndarray:
         upper_raw = jnp.take(sorted_vals, n_in - 1 - H, axis=0)
     else:
         k_max = (n_in - 1) // 2 + 1
-        small, large = _running_extrema(
-            [values[i] for i in range(n_in)], k_max
-        )
-        lower_raw = jnp.take(jnp.stack(small), H, axis=0)
-        upper_raw = jnp.take(jnp.stack(large), k_max - 1 - H, axis=0)
+        lower_raw = jnp.take(_k_smallest(values, k_max), H, axis=0)
+        upper_raw = jnp.take(_k_largest(values, k_max), k_max - 1 - H, axis=0)
     lower = jnp.minimum(lower_raw, own)
     upper = jnp.maximum(upper_raw, own)
     return jnp.mean(jnp.clip(values, lower, upper), axis=0)
@@ -528,7 +642,7 @@ def _masked_aggregate(
     Exactly :func:`resilient_aggregate` restricted to the ``d = sum(valid)``
     valid entries. Selection variant (the default): masking invalid
     slots to +inf makes the (H+1)-th smallest *valid* entry fall out of
-    the small registers directly, and masking to -inf does the same for
+    the small tournament directly, and masking to -inf does the same for
     the (H+1)-th largest on the large side — both static index
     ``[H]``/``[0]`` picks, replacing the sort variant's
     dynamic-index-into-full-sort for the upper bound (``sorted[d-H-1]``
@@ -562,12 +676,51 @@ def _masked_aggregate(
     else:
         sink_lo = jnp.where(v > 0, values, jnp.inf)  # invalid sinks high
         sink_hi = jnp.where(v > 0, values, -jnp.inf)  # invalid sinks low
-        small = _running_small([sink_lo[i] for i in range(n_in)], H + 1)
-        large = _running_large([sink_hi[i] for i in range(n_in)], H + 1)
-        lower = jnp.minimum(small[H], own)
-        upper = jnp.maximum(large[0], own)
+        lower = jnp.minimum(_k_smallest(sink_lo, H + 1)[H], own)
+        upper = jnp.maximum(_k_largest(sink_hi, H + 1)[0], own)
     clipped = jnp.where(v > 0, jnp.clip(values, lower, upper), 0.0)
     return jnp.sum(clipped, axis=0) / count
+
+
+# --------------------------------------------------------------------------
+# Whole-tree (flattened one-launch) aggregation
+# --------------------------------------------------------------------------
+
+
+def ravel_neighbor_tree(tree):
+    """Flatten a pytree of (n_in, ...) leaves into ONE (n_in, P_total)
+    block plus an ``unravel`` closure mapping an aggregated (P_total,)
+    array back to the tree structure (leaves without the neighbor axis).
+
+    This is the layout both the Pallas kernel launch and the XLA
+    one-launch paths share: raveling is pure reshape/concat (bitwise
+    no-ops per element), so aggregating the flattened block is bitwise
+    identical to aggregating leaf by leaf — every select/clip/mean op is
+    elementwise along the trailing axis — while issuing ONE op sequence
+    for the whole message tree instead of one per leaf.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    n_in = leaves[0].shape[0]
+    bad = [l.shape for l in leaves if l.shape[0] != n_in]
+    if bad:
+        raise ValueError(
+            f"all leaves must share the leading neighbor dim {n_in}; "
+            f"got leaves with shapes {bad[:3]}"
+        )
+    sizes = [int(np.prod(l.shape[1:], dtype=np.int64)) for l in leaves]
+    if len(leaves) == 1:
+        flat = leaves[0].reshape(n_in, -1)
+    else:
+        flat = jnp.concatenate([l.reshape(n_in, -1) for l in leaves], axis=1)
+
+    def unravel(agg):
+        out, off = [], 0
+        for leaf, size in zip(leaves, sizes):
+            out.append(agg[off : off + size].reshape(leaf.shape[1:]))
+            off += size
+        return jax.tree.unflatten(treedef, out)
+
+    return flat, unravel
 
 
 def resilient_aggregate_tree(
@@ -577,20 +730,44 @@ def resilient_aggregate_tree(
     valid: jnp.ndarray | None = None,
     n_agents: int = 1,
     sanitize: bool = False,
+    layout: str = "flat",
 ):
     """Apply :func:`resilient_aggregate` to every leaf of a pytree whose
     leaves carry a leading neighbor axis (e.g. a gathered parameter
-    pytree with leaves (n_in, ...)). With a pallas impl the whole tree is
-    flattened into ONE fused kernel launch instead of one selection per
-    leaf. ``valid`` masks padded neighbor slots (see
+    pytree with leaves (n_in, ...)).
+
+    ``layout='flat'`` (default) ravels every leaf into ONE
+    (n_in, P_total) block (:func:`ravel_neighbor_tree`) so the whole
+    message tree is aggregated in a single select/clip/mean op sequence
+    — on every backend: the Pallas impls always launched this way, and
+    the XLA impls (all modes: static-H, traced-H, masked, sanitize) now
+    share the layout instead of dispatching one small op chain per leaf.
+    ``layout='per_leaf'`` keeps the historical leaf-by-leaf ``tree.map``
+    (the comparison arm; also the automatic fallback when leaves carry
+    mixed dtypes, which a single flat block cannot hold). Both layouts
+    are bitwise identical — raveling is elementwise-neutral.
+
+    ``valid`` masks padded neighbor slots (see
     :func:`resilient_aggregate`; masked trees take the XLA path).
     ``n_agents`` is the vmapped agent-axis size, used only to resolve
     ``'auto'``. ``sanitize`` hardens every leaf against non-finite
     payloads (see :func:`resilient_aggregate`)."""
+    if layout not in ("flat", "per_leaf"):
+        raise ValueError(
+            f"unknown layout {layout!r}; expected 'flat' or 'per_leaf'"
+        )
     leaves = jax.tree.leaves(tree)
     if not leaves:  # e.g. the trunk tree of a head-only (hidden=()) net
         _check_impl(impl)
         return tree
+    one_block = layout == "flat" and len({l.dtype for l in leaves}) == 1
+
+    def apply(fn):
+        if one_block:
+            flat, unravel = ravel_neighbor_tree(tree)
+            return unravel(fn(flat))
+        return jax.tree.map(fn, tree)
+
     if not is_static_h(H):
         if valid is not None:
             raise ValueError(
@@ -599,22 +776,15 @@ def resilient_aggregate_tree(
             )
         concrete = _resolve_dynamic(impl, leaves[0].shape[0])
         if sanitize:
-            return jax.tree.map(
-                lambda v: _sanitized_dynamic(v, H, concrete), tree
-            )
-        return jax.tree.map(
-            lambda v: _dynamic_h_aggregate(v, H, concrete), tree
-        )
+            return apply(lambda v: _sanitized_dynamic(v, H, concrete))
+        return apply(lambda v: _dynamic_h_aggregate(v, H, concrete))
     if valid is not None:
         concrete = _resolve_masked(impl, leaves[0].shape[0], H)
         if sanitize:
-            return jax.tree.map(
-                lambda v: _sanitized_aggregate(v, H, concrete, valid=valid),
-                tree,
+            return apply(
+                lambda v: _sanitized_aggregate(v, H, concrete, valid=valid)
             )
-        return jax.tree.map(
-            lambda v: _masked_aggregate(v, H, valid, concrete), tree
-        )
+        return apply(lambda v: _masked_aggregate(v, H, valid, concrete))
     impl = resolve_impl(
         impl, leaves[0].shape[0], leaves[0].dtype, n_agents, H
     )
@@ -631,5 +801,5 @@ def resilient_aggregate_tree(
             sanitize=sanitize,
         )
     if sanitize:
-        return jax.tree.map(lambda v: _sanitized_aggregate(v, H, impl), tree)
-    return jax.tree.map(lambda v: resilient_aggregate(v, H, impl), tree)
+        return apply(lambda v: _sanitized_aggregate(v, H, impl))
+    return apply(lambda v: resilient_aggregate(v, H, impl))

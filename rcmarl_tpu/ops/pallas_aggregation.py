@@ -55,6 +55,7 @@ from rcmarl_tpu.ops.aggregation import (
     _running_large,
     _running_small,
     _sorting_network,
+    ravel_neighbor_tree,
 )
 
 _LANES = 128
@@ -232,33 +233,21 @@ def fused_resilient_aggregate_tree(
     """Aggregate every (n_in, ...) leaf of ``tree`` in ONE kernel launch.
 
     Ravels all leaves along their trailing dims, concatenates into a
-    single (n_in, P) block, runs :func:`fused_resilient_aggregate` once,
-    and splits back — the whole hidden-layer consensus of an agent's
-    trunk (reference ``resilient_CAC_agents.py:142-166``) becomes a
-    single HBM pass instead of one selection per weight array.
+    single (n_in, P) block (``aggregation.ravel_neighbor_tree`` — the
+    same layout the XLA one-launch paths share), runs
+    :func:`fused_resilient_aggregate` once, and splits back — the whole
+    hidden-layer consensus of an agent's trunk (reference
+    ``resilient_CAC_agents.py:142-166``) becomes a single HBM pass
+    instead of one selection per weight array.
     """
-    leaves, treedef = jax.tree.flatten(tree)
-    n_in = leaves[0].shape[0]
-    bad = [l.shape for l in leaves if l.shape[0] != n_in]
-    if bad:
-        raise ValueError(
-            f"all leaves must share the leading neighbor dim {n_in}; "
-            f"got leaves with shapes {bad[:3]}"
+    flat, unravel = ravel_neighbor_tree(tree)
+    return unravel(
+        fused_resilient_aggregate(
+            flat,
+            H,
+            variant=variant,
+            block_rows=block_rows,
+            interpret=interpret,
+            sanitize=sanitize,
         )
-    sizes = [l[0].size for l in leaves]
-    flat = jnp.concatenate(
-        [l.reshape(n_in, -1) for l in leaves], axis=1
     )
-    agg = fused_resilient_aggregate(
-        flat,
-        H,
-        variant=variant,
-        block_rows=block_rows,
-        interpret=interpret,
-        sanitize=sanitize,
-    )
-    out, off = [], 0
-    for leaf, size in zip(leaves, sizes):
-        out.append(agg[off : off + size].reshape(leaf.shape[1:]))
-        off += size
-    return jax.tree.unflatten(treedef, out)

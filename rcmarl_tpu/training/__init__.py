@@ -17,6 +17,7 @@ from rcmarl_tpu.training.trainer import (  # noqa: F401
     metrics_to_dataframe,
     train,
     train_block,
+    train_block_donated,
     train_scanned,
 )
 from rcmarl_tpu.training.update import (  # noqa: F401
@@ -24,5 +25,6 @@ from rcmarl_tpu.training.update import (  # noqa: F401
     spec_from_config,
     team_average_reward,
     update_block,
+    update_block_donated,
 )
 from rcmarl_tpu.training.reference_api import train_RPBCAC  # noqa: F401

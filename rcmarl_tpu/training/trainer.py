@@ -87,8 +87,7 @@ def init_train_state(
     )
 
 
-@partial(jax.jit, static_argnums=0, static_argnames=("with_diag",))
-def train_block(
+def _train_block(
     cfg: Config, state: TrainState, spec=None, with_diag: bool = False
 ) -> Tuple[TrainState, EpisodeMetrics]:
     """One block: rollout ``n_ep_fixed`` episodes, update, push to buffer.
@@ -100,6 +99,10 @@ def train_block(
     the fused-matrix path (:mod:`rcmarl_tpu.parallel.matrix`).
     ``with_diag`` (static) additionally returns the block's
     :class:`~rcmarl_tpu.faults.FaultDiag` degradation counters.
+
+    Exposed as :data:`train_block` (inputs stay alive) and
+    :data:`train_block_donated` (``state`` donated — the host training
+    loop's allocation saver).
     """
     env = make_env(cfg)
     key, k_roll, k_upd = jax.random.split(state.key, 3)
@@ -120,6 +123,27 @@ def train_block(
     if with_diag:
         return out_state, metrics, diag
     return out_state, metrics
+
+
+#: The standard jitted block: inputs stay alive after the call — what
+#: the guard/retry path, the fused-matrix/seed-parallel vmaps, and every
+#: test that re-runs a block from the same state need.
+train_block = partial(
+    jax.jit, static_argnums=0, static_argnames=("with_diag",)
+)(_train_block)
+
+#: Same program with ``state`` DONATED: XLA writes the new params /
+#: optimizer moments / replay buffer into the input buffers instead of
+#: allocating a second full copy per block — the steady-state host loop
+#: (:func:`train` with the guard off) runs with one live TrainState
+#: instead of two (PERF.md "buffer donation"). The passed ``state`` is
+#: consumed; reusing it afterwards raises.
+train_block_donated = jax.jit(
+    _train_block,
+    static_argnums=0,
+    static_argnames=("with_diag",),
+    donate_argnums=(1,),
+)
 
 
 def train_scanned(
@@ -195,6 +219,13 @@ def train(
     frame's ``.attrs['guard']`` records the guard/diagnostic counters
     (retries, skipped blocks, non-finite payload entries, degree-deficit
     fallbacks) when the guard or a fault plan is active.
+
+    Allocation: with the guard off the loop runs :data:`train_block_donated`
+    — each block's new TrainState reuses the old one's buffers (one live
+    copy of params/moments/replay instead of two). A caller-passed
+    ``state`` is copied once up front so it survives the run; guarded
+    runs use the undonated entry because rollback/retry re-runs blocks
+    from the same pre-block state.
     """
     n_eps = cfg.n_episodes if n_episodes is None else n_episodes
     if n_eps % cfg.n_ep_fixed != 0:
@@ -204,11 +235,19 @@ def train(
     if max_retries < 0:
         raise ValueError(f"max_retries={max_retries} must be >= 0")
     n_blocks = n_eps // cfg.n_ep_fixed
-    if state is None:
-        state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
-
     if guard is None:
         guard = cfg.fault_plan is not None
+    if state is None:
+        state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
+    elif not guard:
+        # The donated block entry below CONSUMES its input state; work on
+        # a one-time copy so the caller's resume state stays alive (the
+        # copy is one block's worth of allocation, paid once per run —
+        # donation then keeps the whole loop at a single live TrainState).
+        state = jax.tree.map(jnp.copy, state)
+    # Guarded runs keep the undonated entry: rollback/retry re-runs a
+    # block from the SAME pre-block state, which donation would consume.
+    step = train_block if guard else train_block_donated
     with_diag = cfg.fault_plan is not None and cfg.fault_plan.active
     stats = {"retries": 0, "skipped": 0, "nonfinite": 0, "deficit": 0}
 
@@ -226,9 +265,9 @@ def train(
                 )
             diag = None
             if with_diag:
-                new_state, m, diag = train_block(cfg, base, with_diag=True)
+                new_state, m, diag = step(cfg, base, with_diag=True)
             else:
-                new_state, m = train_block(cfg, base)
+                new_state, m = step(cfg, base)
             if not guard or _block_healthy(new_state, m):
                 state = new_state
                 break

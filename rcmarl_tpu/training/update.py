@@ -354,8 +354,7 @@ def actor_phase(
     return new_actor, new_opt
 
 
-@partial(jax.jit, static_argnums=0, static_argnames=("with_diag",))
-def update_block(
+def _update_block(
     cfg: Config,
     params: AgentParams,
     batch: Batch,
@@ -365,6 +364,9 @@ def update_block(
     with_diag: bool = False,
 ) -> AgentParams:
     """Full update block: ``n_epochs`` x (phase I + II) then phase III.
+
+    Jitted as :data:`update_block` (the default) and
+    :data:`update_block_donated` (same program, ``params`` donated).
 
     Args:
       params: stacked agent state.
@@ -398,3 +400,24 @@ def update_block(
     if with_diag:
         return params, sum_diags(diags)
     return params
+
+
+#: The standard jitted update block: inputs stay alive after the call
+#: (tests and the guard/retry path re-run blocks from the same state).
+update_block = partial(
+    jax.jit, static_argnums=0, static_argnames=("with_diag",)
+)(_update_block)
+
+#: Same program with the ``params`` carry DONATED: XLA reuses the input
+#: parameter/optimizer buffers for the outputs, so the largest stacked
+#: arrays update in place instead of allocating a second copy per call
+#: (PERF.md "buffer donation"). The caller's ``params`` is consumed —
+#: reusing it afterwards raises. Nested calls (e.g. from inside another
+#: jit) leave donation to the outer program, where XLA aliases buffers
+#: on its own.
+update_block_donated = jax.jit(
+    _update_block,
+    static_argnums=0,
+    static_argnames=("with_diag",),
+    donate_argnums=(1,),
+)

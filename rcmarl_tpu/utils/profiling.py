@@ -13,6 +13,13 @@ first-class utility:
   program, so per-phase cost cannot be observed from the host; this
   deliberately un-fused breakdown exists for performance work, not
   training.
+- :func:`profile_consensus` — one level deeper: the consensus epoch's
+  own components (neighbor gather vs trim-bound selection vs clip/mean
+  epilogue vs the phase-I local fits), each timed standalone on the
+  flattened one-launch layout, tagged with the knobs the crossover
+  policies key on (n_in, H, gathered volume) — so refits of
+  ``SELECT_MAX_N_IN`` / ``PALLAS_CROSSOVER_VOLUME`` measure the
+  component they tune instead of inferring it from whole-epoch deltas.
 - :class:`Timer` — tiny wall-clock timer with forced completion, used by
   the benchmark harness and the phase profiler.
 """
@@ -24,6 +31,7 @@ import time
 from typing import Callable, Dict
 
 import jax
+import jax.numpy as jnp
 
 from rcmarl_tpu.training.update import team_average_reward
 
@@ -127,4 +135,136 @@ def profile_phases(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
     out["actor_phase"] = _timeit(actor, state.params, fresh, key, reps=reps)
 
     out["full_block"] = _timeit(lambda s: train_block(cfg, s), state, reps=reps)
+    return out
+
+
+def consensus_tags(cfg) -> Dict[str, int]:
+    """The static knobs every consensus crossover policy keys on, for
+    tagging micro-breakdown rows: the neighbor-axis size, the trim
+    parameter, the agent count, the volume key ``n_in * n_agents`` that
+    :data:`~rcmarl_tpu.ops.aggregation.PALLAS_CROSSOVER_VOLUME` uses,
+    and the total element count of one gathered critic message tree
+    (the actual bytes a consensus launch streams)."""
+    from rcmarl_tpu.models.mlp import init_stacked_mlp
+
+    params = init_stacked_mlp(
+        jax.random.PRNGKey(0), cfg.n_agents, cfg.obs_dim, cfg.hidden, 1
+    )
+    per_agent = sum(
+        int(l.size) // cfg.n_agents for l in jax.tree.leaves(params)
+    )
+    return {
+        "n_in": cfg.n_in,
+        "H": cfg.H,
+        "n_agents": cfg.n_agents,
+        "volume": cfg.n_in * cfg.n_agents,
+        "gathered_numel": cfg.n_agents * cfg.n_in * per_agent,
+    }
+
+
+def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
+    """Time the components of ONE consensus epoch separately.
+
+    Where :func:`profile_phases` stops at whole sub-programs, this
+    breaks the dominant one (the critic/TR epoch, 92-100% of block time
+    at every measured scale — PERF.md) into the pieces the crossover
+    policies tune:
+
+    - ``gather`` — the neighbor-message gather of the critic tree
+      ((N, ...) leaves -> (N, n_in, ...) leaves; rolls or fancy index).
+    - ``trim_bounds`` — the sort-vs-selection trim-bound computation
+      alone, on the flattened (N, n_in, P_total) gathered block (the
+      one-launch layout), by ``cfg.consensus_impl``'s strategy.
+    - ``clip_mean`` — the clip-and-average epilogue given precomputed
+      bounds (the part every strategy shares).
+    - ``consensus`` — the full phase-II update of the critic net
+      (hidden consensus + projection + team head step), vmapped over
+      agents: what ``critic_tr_epoch`` actually runs.
+    - ``phase1_fits`` — the cooperative local critic+TR fits that
+      produce the messages (phase I).
+
+    Each component is jitted standalone with host-fetch barriers, like
+    the phase profiler. Use :func:`consensus_tags` for the row tags.
+    """
+    from rcmarl_tpu.agents.updates import (
+        consensus_update_one,
+        coop_local_critic_fit,
+        coop_local_tr_fit,
+    )
+    from rcmarl_tpu.ops.aggregation import _trim_bounds, resolve_impl
+    from rcmarl_tpu.training.buffer import update_batch
+    from rcmarl_tpu.training.rollout import rollout_block
+    from rcmarl_tpu.training.trainer import init_train_state, make_env
+    from rcmarl_tpu.training.update import gather_neighbor_messages
+
+    if state is None:
+        state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
+    env = make_env(cfg)
+    key = jax.random.PRNGKey(0)
+    fresh, _ = jax.jit(
+        lambda s, k: rollout_block(cfg, env, s.params, s.desired, k, s.initial)
+    )(state, key)
+    batch = jax.jit(update_batch)(state.buffer, fresh)
+    critic = state.params.critic
+    out: Dict[str, float] = {}
+
+    gather = jax.jit(lambda t: gather_neighbor_messages(cfg, t))
+    out["gather"] = _timeit(gather, critic, reps=reps)
+    nbr = gather(critic)  # (N, n_in, ...) leaves
+
+    # the flattened one-launch layout: ONE (N, n_in, P_total) block
+    N, n_in = cfg.n_agents, cfg.n_in
+    flat = jnp.concatenate(
+        [l.reshape(N, n_in, -1) for l in jax.tree.leaves(nbr)], axis=-1
+    )
+    # strategy twin of the resolved impl (the bound computation is
+    # XLA-level here; pallas rows measure the whole kernel instead)
+    resolved = resolve_impl(
+        cfg.consensus_impl, n_in, flat.dtype, N, cfg.H
+    )
+    strategy = (
+        "xla_sort" if resolved in ("xla_sort", "pallas_sort") else "xla"
+    )
+    H_eff = max(cfg.H, 1)  # H=0 short-circuits past the bounds entirely
+    bounds = jax.jit(
+        jax.vmap(lambda v: _trim_bounds(v, H_eff, strategy))
+    )
+    out["trim_bounds"] = _timeit(bounds, flat, reps=reps)
+    lo, hi = bounds(flat)
+
+    def clip_mean(v, lo, hi):
+        own = v[:, 0]
+        lower = jnp.minimum(lo, own)
+        upper = jnp.maximum(hi, own)
+        return jnp.mean(
+            jnp.clip(v, lower[:, None], upper[:, None]), axis=1
+        )
+
+    out["clip_mean"] = _timeit(jax.jit(clip_mean), flat, lo, hi, reps=reps)
+
+    mask = batch.mask
+    cons = jax.jit(
+        jax.vmap(
+            lambda own, nb, x: consensus_update_one(own, nb, x, mask, cfg),
+            in_axes=(0, 0, None),
+        )
+    )
+    out["consensus"] = _timeit(cons, critic, nbr, batch.s, reps=reps)
+
+    r_agents = jnp.moveaxis(batch.r, 1, 0)  # (N, B, 1)
+
+    def fits(critic_p, tr_p, r):
+        c, _ = jax.vmap(
+            lambda p, rr: coop_local_critic_fit(
+                p, batch.s, batch.ns, rr, mask, cfg
+            )
+        )(critic_p, r)
+        t, _ = jax.vmap(
+            lambda p, rr: coop_local_tr_fit(p, batch.sa, rr, mask, cfg)
+        )(tr_p, r)
+        return c, t
+
+    out["phase1_fits"] = _timeit(
+        jax.jit(fits), critic, state.params.tr, r_agents, reps=reps
+    )
     return out

@@ -54,3 +54,17 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
     --fault_drop_p 0.2 --fault_nan_p 0.2 --sanitize \
     --summary_dir "$smoke_dir" --quiet
 echo "fault-injection smoke cell OK"
+
+# Flattened-path smoke cell: a RAGGED graph (per-agent degrees 4/4/3/3,
+# padded + masked; every degree >= 2H+1) under the default flat
+# one-launch layout, with
+# sanitize and a tiny drop+NaN fault plan — the flattened XLA masked +
+# sanitize + fault-injection wire-up end to end, which the unit tests
+# cover only layer by layer. Same tiny budget as the cell above.
+timeout -k 10 180 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
+    --n_agents 4 --in_nodes '[[0,1,2,3],[1,2,3,0],[2,3,0],[3,0,1]]' \
+    --nrow 3 --ncol 3 \
+    --n_episodes 4 --n_ep_fixed 2 --max_ep_len 4 --n_epochs 2 --H 1 \
+    --consensus_layout flat --fault_drop_p 0.2 --fault_nan_p 0.2 \
+    --sanitize --summary_dir "$smoke_dir" --quiet
+echo "flattened ragged-graph smoke cell OK"

@@ -10,10 +10,13 @@
 # timed-out measurement must not cost the rest of the session — and a
 # status summary prints at the end. In order of value:
 #   1. the N=64 / N=256 scaling rows x {xla, xla_sort, pallas,
-#      pallas_sort} (BENCH_SCALING.jsonl; the sort arms are the
-#      comparison rows for refitting PALLAS_CROSSOVER_VOLUME and the
-#      sort-vs-select crossover on-chip)
-#   2. per-phase TPU profile rows (PERF.jsonl; completes PERF.md's table)
+#      pallas_sort} (BENCH_SCALING.jsonl; 'xla' is now the log-depth
+#      TOURNAMENT selection — the sort arms are the comparison rows for
+#      refitting PALLAS_CROSSOVER_VOLUME and SELECT_MAX_N_IN on-chip)
+#   2. per-phase TPU profile rows incl. the dense n16/n64 shapes behind
+#      the CPU tournament crossover refit, with the consensus
+#      micro-breakdown (gather vs trim-bounds vs clip/mean) enabled
+#      (PERF.jsonl; completes PERF.md's table)
 #   3. a bfloat16 row for the 256-wide config (the MXU-native compute
 #      mode; its float32 comparator is step 1's n64_large_h2/xla row)
 #   4. the fused experiment matrix at the published scale - 16 cells x
@@ -53,10 +56,11 @@ run_step "1. scaling rows (n64/n256 x sort/select x xla/pallas)" \
     --configs n64_ring n64_full n64_large_h2 n256_ring \
     --impl xla xla_sort pallas pallas_sort --out BENCH_SCALING.jsonl
 
-run_step "2. per-phase profile rows (sort-vs-select arms)" \
+run_step "2. per-phase profile rows (tournament-vs-sort arms + micro)" \
     timeout 3600 python -m rcmarl_tpu profile \
-    --configs ref5_ring n64_large_h2 --impl xla xla_sort pallas pallas_sort \
-    --out PERF.jsonl
+    --configs ref5_ring n16_full n64_full n64_large_h2 \
+    --impl xla xla_sort pallas pallas_sort \
+    --consensus_micro --out PERF.jsonl
 
 run_step "3. bfloat16 row (256-wide config)" \
     timeout 1800 python -m rcmarl_tpu bench \

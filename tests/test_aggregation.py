@@ -211,12 +211,13 @@ class TestAutoImpl:
     def test_resolution_rules(self, monkeypatch):
         from rcmarl_tpu.ops import aggregation as agg
 
-        # non-TPU backend: the XLA family, selection vs sort by the
-        # measured n_in crossover (tests/test_selection.py pins the
-        # full 3-way policy)
+        # non-TPU backend: the XLA family — with the tournament strategy
+        # the measured rows favor selection at every n_in
+        # (SELECT_MAX_N_IN=None; tests/test_selection.py pins the full
+        # 3-way policy)
         monkeypatch.setattr(agg.jax, "default_backend", lambda: "cpu")
         assert agg.resolve_impl("auto", 4) == "xla"
-        assert agg.resolve_impl("auto", 64, n_agents=64) == "xla_sort"
+        assert agg.resolve_impl("auto", 64, n_agents=64) == "xla"
         # TPU backend: pallas from the measured volume crossover up
         # (n_in * n_agents is the key, so hold n_in at a selection-
         # friendly size and scale the agent axis)
@@ -227,7 +228,7 @@ class TestAutoImpl:
         # f64 never routes to the f32-computing kernel
         assert (
             agg.resolve_impl("auto", 64, np.float64, n_agents=64)
-            == "xla_sort"
+            == "xla"
         )
         assert agg.resolve_impl("auto", 16, np.float64, n_agents=64) == "xla"
         # explicit impls pass through untouched on every backend

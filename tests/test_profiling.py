@@ -7,7 +7,13 @@ import jax
 import jax.numpy as jnp
 
 from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
-from rcmarl_tpu.utils.profiling import Timer, profile_phases, trace
+from rcmarl_tpu.utils.profiling import (
+    Timer,
+    consensus_tags,
+    profile_consensus,
+    profile_phases,
+    trace,
+)
 
 
 def tiny_cfg():
@@ -47,6 +53,29 @@ def test_profile_phases_covers_training_subprograms():
         "full_block",
     }
     assert all(v > 0 for v in times.values())
+
+
+def test_profile_consensus_covers_components_and_tags():
+    """The consensus micro-breakdown: one timing per component the
+    crossover policies tune, plus the (n_in, H, volume) tags refits key
+    on — for both trim strategies."""
+    for impl in ("xla", "xla_sort"):
+        cfg = tiny_cfg().replace(consensus_impl=impl)
+        times = profile_consensus(cfg, reps=1)
+        assert set(times) == {
+            "gather",
+            "trim_bounds",
+            "clip_mean",
+            "consensus",
+            "phase1_fits",
+        }
+        assert all(v > 0 for v in times.values())
+    tags = consensus_tags(tiny_cfg())
+    assert tags["n_in"] == 2 and tags["H"] == 0 and tags["n_agents"] == 3
+    assert tags["volume"] == 6
+    # gathered volume = N * n_in * per-agent critic params
+    # ((8x6 + 8) + (8x8 + 8) + (8x1 + 1) = 137 params for hidden=(8,8))
+    assert tags["gathered_numel"] == 3 * 2 * 137
 
 
 def test_trace_writes_artifacts(tmp_path):

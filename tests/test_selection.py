@@ -90,12 +90,9 @@ class TestSelectMatchesSortBitwise:
     def test_traced_h(self, n_in, H):
         if 2 * H > n_in - 1:
             pytest.skip("H invalid for this n_in")
-        if n_in > 16:
-            # the traced-H selection variant carries k_max = (n_in-1)//2+1
-            # registers; at n_in=64 that is a 4096-op unroll whose compile
-            # time has no place in tier-1 (and 'auto' routes it to the
-            # sort variant anyway — pinned in test_traced_h_auto below)
-            pytest.skip("large-n traced selection excluded from tier-1")
+        # n_in=64 included: the tournament's k_max selection is ⌈log₂n⌉
+        # merge levels of block ops, so the compile-time blowup that made
+        # the PR-1 register chain skip large n (a 4096-op unroll) is gone
         vals = _vals(n_in, seed=2)
         want = resilient_aggregate(vals, H, impl="xla_sort")
         sel = jax.jit(
@@ -108,10 +105,11 @@ class TestSelectMatchesSortBitwise:
         np.testing.assert_array_equal(np.asarray(want), np.asarray(srt))
 
 
-def test_traced_h_auto_large_n_routes_to_sort():
-    """'auto' with a traced H keys on the STATIC worst-case register
-    count: at n_in=64 the sort variant lowers (no 4096-op unroll), and
-    the result still matches the static path bitwise."""
+def test_traced_h_auto_large_n_stays_selection():
+    """'auto' with a traced H keys on the STATIC worst-case trim
+    k_max = (n_in-1)//2+1: with the tournament that selection compiles
+    and wins even at n_in=64 (the register-chain era routed this to the
+    sort), and the result matches the static sort path bitwise."""
     vals = _vals(64, seed=3)
     out = jax.jit(
         lambda v, h: resilient_aggregate(v, h, impl="auto")
@@ -148,16 +146,20 @@ class TestThreeWayAutoPolicy:
         from rcmarl_tpu.ops import aggregation as agg
 
         monkeypatch.setattr(agg.jax, "default_backend", lambda: "cpu")
-        # measured epoch rows: selection wins every measured n_in up to
-        # 16 (ref5_ring 1.22x, n16_full 1.65x), for every legal H
+        # measured tournament epoch rows (PERF.md "sort vs select"):
+        # selection wins at EVERY measured n_in, including the dense
+        # n_in=64 shape the register chain lost — SELECT_MAX_N_IN=None
+        # means no sort crossover exists on this host
+        assert agg.SELECT_MAX_N_IN is None
         assert agg.resolve_impl("auto", 4, H=1) == "xla"
         assert agg.resolve_impl("auto", 16, H=7) == "xla"
-        # measured: n64_full epoch LOSES even at the friendliest k=2
-        # (0.64x) — the row-slice traffic swamps the saved ops — so H
-        # cannot flip the verdict above the n_in threshold
+        assert agg.resolve_impl("auto", 64, H=1) == "xla"
+        assert agg.resolve_impl("auto", 64, H=31) == "xla"
+        assert agg.resolve_impl("auto", 64) == "xla"
+        # a future refit to a finite threshold re-introduces the sort arm
+        monkeypatch.setattr(agg, "SELECT_MAX_N_IN", 16)
+        assert agg.resolve_impl("auto", 16, H=1) == "xla"
         assert agg.resolve_impl("auto", 64, H=1) == "xla_sort"
-        assert agg.resolve_impl("auto", 64, H=31) == "xla_sort"
-        assert agg.resolve_impl("auto", 64) == "xla_sort"
 
     def test_tpu_volume_beats_xla_family(self, monkeypatch):
         from rcmarl_tpu.ops import aggregation as agg
@@ -174,7 +176,7 @@ class TestThreeWayAutoPolicy:
         )
         assert (
             agg.resolve_impl("auto", 64, np.float64, n_agents=64, H=5)
-            == "xla_sort"
+            == "xla"
         )
 
     def test_explicit_impls_stick(self):
@@ -189,7 +191,7 @@ class TestThreeWayAutoPolicy:
         from rcmarl_tpu.ops import aggregation as agg
 
         assert agg._resolve_masked("auto", 5, 1) == "xla"
-        assert agg._resolve_masked("auto", 64, 1) == "xla_sort"
+        assert agg._resolve_masked("auto", 64, 1) == "xla"
         assert agg._resolve_masked("pallas", 5, 1) == "xla"
         assert agg._resolve_masked("pallas_interpret", 5, 1) == "xla"
         assert agg._resolve_masked("pallas_sort", 5, 1) == "xla_sort"

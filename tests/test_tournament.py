@@ -1,0 +1,315 @@
+"""Tournament selection + flattened one-launch layout: BITWISE contracts.
+
+Two tentpole mechanisms are pinned here:
+
+1. **Log-depth tournament selection** (``ops/aggregation.py:_k_smallest``
+   / ``_k_largest``): chunk the stacked neighbor axis, bitonic-sort
+   within chunks, pairwise-merge sorted k-prefixes/suffixes up a binary
+   tree — whole-block min/max only, no unstacked row slices. Selection
+   returns exact input values, so every aggregate it feeds must equal
+   the ``xla_sort`` arm bitwise across (n_in, H, masked, sanitize,
+   traced-H) — including odd / non-power-of-two n_in (the tournament
+   pads with ±inf sentinels) and inputs that already carry ±inf
+   sentinels (sanitize sinks, masked slots), where a pad and a real
+   sentinel share one bit pattern.
+
+2. **Flattened one-launch tree layout**
+   (``resilient_aggregate_tree(layout='flat')``): every leaf raveled
+   into one (n_in, P_total) block. Raveling is elementwise-neutral, so
+   the flat path must match the historical per-leaf path LEAF-FOR-LEAF,
+   in every mode.
+
+tests/test_selection.py keeps the register-chain-era deterministic
+matrix (the helpers still back the Pallas kernel); this module is the
+tournament-specific coverage, with hypothesis twins at the bottom.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rcmarl_tpu.ops.aggregation import (
+    _k_largest,
+    _k_smallest,
+    ravel_neighbor_tree,
+    resilient_aggregate,
+    resilient_aggregate_tree,
+)
+
+# deliberately odd / non-power-of-two heavy: the tournament's chunk
+# padding and odd-node-count carry paths must all be exercised. The
+# primitive test sweeps the full list; the aggregate-mode matrix runs a
+# trimmed grid (each extra cell is 2+ jit compiles) that still covers
+# odd, even-non-pow2, pow2, and the dense-64 shape.
+N_INS = [2, 3, 5, 6, 7, 9, 12, 13, 16, 17, 33, 64]
+N_INS_MODES = [3, 5, 6, 9, 12, 64]
+HS = [0, 1, 3]
+
+
+def _vals(n_in, m=19, seed=0, ties=False, infs=False):
+    rng = np.random.default_rng(seed + 1000 * n_in)
+    v = rng.normal(size=(n_in, m)).astype(np.float32)
+    if ties and n_in > 2:
+        v[1] = v[0]
+        v[n_in // 2] = v[0]
+    if infs:
+        v = np.where(rng.random(v.shape) < 0.3, np.inf, v)
+        v = np.where(rng.random(v.shape) < 0.15, -np.inf, v)
+        v = v.astype(np.float32)
+    return jnp.asarray(v)
+
+
+class TestTournamentPrimitive:
+    """_k_smallest / _k_largest == the sort prefix/suffix, bitwise, for
+    every k up to n — the raw selection contract everything else rides."""
+
+    @pytest.mark.parametrize("n", N_INS)
+    def test_matches_sort_prefix_suffix(self, n):
+        # ties + ±inf payloads in one input: both tie-handling and the
+        # sentinel/pad interplay are always exercised
+        for variant in ({"ties": True}, {"infs": True}):
+            vals = _vals(n, seed=1, **variant)
+            ref = np.sort(np.asarray(vals), axis=0)
+            ks = sorted({1, 2, (n - 1) // 2 + 1, n})
+            for k in ks:
+                if k > n:
+                    continue
+                np.testing.assert_array_equal(
+                    np.asarray(_k_smallest(vals, k)), ref[:k]
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(_k_largest(vals, k)), ref[n - k :]
+                )
+
+    def test_under_vmap_and_jit(self):
+        vals = _vals(7, seed=2)
+        batched = jnp.stack([vals + i for i in range(5)])  # (5, 7, m)
+        out = jax.jit(jax.vmap(lambda v: _k_smallest(v, 3)))(batched)
+        ref = np.sort(np.asarray(batched), axis=1)[:, :3]
+        np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+@pytest.mark.parametrize("n_in", N_INS_MODES)
+@pytest.mark.parametrize("H", HS)
+class TestTournamentAggregateMatchesSort:
+    """Full aggregation, tournament ('xla') vs full sort ('xla_sort'),
+    across the mode matrix."""
+
+    def _skip_invalid(self, n_in, H):
+        if 2 * H > n_in - 1:
+            pytest.skip("H invalid for this n_in")
+
+    def test_static_h(self, n_in, H):
+        self._skip_invalid(n_in, H)
+        vals = _vals(n_in, ties=True)
+        a = resilient_aggregate(vals, H, impl="xla_sort")
+        b = resilient_aggregate(vals, H, impl="xla")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_sanitize_with_nonfinite_payloads(self, n_in, H):
+        """±inf/NaN bombs + the sanitize sinks: tournament pads (±inf)
+        meet real ±inf sentinels and the aggregate must still be
+        bitwise-equal to the sort arm."""
+        self._skip_invalid(n_in, H)
+        vals = np.asarray(_vals(n_in, seed=3, infs=True))
+        rng = np.random.default_rng(7 + n_in)
+        vals = np.where(rng.random(vals.shape) < 0.1, np.nan, vals).astype(
+            np.float32
+        )
+        vals = jnp.asarray(vals)
+        a = resilient_aggregate(vals, H, impl="xla_sort", sanitize=True)
+        b = resilient_aggregate(vals, H, impl="xla", sanitize=True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_masked(self, n_in, H):
+        if n_in < 4:
+            pytest.skip("needs padding room")
+        d = n_in - 2
+        if 2 * H > d - 1:
+            pytest.skip("H invalid for the valid count")
+        vals = _vals(n_in, seed=4)
+        vals = vals.at[d:].set(jnp.nan)  # garbage in padded slots
+        valid = jnp.asarray([1.0] * d + [0.0] * (n_in - d))
+        a = resilient_aggregate(vals, H, impl="xla_sort", valid=valid)
+        b = resilient_aggregate(vals, H, impl="xla", valid=valid)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_traced_h(self, n_in, H):
+        self._skip_invalid(n_in, H)
+        vals = _vals(n_in, seed=5, ties=True)
+        want = resilient_aggregate(vals, H, impl="xla_sort")
+        got = jax.jit(lambda v, h: resilient_aggregate(v, h, impl="xla"))(
+            vals, jnp.int32(H)
+        )
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+    def test_traced_h_sanitized(self, n_in, H):
+        self._skip_invalid(n_in, H)
+        vals = _vals(n_in, seed=6, infs=True)
+        want = jax.jit(
+            lambda v, h: resilient_aggregate(
+                v, h, impl="xla_sort", sanitize=True
+            )
+        )(vals, jnp.int32(H))
+        got = jax.jit(
+            lambda v, h: resilient_aggregate(v, h, impl="xla", sanitize=True)
+        )(vals, jnp.int32(H))
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+# --------------------------------------------------------------------------
+# Flattened one-launch layout
+# --------------------------------------------------------------------------
+
+
+def _tree(n_in, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "W1": jnp.asarray(rng.normal(size=(n_in, 4, 6)).astype(np.float32)),
+        "b1": jnp.asarray(rng.normal(size=(n_in, 6)).astype(np.float32)),
+        "W2": jnp.asarray(rng.normal(size=(n_in, 6, 3)).astype(np.float32)),
+        "b2": jnp.asarray(rng.normal(size=(n_in, 3)).astype(np.float32)),
+    }
+
+
+def test_ravel_neighbor_tree_roundtrip():
+    tree = _tree(5)
+    flat, unravel = ravel_neighbor_tree(tree)
+    assert flat.shape == (5, 4 * 6 + 6 + 6 * 3 + 3)
+    back = unravel(flat[0])
+    for k in tree:
+        np.testing.assert_array_equal(
+            np.asarray(back[k]), np.asarray(tree[k][0])
+        )
+
+
+def test_ravel_rejects_mismatched_neighbor_dim():
+    tree = {"a": jnp.zeros((4, 2)), "b": jnp.zeros((5, 2))}
+    with pytest.raises(ValueError, match="leading neighbor dim"):
+        ravel_neighbor_tree(tree)
+
+
+class TestFlatLayoutMatchesPerLeaf:
+    """layout='flat' vs layout='per_leaf', leaf for leaf, bitwise, in
+    every mode — the regression pin for the one-launch restructuring."""
+
+    def _check(self, n_in=5, H=2, **kw):
+        tree = _tree(n_in, seed=n_in)
+        for impl in ("xla", "xla_sort"):
+            a = resilient_aggregate_tree(
+                tree, H, impl=impl, layout="flat", **kw
+            )
+            b = resilient_aggregate_tree(
+                tree, H, impl=impl, layout="per_leaf", **kw
+            )
+            for k in tree:
+                np.testing.assert_array_equal(
+                    np.asarray(a[k]), np.asarray(b[k])
+                )
+
+    def test_static_h(self):
+        self._check()
+
+    def test_h0_short_circuit(self):
+        self._check(H=0)
+
+    def test_sanitize(self):
+        self._check(sanitize=True)
+
+    def test_masked(self):
+        self._check(H=1, valid=jnp.asarray([1.0, 1.0, 1.0, 0.0, 0.0]))
+
+    def test_masked_sanitize(self):
+        self._check(
+            H=1,
+            valid=jnp.asarray([1.0, 1.0, 1.0, 0.0, 0.0]),
+            sanitize=True,
+        )
+
+    def test_traced_h(self):
+        tree = _tree(7, seed=7)
+        a = jax.jit(
+            lambda t, h: resilient_aggregate_tree(t, h, layout="flat")
+        )(tree, jnp.int32(2))
+        b = jax.jit(
+            lambda t, h: resilient_aggregate_tree(t, h, layout="per_leaf")
+        )(tree, jnp.int32(2))
+        for k in tree:
+            np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
+
+    def test_under_agent_vmap(self):
+        """The consensus layer's actual shape: (N, n_in, ...) leaves,
+        vmapped over agents."""
+        base = _tree(5, seed=11)
+        stacked = jax.tree.map(
+            lambda l: jnp.stack([l * (i + 1) for i in range(4)]), base
+        )  # (4, 5, ...) leaves
+        a = jax.vmap(
+            lambda t: resilient_aggregate_tree(t, 1, layout="flat")
+        )(stacked)
+        b = jax.vmap(
+            lambda t: resilient_aggregate_tree(t, 1, layout="per_leaf")
+        )(stacked)
+        for k in base:
+            np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
+
+    def test_mixed_dtype_falls_back_to_per_leaf(self):
+        tree = {
+            "a": jnp.asarray(
+                np.random.default_rng(0).normal(size=(5, 3)), jnp.float32
+            ),
+            "b": jnp.ones((5, 2), jnp.bfloat16),
+        }
+        out = resilient_aggregate_tree(tree, 1, layout="flat")
+        assert out["a"].dtype == jnp.float32
+        assert out["b"].dtype == jnp.bfloat16
+
+    def test_unknown_layout_rejected(self):
+        with pytest.raises(ValueError, match="unknown layout"):
+            resilient_aggregate_tree(_tree(5), 1, layout="stacked")
+
+
+def test_flat_layout_end_to_end_block_matches_per_leaf():
+    """One full training block under consensus_layout='flat' must
+    reproduce 'per_leaf' bit-for-bit (raveling is elementwise-neutral,
+    so the whole trajectory is identical)."""
+    from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+    from rcmarl_tpu.training.trainer import init_train_state, train_block
+
+    kw = dict(
+        n_agents=4,
+        agent_roles=(Roles.COOPERATIVE,) * 3 + (Roles.GREEDY,),
+        in_nodes=circulant_in_nodes(4, 4),
+        H=1,
+        nrow=3,
+        ncol=3,
+        max_ep_len=4,
+        n_ep_fixed=2,
+        n_epochs=2,
+        buffer_size=16,
+        hidden=(8, 8),
+        coop_fit_steps=2,
+        adv_fit_epochs=1,
+        adv_fit_batch=4,
+        batch_size=4,
+        n_episodes=2,
+    )
+    cfg_flat = Config(**kw, consensus_layout="flat")
+    cfg_leaf = Config(**kw, consensus_layout="per_leaf")
+    s0 = init_train_state(cfg_flat, jax.random.PRNGKey(0))
+    s_flat, m_flat = train_block(cfg_flat, s0)
+    s_leaf, m_leaf = train_block(cfg_leaf, s0)
+    for a, b in zip(
+        jax.tree.leaves(s_flat.params), jax.tree.leaves(s_leaf.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(
+        np.asarray(m_flat.true_team_returns),
+        np.asarray(m_leaf.true_team_returns),
+    )
+
+
+# Hypothesis twins live in tests/test_tournament_properties.py, guarded
+# by importorskip — this module is the deterministic matrix that always
+# runs (same split as test_selection.py / test_selection_properties.py).

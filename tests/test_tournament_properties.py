@@ -1,0 +1,98 @@
+"""Property-based tournament-vs-sort equivalence (hypothesis).
+
+Randomized twin of tests/test_tournament.py's deterministic matrix:
+over arbitrary f32 inputs (duplicates, adversarial magnitudes, ±inf
+payloads, NaN injections), the log-depth tournament selection and the
+flattened one-launch tree layout must reproduce the sort-based
+aggregation BITWISE. Guarded like the other property modules: a missing
+hypothesis (the `test` extra) is a skip, never a collection error.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+from hypothesis.extra.numpy import arrays
+
+from rcmarl_tpu.ops.aggregation import (
+    _k_largest,
+    _k_smallest,
+    resilient_aggregate,
+    resilient_aggregate_tree,
+)
+
+finite = st.floats(-1e6, 1e6, allow_nan=False, width=32)
+with_infs = st.floats(
+    -1e6, 1e6, allow_nan=False, allow_infinity=True, width=32
+)
+
+
+@st.composite
+def vals_k(draw, min_n=1, max_n=17, m=5, elements=finite):
+    n = draw(st.integers(min_n, max_n))
+    k = draw(st.integers(1, n))
+    vals = draw(arrays(np.float32, (n, m), elements=elements))
+    return vals, k
+
+
+@settings(max_examples=60, deadline=None)
+@given(vals_k(elements=with_infs))
+def test_tournament_primitive_matches_sort(case):
+    vals, k = case
+    ref = np.sort(vals, axis=0)
+    np.testing.assert_array_equal(
+        np.asarray(_k_smallest(jnp.asarray(vals), k)), ref[:k]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(_k_largest(jnp.asarray(vals), k)), ref[vals.shape[0] - k :]
+    )
+
+
+@st.composite
+def vals_and_h(draw, min_n=3, max_n=13, m=5, elements=finite):
+    n = draw(st.integers(min_n, max_n))
+    H = draw(st.integers(0, (n - 1) // 2))
+    vals = draw(arrays(np.float32, (n, m), elements=elements))
+    return vals, H
+
+
+@settings(max_examples=40, deadline=None)
+@given(vals_and_h())
+def test_tournament_aggregate_matches_sort(case):
+    vals, H = case
+    a = resilient_aggregate(jnp.asarray(vals), H, impl="xla_sort")
+    b = resilient_aggregate(jnp.asarray(vals), H, impl="xla")
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@settings(max_examples=30, deadline=None)
+@given(vals_and_h(elements=with_infs), st.integers(0, 2**31 - 1))
+def test_sanitized_tournament_matches_sort(case, nan_seed):
+    """Random ±inf payloads plus random NaN injection: the sanitize
+    sinks and the tournament's ±inf pads must coexist bitwise."""
+    vals, H = case
+    rng = np.random.default_rng(nan_seed)
+    vals = np.where(rng.random(vals.shape) < 0.15, np.nan, vals).astype(
+        np.float32
+    )
+    a = resilient_aggregate(jnp.asarray(vals), H, impl="xla_sort", sanitize=True)
+    b = resilient_aggregate(jnp.asarray(vals), H, impl="xla", sanitize=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@settings(max_examples=25, deadline=None)
+@given(vals_and_h(min_n=4, max_n=9))
+def test_flat_tree_matches_per_leaf(case):
+    vals, H = case
+    tree = {
+        "a": jnp.asarray(vals),
+        "b": jnp.asarray(vals[:, :3] * 2.0 + 1.0),
+    }
+    a = resilient_aggregate_tree(tree, H, layout="flat")
+    b = resilient_aggregate_tree(tree, H, layout="per_leaf")
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
