@@ -52,7 +52,9 @@ PROBE_ATTEMPTS = 3
 BACKOFF_S = 30.0
 
 
-def _measure(n_seeds: int, n_blocks: int, reps: int) -> None:
+def _measure(
+    n_seeds: int, n_blocks: int, reps: int, netstack: str = "auto"
+) -> None:
     """Child: run ONE measurement on whatever backend JAX_PLATFORMS says.
 
     One replica count per child process: a candidate that OOMs, hangs, or
@@ -78,7 +80,16 @@ def _measure(n_seeds: int, n_blocks: int, reps: int) -> None:
     # headline numbers remain trajectory-comparable across rounds; the
     # sort-vs-select A/B arms live in `python -m rcmarl_tpu bench/profile
     # --impl xla xla_sort pallas pallas_sort [--layout flat per_leaf]`).
-    cfg = Config(slow_lr=0.002, fast_lr=0.01, seed=100)
+    # netstack (round 8: the critic+TR one-block epoch, pinned equivalent
+    # to the dual-launch arm; default 'auto' = stacked on TPU, dual on
+    # CPU — the measured backend policy, PERF.md "netstack") can be
+    # forced with `python bench.py --netstack on|off` for an A/B
+    # headline; the per-config arms live in
+    # `python -m rcmarl_tpu bench --netstack on off`.
+    cfg = Config(
+        slow_lr=0.002, fast_lr=0.01, seed=100,
+        netstack={"on": True, "off": False}.get(netstack, "auto"),
+    )
 
     def fetch(states, metrics):
         """Force completion: pull a scalar depending on every replica."""
@@ -115,6 +126,7 @@ def _measure(n_seeds: int, n_blocks: int, reps: int) -> None:
                     "blocks": n_blocks,
                     "reps": reps,
                     "block_steps": cfg.block_steps,
+                    "netstack": cfg.netstack,
                 },
             }
         )
@@ -129,6 +141,16 @@ def _probe() -> None:
     x = jnp.ones((128, 128))
     assert float((x @ x).sum()) == 128.0 * 128 * 128
     print(json.dumps({"probe": "ok", "platform": jax.devices()[0].platform}))
+
+
+def _netstack_arg(argv) -> str:
+    """The validated value of a --netstack flag in ``argv`` (a missing or
+    out-of-set value is a hard error, not a silent 'auto' fallback — a
+    TPU A/B round must not quietly measure the wrong arm)."""
+    i = argv.index("--netstack")
+    if i + 1 >= len(argv) or argv[i + 1] not in ("on", "off", "auto"):
+        sys.exit("--netstack requires one of: on, off, auto")
+    return argv[i + 1]
 
 
 def _run_child(argv, env_overrides, timeout_s):
@@ -161,6 +183,14 @@ def _run_child(argv, env_overrides, timeout_s):
 
 
 def main() -> int:
+    # headline A/B arm: `python bench.py --netstack on|off` forces the
+    # stacked / dual-launch arm in every child measurement (default:
+    # the 'auto' backend policy)
+    netstack_argv = (
+        ["--netstack", _netstack_arg(sys.argv)]
+        if "--netstack" in sys.argv
+        else []
+    )
     attempts = []
     # 1-3: probe the TPU, with bounded retries + backoff on any failure
     # (covers both the fast RuntimeError and the silent-hang mode).
@@ -186,7 +216,7 @@ def main() -> int:
         for n_seeds in (32, 128, 512):
             res = _run_child(
                 ["--child", "--seeds", str(n_seeds), "--blocks", "10",
-                 "--reps", "3"],
+                 "--reps", "3", *netstack_argv],
                 {},
                 TPU_TIMEOUT_S,
             )
@@ -208,7 +238,8 @@ def main() -> int:
     # Fallback: a smaller CPU measurement — still a real end-to-end number
     # the driver can parse, honestly tagged with its platform.
     res = _run_child(
-        ["--child", "--seeds", "4", "--blocks", "2", "--reps", "1"],
+        ["--child", "--seeds", "4", "--blocks", "2", "--reps", "1",
+         *netstack_argv],
         {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
         CPU_TIMEOUT_S,
     )
@@ -254,6 +285,7 @@ if __name__ == "__main__":
             n_seeds=int(args[args.index("--seeds") + 1]),
             n_blocks=int(args[args.index("--blocks") + 1]),
             reps=int(args[args.index("--reps") + 1]),
+            netstack=_netstack_arg(args) if "--netstack" in args else "auto",
         )
     else:
         sys.exit(main())

@@ -42,15 +42,23 @@ from rcmarl_tpu.models.mlp import (
     MLPParams,
     actor_probs,
     einsum,
+    flatten_input,
     head_forward,
     mlp_forward,
+    pad_features,
+    pad_rows,
+    trunk_apply,
     trunk_forward,
 )
 from rcmarl_tpu.ops.aggregation import (
     resilient_aggregate,
     resilient_aggregate_tree,
 )
-from rcmarl_tpu.ops.fit import fit_full_batch, fit_minibatch
+from rcmarl_tpu.ops.fit import (
+    fit_minibatch,
+    fit_mse_full_batch,
+    fit_mse_minibatch,
+)
 from rcmarl_tpu.ops.losses import weighted_mse, weighted_sparse_ce
 from rcmarl_tpu.ops.optim import AdamState, adam_update
 
@@ -121,6 +129,12 @@ class Batch(NamedTuple):
 # --------------------------------------------------------------------------
 
 
+def _fwd(cfg: Config):
+    """The forward pass every critic/TR fit regresses with (the nets use
+    the reference-hardcoded LeakyReLU alpha=0.1 default)."""
+    return lambda p, x: mlp_forward(p, x, dtype=cfg.dot_dtype)
+
+
 def coop_local_critic_fit(
     critic: MLPParams, s, ns, r, mask, cfg: Config
 ) -> Tuple[MLPParams, jnp.ndarray]:
@@ -130,13 +144,11 @@ def coop_local_critic_fit(
     caller keeps the agent's own critic unchanged (restore semantics).
     Returns (message_params, first_step_loss) — the loss mirrors the
     reference's ``history['loss'][0]`` second return value."""
-    target = r + cfg.gamma * mlp_forward(critic, ns, dtype=cfg.dot_dtype)
-    target = jax.lax.stop_gradient(target)
-
-    def loss(p):
-        return weighted_mse(mlp_forward(p, s, dtype=cfg.dot_dtype), target, mask=mask)
-
-    return fit_full_batch(critic, loss, cfg.coop_fit_steps, cfg.fast_lr)
+    fwd = _fwd(cfg)
+    target = r + cfg.gamma * fwd(critic, ns)
+    return fit_mse_full_batch(
+        critic, fwd, s, target, mask, cfg.coop_fit_steps, cfg.fast_lr
+    )
 
 
 def coop_local_tr_fit(
@@ -145,11 +157,9 @@ def coop_local_tr_fit(
     """Cooperative local team-reward fit (resilient_CAC_agents.py:124-140):
     same 5-step full-batch SGD, target = local reward (no bootstrap).
     Returns (message_params, first_step_loss)."""
-
-    def loss(p):
-        return weighted_mse(mlp_forward(p, sa, dtype=cfg.dot_dtype), r, mask=mask)
-
-    return fit_full_batch(tr, loss, cfg.coop_fit_steps, cfg.fast_lr)
+    return fit_mse_full_batch(
+        tr, _fwd(cfg), sa, r, mask, cfg.coop_fit_steps, cfg.fast_lr
+    )
 
 
 def adv_critic_fit(
@@ -161,23 +171,12 @@ def adv_critic_fit(
     237-239). The update PERSISTS (no restore). Returns
     (params, first_epoch_mean_loss) — the reference's
     ``history['loss'][0]`` second return value."""
-    target = r_target + cfg.gamma * mlp_forward(critic, ns, dtype=cfg.dot_dtype)
-    target = jax.lax.stop_gradient(target)
-
-    def batch_loss(p, idx, bval):
-        return weighted_mse(mlp_forward(p, s[idx], dtype=cfg.dot_dtype), target[idx], mask=bval)
-
-    out, _, loss = fit_minibatch(
-        key,
-        critic,
-        batch_loss,
-        capacity=s.shape[0],
-        mask=mask,
-        epochs=cfg.adv_fit_epochs,
-        batch_size=cfg.adv_fit_batch,
-        lr=cfg.fast_lr,
+    fwd = _fwd(cfg)
+    target = r_target + cfg.gamma * fwd(critic, ns)
+    return fit_mse_minibatch(
+        key, critic, fwd, s, target, mask,
+        cfg.adv_fit_epochs, cfg.adv_fit_batch, cfg.fast_lr,
     )
-    return out, loss
 
 
 def adv_tr_fit(
@@ -186,21 +185,98 @@ def adv_tr_fit(
     """Adversary team-reward fit: fit(epochs=10, batch_size=32) toward the
     (possibly compromised) reward (adversarial_CAC_agents.py:154-165,
     243-253). Returns (params, first_epoch_mean_loss)."""
-
-    def batch_loss(p, idx, bval):
-        return weighted_mse(mlp_forward(p, sa[idx], dtype=cfg.dot_dtype), r_target[idx], mask=bval)
-
-    out, _, loss = fit_minibatch(
-        key,
-        tr,
-        batch_loss,
-        capacity=sa.shape[0],
-        mask=mask,
-        epochs=cfg.adv_fit_epochs,
-        batch_size=cfg.adv_fit_batch,
-        lr=cfg.fast_lr,
+    return fit_mse_minibatch(
+        key, tr, _fwd(cfg), sa, r_target, mask,
+        cfg.adv_fit_epochs, cfg.adv_fit_batch, cfg.fast_lr,
     )
-    return out, loss
+
+
+# --------------------------------------------------------------------------
+# Netstack: critic + TR fits as ONE (net, agent)-vmapped program
+# --------------------------------------------------------------------------
+#
+# ``Config.netstack`` stacks the critic and team-reward families along a
+# leading net axis (models/mlp.py:netstack_stack — critic inputs/first-
+# layer rows zero-padded to the TR width, exactly gradient-neutral), so
+# each phase-I fit flavor launches ONE scan over (2, N) stacked nets
+# instead of two N-stacked scans, and phase II aggregates both message
+# trees as one combined block (:func:`consensus_update_pair`). Net 0 is
+# the critic, net 1 the TR net. Both nets regress toward FIXED
+# precomputed targets (:func:`pair_bootstrap_targets`: net 0 gets the TD
+# bootstrap, net 1 the raw reward), which is how one program serves both
+# target rules without the stacked loop paying a per-net branch.
+
+
+def netstack_pair_inputs(cfg: Config, s, sa) -> jnp.ndarray:
+    """The shared stacked fit/feature input for the critic+TR netstack:
+    ``(2, B, sa_dim)`` — net 0 the zero-padded flattened critic input
+    (s), net 1 the flattened TR input (sa)."""
+    return jnp.stack(
+        [pad_features(flatten_input(s), cfg.sa_dim), flatten_input(sa)]
+    )
+
+
+def pair_bootstrap_targets(cfg: Config, critic, ns, r, v=None) -> jnp.ndarray:
+    """(2, N, B, 1) regression targets for one critic+TR fit pair:
+    net 0 = ``r + gamma * V(ns)`` (TD bootstrap with the PRE-FIT critic),
+    net 1 = ``r`` (the TR net regresses the raw reward, no bootstrap).
+
+    The bootstrap forward runs ONCE at the critic's unpadded width — the
+    dual arm computes the identical ``mlp_forward(critic, ns)`` inside
+    each critic fit flavor, so reusing one evaluation across the coop /
+    greedy / malicious pairs is a strict flop saving in mixed-role
+    configs, and the targets stay bitwise the dual arm's. Pass a
+    precomputed ``v`` (the (N, B, 1) bootstrap values) to share it
+    across several target calls, as the netstack epoch does.
+    """
+    if v is None:
+        v = jax.vmap(lambda p: mlp_forward(p, ns, dtype=cfg.dot_dtype))(critic)
+    return jnp.stack([r + cfg.gamma * v, jnp.broadcast_to(r, v.shape)])
+
+
+def coop_pair_fit(stack2, x2, targets2, mask, cfg: Config):
+    """Phase-I cooperative critic+TR fits as ONE (net, agent)-vmapped
+    full-batch scan — the netstack twin of
+    :func:`coop_local_critic_fit` + :func:`coop_local_tr_fit`.
+
+    ``stack2``: netstacked params, leaves ``(2, N, ...)``; ``x2``:
+    :func:`netstack_pair_inputs`; ``targets2``: ``(2, N, B, 1)``
+    precomputed regression targets (:func:`pair_bootstrap_targets`).
+    Returns the stacked messages (leaves ``(2, N, ...)``) and ``(2, N)``
+    losses.
+    """
+    fwd = _fwd(cfg)
+
+    def fit_one(p, x, t):
+        return fit_mse_full_batch(
+            p, fwd, x, t, mask, cfg.coop_fit_steps, cfg.fast_lr
+        )
+
+    per_agent = jax.vmap(fit_one, in_axes=(0, None, 0))
+    return jax.vmap(per_agent, in_axes=(0, 0, 0))(stack2, x2, targets2)
+
+
+def adv_pair_fit(keys2, stack2, x2, targets2, mask, cfg: Config):
+    """Phase-I adversary critic+TR fit pair as ONE (net, agent)-vmapped
+    minibatch program — the netstack twin of :func:`adv_critic_fit` +
+    :func:`adv_tr_fit` (used for both the greedy and the malicious
+    compromised pair; the malicious PRIVATE critic fit stays unpaired).
+
+    ``keys2``: ``(2, N)`` PRNG keys — per net the same ``split(key, N)``
+    stream the dual-launch arm draws, so shuffles are identical.
+    """
+    fwd = _fwd(cfg)
+
+    def fit_one(k, p, x, t):
+        return fit_mse_minibatch(
+            k, p, fwd, x, t, mask,
+            cfg.adv_fit_epochs, cfg.adv_fit_batch, cfg.fast_lr,
+        )
+
+    per_agent = jax.vmap(fit_one, in_axes=(0, 0, None, 0))
+    return jax.vmap(per_agent, in_axes=(0, 0, 0, 0))(
+        keys2, stack2, x2, targets2
+    )
 
 
 # --------------------------------------------------------------------------
@@ -284,6 +360,122 @@ def consensus_update_one(
     # d) normalized team update of the head only
     new_head = team_head_update(new_params[-1], phi, agg, cfg, mask=mask)
     return tuple(trunk_agg) + (new_head,)
+
+
+def _unravel_cols(vec: jnp.ndarray, tree):
+    """Split a flat (P,) column vector back into a pytree of leaves
+    (shapes taken from ``tree``; no leading neighbor axis)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    out, off = [], 0
+    for l in leaves:
+        out.append(vec[off : off + l.size].reshape(l.shape))
+        off += l.size
+    return jax.tree.unflatten(treedef, out)
+
+
+def consensus_update_pair(
+    own_c: MLPParams,
+    own_t: MLPParams,
+    blk: jnp.ndarray,
+    x2: jnp.ndarray,
+    mask: jnp.ndarray,
+    cfg: Config,
+    valid: jnp.ndarray | None = None,
+    H=None,
+) -> Tuple[MLPParams, MLPParams]:
+    """Full Phase-II update for ONE agent's critic AND TR nets from one
+    COMBINED raveled neighbor block (the netstack mode twin of two
+    :func:`consensus_update_one` calls).
+
+    Args:
+      own_c/own_t: the agent's current critic / team-reward nets.
+      blk: (n_in, P_critic + P_tr) gathered neighbor messages, own at
+        index 0, columns laid out trunks-first:
+        ``[trunk_c | trunk_t | head_c | head_t]`` (the ravel order of
+        ``((trunk_c, trunk_t), (head_c, head_t))`` —
+        ``training/update.py`` builds it with
+        :func:`~rcmarl_tpu.ops.aggregation.ravel_neighbor_tree`).
+      x2: (2, B, sa_dim) stacked flattened net inputs (net 0 = padded
+        critic input, net 1 = TR input) — :func:`netstack_pair_inputs`.
+
+    Steps b-d of the reference's Phase II, each launched ONCE for both
+    trees: (b) one trim/clip/mean over the combined trunk columns, (c)
+    one stacked trunk forward + one projection einsum over both head
+    families, (d) one (net,)-vmapped normalized team head step. Bitwise
+    column-equal to the two per-tree aggregations (aggregation is
+    elementwise along the trailing axis).
+    """
+    H = cfg.H if H is None else H
+    impl = cfg.consensus_impl
+    sanitize = cfg.consensus_sanitize
+    trunk_c, trunk_t = own_c[:-1], own_t[:-1]
+    P_c = sum(l.size for l in jax.tree.leaves(trunk_c))
+    P_t = sum(l.size for l in jax.tree.leaves(trunk_t))
+    n_in = blk.shape[0]
+    # b) hidden consensus: ONE clip-mean over the combined trunk columns
+    if P_c + P_t:
+        agg = resilient_aggregate(
+            blk[:, : P_c + P_t],
+            H,
+            impl,
+            valid=valid,
+            n_agents=cfg.n_agents,
+            sanitize=sanitize,
+        )
+        new_trunk_c = _unravel_cols(agg[:P_c], trunk_c)
+        new_trunk_t = _unravel_cols(agg[P_c:], trunk_t)
+    else:  # head-only (hidden=()) nets: nothing to aggregate
+        new_trunk_c, new_trunk_t = trunk_c, trunk_t
+    # c) projection: per-net trunk features (each at its own unpadded
+    # first-layer width — bitwise the dual arm's phi, no padding FLOPs),
+    # then ONE einsum over both head families and ONE aggregation of the
+    # stacked per-sample estimates
+    h_c = own_c[-1][0].shape[0]
+    h_t = own_t[-1][0].shape[0]
+    h_max = max(h_c, h_t)
+    x_c = x2[0, :, : own_c[0][0].shape[-2]]  # un-pad: zeros are appended
+    if P_c + P_t:
+        phi2 = jnp.stack([
+            trunk_apply(new_trunk_c, x_c, cfg.leaky_alpha, cfg.dot_dtype),
+            trunk_apply(new_trunk_t, x2[1], cfg.leaky_alpha, cfg.dot_dtype),
+        ])  # (2, B, h)
+    else:  # head-only nets: the flattened inputs ARE the features
+        phi2 = jnp.stack([pad_features(x_c, h_max), x2[1]])
+    off = P_c + P_t
+    W_c_nbr = blk[:, off : off + h_c].reshape(n_in, h_c, 1)
+    b_c_nbr = blk[:, off + h_c : off + h_c + 1]
+    off += h_c + 1
+    W_t_nbr = blk[:, off : off + h_t].reshape(n_in, h_t, 1)
+    b_t_nbr = blk[:, off + h_t : off + h_t + 1]
+    W2_nbr = jnp.stack(
+        [pad_rows(W_c_nbr, h_max), pad_rows(W_t_nbr, h_max)]
+    )  # (2, n_in, h_max, 1)
+    b2_nbr = jnp.stack([b_c_nbr, b_t_nbr])  # (2, n_in, 1)
+    proj = einsum("kbh,knho->knbo", phi2, W2_nbr, dtype=cfg.dot_dtype)
+    vals = proj + b2_nbr[:, :, None, :]  # (2, n_in, B, 1)
+    agg2 = resilient_aggregate(
+        jnp.moveaxis(vals, 0, 1),  # (n_in, 2, B, 1): neighbor axis leads
+        H,
+        impl,
+        valid=valid,
+        n_agents=cfg.n_agents,
+        sanitize=sanitize,
+    )  # (2, B, 1)
+    agg2 = jax.lax.stop_gradient(agg2)
+    # d) normalized team update of both heads in one (net,)-vmapped step
+    head2 = (
+        jnp.stack(
+            [pad_rows(own_c[-1][0], h_max),
+             pad_rows(own_t[-1][0], h_max)]
+        ),
+        jnp.stack([own_c[-1][1], own_t[-1][1]]),
+    )
+    new_W2, new_b2 = jax.vmap(
+        lambda hd, ph, tg: team_head_update(hd, ph, tg, cfg, mask=mask)
+    )(head2, phi2, agg2)
+    new_c = tuple(new_trunk_c) + ((new_W2[0, :h_c], new_b2[0]),)
+    new_t = tuple(new_trunk_t) + ((new_W2[1, :h_t], new_b2[1]),)
+    return new_c, new_t
 
 
 def team_head_update(head, phi, targets, cfg: Config, mask=None):
@@ -393,11 +585,14 @@ def adv_actor_update(
 # --------------------------------------------------------------------------
 
 
-def select_tree(pred_per_agent: jnp.ndarray, if_true, if_false):
-    """Per-agent masked select over stacked pytrees: leaves (N, ...)."""
+def select_tree(pred_per_agent: jnp.ndarray, if_true, if_false, axis: int = 0):
+    """Per-agent masked select over stacked pytrees: leaves carry the
+    agent dimension on ``axis`` (0 for the usual (N, ...) stacks, 1 for
+    netstacked (2, N, ...) leaves)."""
 
     def sel(a, b):
-        shape = (-1,) + (1,) * (a.ndim - 1)
+        shape = [1] * a.ndim
+        shape[axis] = -1
         return jnp.where(pred_per_agent.reshape(shape), a, b)
 
     return jax.tree.map(sel, if_true, if_false)

@@ -127,6 +127,19 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         "identical outputs",
     )
     p.add_argument(
+        "--netstack",
+        type=str,
+        default="auto",
+        choices=["auto", "on", "off"],
+        help="critic+TR netstack: on = the whole critic/TR epoch runs on "
+        "ONE stacked parameter block (single (net, agent)-vmapped "
+        "phase-I fits, combined (n_in, P_critic + P_tr) consensus "
+        "block); off = the historical dual-launch comparison arm (the "
+        "only arm --consensus_layout affects); auto (default) = the "
+        "measured backend policy — stacked on TPU, dual elsewhere "
+        "(PERF.md 'netstack'). Outputs are pinned equivalent either way",
+    )
+    p.add_argument(
         "--compute_dtype",
         type=str,
         default="float32",
@@ -187,6 +200,11 @@ def fault_plan_from_args(args):
     return plan if plan.active else None
 
 
+def _netstack_value(arm: str):
+    """CLI arm string -> Config.netstack value."""
+    return {"on": True, "off": False}.get(arm, "auto")
+
+
 def config_from_args(args) -> Config:
     labels = args.agent_label
     common = args.common_reward
@@ -237,6 +255,7 @@ def config_from_args(args) -> Config:
         seed=getattr(args, "random_seed", 300),
         consensus_impl=args.consensus_impl,
         consensus_layout=getattr(args, "consensus_layout", "flat"),
+        netstack=_netstack_value(getattr(args, "netstack", "auto")),
         compute_dtype=args.compute_dtype,
         fault_plan=fault_plan_from_args(args),
         consensus_sanitize=args.sanitize,
@@ -626,6 +645,15 @@ def cmd_sweep(argv) -> int:
         "3-way crossover (ops/aggregation.py)",
     )
     p.add_argument(
+        "--netstack",
+        type=str,
+        default="auto",
+        choices=["auto", "on", "off"],
+        help="critic+TR netstack (on: one stacked critic+TR program per "
+        "epoch; off: the dual-launch comparison arm; auto, the default: "
+        "the measured backend policy — stacked on TPU, dual elsewhere)",
+    )
+    p.add_argument(
         "--skip_existing",
         action="store_true",
         help="skip cells whose sim_data files are all already on disk, so "
@@ -668,6 +696,7 @@ def cmd_sweep(argv) -> int:
             fast_lr=args.fast_lr,
             eps_explore=args.eps,
             consensus_impl=args.consensus_impl,
+            netstack=_netstack_value(args.netstack),
             fault_plan=fault_plan_from_args(args),
             consensus_sanitize=args.sanitize,
         )
@@ -802,6 +831,7 @@ def _bench_config(
     n_ep_fixed: int,
     compute_dtype: str = "float32",
     layout: str = "flat",
+    netstack: "bool | str" = "auto",
 ) -> Config:
     spec = BENCH_CONFIGS[name]
     n = spec["n_agents"]
@@ -823,7 +853,25 @@ def _bench_config(
         slow_lr=0.002,
         consensus_impl=impl,
         consensus_layout=layout,
+        netstack=netstack,
         compute_dtype=compute_dtype,
+    )
+
+
+def _netstack_arm_flag(p: argparse.ArgumentParser) -> None:
+    """The shared bench/profile netstack A/B arm."""
+    p.add_argument(
+        "--netstack",
+        nargs="+",
+        default=["auto"],
+        choices=["auto", "on", "off"],
+        help="critic+TR netstack arm(s) to compare: on = one stacked "
+        "critic+TR program per epoch, off = the historical dual-launch "
+        "comparison arm, auto (default) = the measured backend policy "
+        "(stacked on TPU, dual elsewhere); pass 'on off' for the A/B. "
+        "A per_leaf layout row only exists on the dual arm (netstack "
+        "always uses the combined flat block), so stacked+per_leaf "
+        "combinations are skipped.",
     )
 
 
@@ -858,6 +906,7 @@ def cmd_bench(argv) -> int:
         "raveled (n_in, P_total) launch per tree, per_leaf = historical "
         "leaf-by-leaf dispatch (bitwise-identical comparison arm)",
     )
+    _netstack_arm_flag(p)
     p.add_argument(
         "--shard_agents",
         nargs="+",
@@ -892,15 +941,27 @@ def cmd_bench(argv) -> int:
 
     from rcmarl_tpu.ops.aggregation import resolve_impl
     from rcmarl_tpu.parallel.seeds import make_mesh, train_parallel
+    from rcmarl_tpu.training.update import netstack_enabled
     from rcmarl_tpu.training.trainer import init_train_state, train_scanned
     from rcmarl_tpu.utils.profiling import Timer
 
     shard_modes = [None] if args.shard_agents is None else args.shard_agents
     n_failed = 0
-    for name, dtype, impl, layout, shard in itertools.product(
-        args.configs, args.compute_dtype, args.impl, args.layout, shard_modes
+    for name, dtype, impl, layout, ns, shard in itertools.product(
+        args.configs, args.compute_dtype, args.impl, args.layout,
+        args.netstack, shard_modes,
     ):
-        cfg = _bench_config(name, impl, args.n_ep_fixed, dtype, layout)
+        cfg = _bench_config(
+            name, impl, args.n_ep_fixed, dtype, layout,
+            netstack=_netstack_value(ns),
+        )
+        if netstack_enabled(cfg) and layout == "per_leaf":
+            print(
+                f"# skip {name} netstack={ns} layout=per_leaf: the "
+                "per-leaf layout only exists on the dual-launch arm",
+                file=sys.stderr,
+            )
+            continue
         if shard is None:
             state = init_train_state(cfg, jax.random.PRNGKey(0))
             run = jax.jit(
@@ -945,6 +1006,8 @@ def cmd_bench(argv) -> int:
                 {
                     "config": name,
                     "impl": impl,
+                    "layout": layout,
+                    "netstack": netstack_enabled(cfg),
                     "compute_dtype": dtype,
                     **({} if shard is None else {"shard_agents": bool(shard)}),
                     "error": f"{type(e).__name__}: {e}"[:300],
@@ -960,6 +1023,7 @@ def cmd_bench(argv) -> int:
                 "impl": impl,
                 "impl_resolved": resolve_impl(impl, cfg.n_in, n_agents=cfg.n_agents, H=cfg.H),
                 "layout": cfg.consensus_layout,
+                "netstack": netstack_enabled(cfg),
                 "compute_dtype": cfg.compute_dtype,
                 "n_agents": cfg.n_agents,
                 "n_in": cfg.n_in,
@@ -1030,14 +1094,17 @@ def cmd_profile(argv) -> int:
         help="consensus message-tree layout(s) to profile (flat = one "
         "raveled launch per tree; per_leaf = comparison arm)",
     )
+    _netstack_arm_flag(p)
     p.add_argument(
         "--consensus_micro",
         action="store_true",
         help="additionally emit a consensus micro-breakdown row per cell "
-        "(gather vs trim-bounds vs clip/mean vs phase-I fits, "
+        "(gather vs trim-bounds vs clip/mean vs phase-I fits vs the "
+        "whole epoch and its epoch_other residual, "
         "utils/profiling.py:profile_consensus) tagged with n_in/H/"
         "gathered volume — the component-level rows crossover refits "
-        "(SELECT_MAX_N_IN, PALLAS_CROSSOVER_VOLUME) key on",
+        "(SELECT_MAX_N_IN, PALLAS_CROSSOVER_VOLUME) and the netstack "
+        "A/B key on",
     )
     p.add_argument("--n_ep_fixed", type=int, default=10)
     p.add_argument("--reps", type=int, default=3)
@@ -1054,6 +1121,7 @@ def cmd_profile(argv) -> int:
     import jax
 
     from rcmarl_tpu.ops.aggregation import resolve_impl
+    from rcmarl_tpu.training.update import netstack_enabled
     from rcmarl_tpu.utils.profiling import (
         consensus_tags,
         profile_consensus,
@@ -1061,10 +1129,20 @@ def cmd_profile(argv) -> int:
     )
 
     n_failed = 0
-    for name, dtype, impl, layout in itertools.product(
-        args.configs, args.compute_dtype, args.impl, args.layout
+    for name, dtype, impl, layout, ns in itertools.product(
+        args.configs, args.compute_dtype, args.impl, args.layout, args.netstack
     ):
-        cfg = _bench_config(name, impl, args.n_ep_fixed, dtype, layout)
+        cfg = _bench_config(
+            name, impl, args.n_ep_fixed, dtype, layout,
+            netstack=_netstack_value(ns),
+        )
+        if netstack_enabled(cfg) and layout == "per_leaf":
+            print(
+                f"# skip {name} netstack={ns} layout=per_leaf: the "
+                "per-leaf layout only exists on the dual-launch arm",
+                file=sys.stderr,
+            )
+            continue
         try:
             phases = profile_phases(cfg, reps=args.reps)
             micro = (
@@ -1078,6 +1156,7 @@ def cmd_profile(argv) -> int:
                     "config": name,
                     "impl": impl,
                     "layout": layout,
+                    "netstack": netstack_enabled(cfg),
                     "compute_dtype": dtype,
                     "error": f"{type(e).__name__}: {e}"[:300],
                 }
@@ -1100,6 +1179,7 @@ def cmd_profile(argv) -> int:
                 "impl": impl,
                 "impl_resolved": resolve_impl(impl, cfg.n_in, n_agents=cfg.n_agents, H=cfg.H),
                 "layout": cfg.consensus_layout,
+                "netstack": netstack_enabled(cfg),
                 "compute_dtype": cfg.compute_dtype,
                 "n_agents": cfg.n_agents,
                 "hidden": list(cfg.hidden),
@@ -1131,6 +1211,7 @@ def cmd_profile(argv) -> int:
                         impl, cfg.n_in, n_agents=cfg.n_agents, H=cfg.H
                     ),
                     "layout": cfg.consensus_layout,
+                    "netstack": netstack_enabled(cfg),
                     "compute_dtype": cfg.compute_dtype,
                     **consensus_tags(cfg),
                     "ms": {k: round(v * 1e3, 3) for k, v in micro.items()},

@@ -158,6 +158,26 @@ class Config:
     # arm. Bitwise identical — raveling is elementwise-neutral
     # (ops/aggregation.py:resilient_aggregate_tree).
     consensus_layout: str = "flat"
+    # --- netstack: critic+TR as ONE stacked program ---
+    # True: the whole critic+TR epoch operates on one stacked parameter
+    # block — phase-I fits run as a single (net, agent)-vmapped scan
+    # (critic inputs/first-layer rows zero-padded to the TR width;
+    # exactly gradient-neutral), and phase-II consensus gathers, faults,
+    # trims, clips and projects BOTH message trees as one combined
+    # (n_in, P_critic + P_tr) block — every hot launch happens once per
+    # epoch instead of twice. False: the historical dual-launch path,
+    # kept as the measured comparison arm (it is also the only arm
+    # `consensus_layout` affects; the netstack always uses the combined
+    # flat block). 'auto' (default): a measured BACKEND policy, like
+    # consensus_impl='auto' — stacked on TPU (where doubling the batch
+    # of the MXU-underfilling 20-wide gemms is the win the stacking
+    # buys), dual-launch elsewhere (measured on the 1-core CPU host: the
+    # zero-padding widens the critic's dominant first-layer contraction
+    # obs_dim -> sa_dim, ~+20% FLOPs, and a serial core has no batching
+    # headroom to pay for it — PERF.md "netstack"). Outputs are pinned
+    # leaf-for-leaf equivalent either way (tests/test_netstack.py), so
+    # the policy is purely a speed choice.
+    netstack: "bool | str" = "auto"
     # --- transport faults / graceful degradation ---
     # fault_plan: per-link transport-fault injection on the consensus
     # exchange (drop / stale replay / corruption / NaN-Inf bombs —
@@ -206,6 +226,11 @@ class Config:
             raise ValueError(
                 f"consensus_layout={self.consensus_layout!r}: expected "
                 "'flat' or 'per_leaf'"
+            )
+        if not (isinstance(self.netstack, bool) or self.netstack == "auto"):
+            raise ValueError(
+                f"netstack={self.netstack!r}: expected True, False, or "
+                "'auto' (the measured backend policy)"
             )
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(

@@ -159,6 +159,45 @@ def _link_masks(key, plan: FaultPlan, shape):
     }
 
 
+def _fault_payload(key, masks, i, fresh, stale, plan: FaultPlan):
+    """The per-payload fault chain shared by the tree and flat entry
+    points: ``fresh``/``stale`` are one leaf's ``(N, n_in, ...)`` block,
+    ``i`` its index in the ORIGINAL tree's flatten order (the corruption
+    noise stream is keyed on it), ``masks`` the tree's per-link draws."""
+    import jax
+    import jax.numpy as jnp
+
+    shape = fresh.shape[:2]
+
+    def bcast(m, leaf):
+        return m.reshape(shape + (1,) * (leaf.ndim - 2))
+
+    v = fresh
+    if float(plan.stale_p) > 0.0:
+        v = jnp.where(bcast(masks["stale"], v), stale, v)
+    if float(plan.corrupt_p) > 0.0:
+        noise = jax.random.normal(
+            jax.random.fold_in(key, i + 1), v.shape, v.dtype
+        )
+        v = jnp.where(
+            bcast(masks["corrupt"], v),
+            v + jnp.asarray(plan.corrupt_scale, v.dtype) * noise,
+            v,
+        )
+    if float(plan.flip_p) > 0.0:
+        v = jnp.where(bcast(masks["flip"], v), -v, v)
+    if float(plan.drop_p) > 0.0 or float(plan.nan_p) > 0.0:
+        bomb = masks["drop"] | masks["nan"]
+        v = jnp.where(bcast(bomb, v), jnp.nan, v)
+    if float(plan.inf_p) > 0.0:
+        v = jnp.where(
+            bcast(masks["inf"], v),
+            bcast(masks["inf_sign"], v).astype(v.dtype),
+            v,
+        )
+    return v
+
+
 def apply_link_faults(key, fresh_tree, stale_tree, plan: FaultPlan):
     """Apply ``plan`` to a gathered neighbor-message pytree.
 
@@ -192,35 +231,6 @@ def apply_link_faults(key, fresh_tree, stale_tree, plan: FaultPlan):
     key = jax.random.fold_in(key, plan.seed)
     masks = _link_masks(key, plan, shape)
 
-    def bcast(m, leaf):
-        return m.reshape(shape + (1,) * (leaf.ndim - 2))
-
-    def fault_leaf(i, fresh, stale):
-        v = fresh
-        if float(plan.stale_p) > 0.0:
-            v = jnp.where(bcast(masks["stale"], v), stale, v)
-        if float(plan.corrupt_p) > 0.0:
-            noise = jax.random.normal(
-                jax.random.fold_in(key, i + 1), v.shape, v.dtype
-            )
-            v = jnp.where(
-                bcast(masks["corrupt"], v),
-                v + jnp.asarray(plan.corrupt_scale, v.dtype) * noise,
-                v,
-            )
-        if float(plan.flip_p) > 0.0:
-            v = jnp.where(bcast(masks["flip"], v), -v, v)
-        if float(plan.drop_p) > 0.0 or float(plan.nan_p) > 0.0:
-            bomb = masks["drop"] | masks["nan"]
-            v = jnp.where(bcast(bomb, v), jnp.nan, v)
-        if float(plan.inf_p) > 0.0:
-            v = jnp.where(
-                bcast(masks["inf"], v),
-                bcast(masks["inf_sign"], v).astype(v.dtype),
-                v,
-            )
-        return v
-
     fresh_leaves, treedef = jax.tree.flatten(fresh_tree)
     stale_leaves = jax.tree.leaves(stale_tree)
     if len(stale_leaves) != len(fresh_leaves):
@@ -229,10 +239,64 @@ def apply_link_faults(key, fresh_tree, stale_tree, plan: FaultPlan):
             f"{len(fresh_leaves)} vs {len(stale_leaves)} leaves"
         )
     out = [
-        fault_leaf(i, f, s)
+        _fault_payload(key, masks, i, f, s, plan)
         for i, (f, s) in enumerate(zip(fresh_leaves, stale_leaves))
     ]
     return jax.tree.unflatten(treedef, out)
+
+
+def apply_link_faults_flat(key, fresh, stale, plan: FaultPlan, segments):
+    """Apply ``plan`` to a COMBINED raveled gathered block (the netstack
+    consensus layout: BOTH message trees as one ``(N, n_in, P_total)``
+    array).
+
+    Args:
+      key: the epoch fault key (pre per-tree fold_in — this function
+        derives ``fold_in(key, tree_id)`` itself, matching the dual
+        arm's two ``apply_link_faults(fold_in(key, k), ...)`` calls).
+      fresh/stale: the combined gathered block and its stale-replay
+        twin, shapes ``(N, n_in, P_total)``.
+      segments: static tuple of ``(tree_id, leaf_idx, offset, size)``
+        mapping column ranges back to the original trees' leaves
+        (``training/update.py`` derives it from the pair ravel order).
+
+    Per-tree link masks and per-leaf corruption noise are drawn with
+    EXACTLY the key structure of two separate :func:`apply_link_faults`
+    calls — ``jax.random`` fills arrays in row-major counter order, so a
+    ``(N, n_in, size)`` noise draw is bitwise the reshaped
+    ``(N, n_in, *leaf_dims)`` draw — making the faulted combined block
+    the exact ravel of the dual-arm faulted trees.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if not plan.active:
+        return fresh
+    if sum(s[3] for s in segments) != fresh.shape[-1]:
+        raise ValueError(
+            f"segments cover {sum(s[3] for s in segments)} columns but the "
+            f"block has {fresh.shape[-1]}"
+        )
+    shape = fresh.shape[:2]
+    tree_ids = sorted({t for t, *_ in segments})
+    keys = {
+        t: jax.random.fold_in(jax.random.fold_in(key, t), plan.seed)
+        for t in tree_ids
+    }
+    masks = {t: _link_masks(keys[t], plan, shape) for t in tree_ids}
+    cols = []
+    for tree_id, leaf_idx, off, size in segments:
+        cols.append(
+            _fault_payload(
+                keys[tree_id],
+                masks[tree_id],
+                leaf_idx,
+                fresh[:, :, off : off + size],
+                stale[:, :, off : off + size],
+                plan,
+            )
+        )
+    return jnp.concatenate(cols, axis=-1)
 
 
 def fault_diagnostics(tree, H, valid=None) -> FaultDiag:

@@ -118,6 +118,19 @@ def flatten_input(x: jnp.ndarray) -> jnp.ndarray:
     return x.reshape(x.shape[0], -1)
 
 
+def trunk_apply(
+    trunk_params: MLPParams, x: jnp.ndarray, alpha: float = 0.1, dtype=None
+) -> jnp.ndarray:
+    """Apply a TRUNK-ONLY parameter tuple (no head layer) to an already
+    flattened ``(batch, features)`` input. The netstacked consensus path
+    uses this directly on the stacked (net, ...) trunk; everything else
+    goes through :func:`trunk_forward`."""
+    h = x
+    for W, b in trunk_params:
+        h = leaky_relu(dot(h, W, dtype) + b, alpha)
+    return h
+
+
 def trunk_forward(
     params: MLPParams, x: jnp.ndarray, alpha: float = 0.1, dtype=None
 ) -> jnp.ndarray:
@@ -129,10 +142,7 @@ def trunk_forward(
       x: (batch, ...) input; flattened internally.
       dtype: matmul compute dtype (see :func:`dot`).
     """
-    h = flatten_input(x)
-    for W, b in params[:-1]:
-        h = leaky_relu(dot(h, W, dtype) + b, alpha)
-    return h
+    return trunk_apply(params[:-1], flatten_input(x), alpha, dtype)
 
 
 def head_forward(
@@ -159,3 +169,75 @@ def actor_probs(
 def agent_slice(params: MLPParams, i) -> MLPParams:
     """Select agent i's parameters from a stacked pytree."""
     return jax.tree.map(lambda a: a[i], params)
+
+
+# --------------------------------------------------------------------------
+# Netstack: critic + TR as ONE stacked parameter block
+# --------------------------------------------------------------------------
+#
+# The critic (input obs_dim) and team-reward net (input sa_dim) share
+# every dimension except the first-layer input width. Zero-padding the
+# narrower net's first-layer rows (and its input columns) to the common
+# width makes the two nets stackable along a leading net axis, so one
+# (net, agent)-vmapped program fits/evaluates BOTH families at once —
+# and the padding is exactly neutral: padded input columns are exact
+# zeros, so padded first-layer rows receive bitwise-zero gradients and
+# stay zero through any number of SGD steps
+# (tests/test_netstack_properties.py pins this).
+
+
+def pad_features(x: jnp.ndarray, width: int) -> jnp.ndarray:
+    """Zero-pad the trailing feature axis of ``x`` up to ``width``."""
+    d = x.shape[-1]
+    if d == width:
+        return x
+    if d > width:
+        raise ValueError(f"cannot pad feature dim {d} down to {width}")
+    pad = [(0, 0)] * (x.ndim - 1) + [(0, width - d)]
+    return jnp.pad(x, pad)
+
+
+def pad_rows(W: jnp.ndarray, rows: int) -> jnp.ndarray:
+    """Zero-pad the input-row axis (``-2``) of a kernel up to ``rows``."""
+    d = W.shape[-2]
+    if d == rows:
+        return W
+    pad = [(0, 0)] * W.ndim
+    pad[-2] = (0, rows - d)
+    return jnp.pad(W, pad)
+
+
+def netstack_stack(a: MLPParams, b: MLPParams) -> MLPParams:
+    """Stack two MLP families along a NEW leading net axis.
+
+    ``a`` and ``b`` must agree in depth and in every layer shape except
+    the first-layer input width, which is zero-padded up to the wider of
+    the two (both for kernels with and without a leading agent axis —
+    only the ``-2`` axis of the first kernel is padded). Leaves of the
+    result are ``(2, ...)``-leading; recover the originals with
+    :func:`netstack_split`.
+    """
+    if len(a) != len(b):
+        raise ValueError(
+            f"netstack requires equal depth, got {len(a)} vs {len(b)} layers"
+        )
+    width = max(a[0][0].shape[-2], b[0][0].shape[-2])
+    a = ((pad_rows(a[0][0], width), a[0][1]),) + tuple(a[1:])
+    b = ((pad_rows(b[0][0], width), b[0][1]),) + tuple(b[1:])
+    return jax.tree.map(lambda x, y: jnp.stack([x, y]), a, b)
+
+
+def netstack_split(
+    stacked: MLPParams, in_dims: Tuple[int, int]
+) -> Tuple[MLPParams, MLPParams]:
+    """Inverse of :func:`netstack_stack`: slice the two families back
+    out, trimming each first-layer kernel to its own input width (the
+    padded rows carry exact zeros, so the trim is lossless)."""
+
+    def unstack(net: int, rows: int) -> MLPParams:
+        p = jax.tree.map(lambda l: l[net], stacked)
+        W1 = p[0][0]
+        sl = (slice(None),) * (W1.ndim - 2) + (slice(0, rows), slice(None))
+        return ((W1[sl], p[0][1]),) + tuple(p[1:])
+
+    return unstack(0, in_dims[0]), unstack(1, in_dims[1])

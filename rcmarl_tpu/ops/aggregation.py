@@ -698,6 +698,13 @@ def ravel_neighbor_tree(tree):
     identical to aggregating leaf by leaf — every select/clip/mean op is
     elementwise along the trailing axis — while issuing ONE op sequence
     for the whole message tree instead of one per leaf.
+
+    The raveling composes across TREES exactly the same way: any pytree
+    works, including a tuple of several message trees — the netstack
+    consensus (``Config.netstack``, training/update.py) ravels the
+    critic AND team-reward trees into one ``(n_in, P_critic + P_tr)``
+    super-block this way, halving the per-epoch launch count again on
+    top of the per-tree flattening, still bitwise column for column.
     """
     leaves, treedef = jax.tree.flatten(tree)
     n_in = leaves[0].shape[0]

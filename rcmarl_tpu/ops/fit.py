@@ -31,6 +31,7 @@ from typing import Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from rcmarl_tpu.ops.losses import weighted_mse
 from rcmarl_tpu.ops.optim import sgd_update
 
 
@@ -153,3 +154,67 @@ def fit_minibatch(
 
     (params, opt_state), epoch_losses = jax.lax.scan(epoch, (params, opt_state), ekeys)
     return params, opt_state, epoch_losses[0]
+
+
+# --------------------------------------------------------------------------
+# Targeted regression fits (the shape every critic/TR fit reduces to)
+# --------------------------------------------------------------------------
+#
+# All four critic/TR fit flavors in agents/updates.py are "regress
+# forward(params, x) onto a FIXED precomputed target under a validity
+# mask" — the TD bootstrap (when any) happens once, before the fit.
+# Expressing that shape directly (data as ARGUMENTS, not closures) is
+# what lets the netstack vmap ONE fit program over a leading (net,
+# agent) axis with per-net inputs/targets, instead of tracing one scan
+# per net family.
+
+
+def fit_mse_full_batch(
+    params,
+    forward: Callable[[object, jnp.ndarray], jnp.ndarray],
+    x: jnp.ndarray,
+    target: jnp.ndarray,
+    mask: jnp.ndarray,
+    n_steps: int,
+    lr: float,
+):
+    """:func:`fit_full_batch` specialized to masked-MSE regression of
+    ``forward(params, x)`` onto a fixed ``target``. Identical op
+    sequence to the closure form (same grads, same scan)."""
+    target = jax.lax.stop_gradient(target)
+    return fit_full_batch(
+        params,
+        lambda p: weighted_mse(forward(p, x), target, mask=mask),
+        n_steps,
+        lr,
+    )
+
+
+def fit_mse_minibatch(
+    key: jax.Array,
+    params,
+    forward: Callable[[object, jnp.ndarray], jnp.ndarray],
+    x: jnp.ndarray,
+    target: jnp.ndarray,
+    mask: jnp.ndarray,
+    epochs: int,
+    batch_size: int,
+    lr: float,
+):
+    """:func:`fit_minibatch` specialized the same way (the adversary
+    critic/TR fit shape: Keras ``fit(epochs, batch_size)`` with shuffled
+    minibatches toward a fixed target)."""
+    target = jax.lax.stop_gradient(target)
+    out, _, loss = fit_minibatch(
+        key,
+        params,
+        lambda p, idx, bval: weighted_mse(
+            forward(p, x[idx]), target[idx], mask=bval
+        ),
+        capacity=x.shape[0],
+        mask=mask,
+        epochs=epochs,
+        batch_size=batch_size,
+        lr=lr,
+    )
+    return out, loss

@@ -38,6 +38,14 @@ The public entry points mirror the XLA versions and are exact drop-ins:
   ...) leaves, flattened into ONE kernel launch (vs one selection per
   leaf), then split back.
 
+The kernel is agnostic to how many message trees a block carries: under
+``Config.netstack`` (the default) the consensus layer hands it the
+COMBINED critic+TR trunk block — ``(n_in, P_critic + P_tr)`` columns in
+one launch — and the tiled grid just covers the wider trailing axis, so
+the dual-tree epoch costs one kernel dispatch where the per-tree layout
+cost two. Aggregation is elementwise along the trailing axis, so the
+combined launch is bitwise the two per-tree launches column for column.
+
 Both fall back to nothing special on CPU: pass ``interpret=True`` (the
 tests do) or keep ``Config.consensus_impl='xla'``.
 """

@@ -32,22 +32,34 @@ from rcmarl_tpu.agents.updates import (
     CellSpec,
     adv_actor_update,
     adv_critic_fit,
+    adv_pair_fit,
     adv_tr_fit,
     consensus_update_one,
+    consensus_update_pair,
     coop_actor_update,
     coop_local_critic_fit,
     coop_local_tr_fit,
+    coop_pair_fit,
+    netstack_pair_inputs,
+    pair_bootstrap_targets,
     select_tree,
 )
 from rcmarl_tpu.config import Config, Roles
 from rcmarl_tpu.faults import (
     FaultDiag,
     apply_link_faults,
+    apply_link_faults_flat,
     fault_diagnostics,
     sum_diags,
     zero_diag,
 )
-from rcmarl_tpu.models.mlp import init_stacked_mlp
+from rcmarl_tpu.models.mlp import (
+    init_stacked_mlp,
+    mlp_forward,
+    netstack_split,
+    netstack_stack,
+)
+from rcmarl_tpu.ops.aggregation import ravel_neighbor_tree
 from rcmarl_tpu.ops.optim import adam_init
 
 #: fold_in tag deriving the transport-fault stream from the epoch key —
@@ -72,6 +84,18 @@ def init_agent_params(key: jax.Array, cfg: Config) -> AgentParams:
 
 def _role_mask(cfg: Config, role: int) -> jnp.ndarray:
     return jnp.asarray(np.array(cfg.agent_roles) == role)
+
+
+def netstack_enabled(cfg: Config) -> bool:
+    """Resolve ``Config.netstack`` at trace time: explicit booleans pass
+    through; ``'auto'`` is the measured backend policy — the stacked
+    one-block epoch on TPU (the batching win the stacking buys), the
+    dual-launch arm elsewhere (measured slower on a serial CPU host:
+    the zero-padding FLOPs have no parallel headroom to hide in —
+    PERF.md "netstack")."""
+    if cfg.netstack == "auto":
+        return jax.default_backend() == "tpu"
+    return bool(cfg.netstack)
 
 
 def spec_from_config(cfg: Config) -> CellSpec:
@@ -166,6 +190,10 @@ def critic_tr_epoch(
     :class:`~rcmarl_tpu.faults.FaultDiag` of degradation counters for
     this epoch.
     """
+    if netstack_enabled(cfg):
+        return _critic_tr_epoch_netstack(
+            cfg, carry, batch, r_coop, ekey, spec, with_diag
+        )
     critic, tr, critic_local = carry
     s, ns, sa, mask = batch.s, batch.ns, batch.sa, batch.mask
     r_agents = jnp.moveaxis(batch.r, 1, 0)  # (N, B, 1) per-agent rewards
@@ -248,12 +276,18 @@ def critic_tr_epoch(
         if plan is not None and plan.active:
             # Transport boundary: fault the gathered blocks. A stale
             # link replays the sender's PRE-FIT epoch-carry weights —
-            # gather the carry nets as the replay payload. Pure PRNG
-            # transform on (N, n_in, ...) blocks, so it traces the same
-            # under vmap, the fused matrix, and both gather lowerings.
+            # gather the carry nets as the replay payload, but ONLY when
+            # the stale branch can actually fire: a drop/NaN-only plan
+            # must not pay a second full gather for replay content that
+            # is never read. Pure PRNG transform on (N, n_in, ...)
+            # blocks, so it traces the same under vmap, the fused
+            # matrix, and both gather lowerings.
             fkey = jax.random.fold_in(ekey, _FAULT_STREAM)
-            stale_c = gather_neighbor_messages(cfg, critic)
-            stale_t = gather_neighbor_messages(cfg, tr)
+            if float(plan.stale_p) > 0.0:
+                stale_c = gather_neighbor_messages(cfg, critic)
+                stale_t = gather_neighbor_messages(cfg, tr)
+            else:
+                stale_c, stale_t = nbr_c, nbr_t
             nbr_c = apply_link_faults(
                 jax.random.fold_in(fkey, 0), nbr_c, stale_c, plan
             )
@@ -296,6 +330,197 @@ def critic_tr_epoch(
         m = spec.coop if traced else _role_mask(cfg, Roles.COOPERATIVE)
         new_critic = select_tree(m, cons(new_critic, nbr_c, s), new_critic)
         new_tr = select_tree(m, cons(new_tr, nbr_t, sa), new_tr)
+
+    if with_diag:
+        return (new_critic, new_tr, new_critic_local), diag
+    return new_critic, new_tr, new_critic_local
+
+
+def _pair_segments(msg_c, msg_t):
+    """Static ``(tree_id, leaf_idx, offset, size)`` rows mapping the
+    trunks-first pair ravel (``((trunk_c, trunk_t), (head_c, head_t))``)
+    back to the two original trees' leaves — what
+    :func:`~rcmarl_tpu.faults.apply_link_faults_flat` needs to draw the
+    dual-arm fault streams on the combined block. Leaf sizes strip the
+    leading agent axis (the gathered block is (N, n_in, P_total))."""
+    lc, lt = jax.tree.leaves(msg_c), jax.tree.leaves(msg_t)
+    order = (
+        [(0, i) for i in range(len(lc) - 2)]
+        + [(1, i) for i in range(len(lt) - 2)]
+        + [(0, len(lc) - 2), (0, len(lc) - 1)]
+        + [(1, len(lt) - 2), (1, len(lt) - 1)]
+    )
+    segs, off = [], 0
+    for t, i in order:
+        size = int(np.prod((lc, lt)[t][i].shape[1:], dtype=np.int64))
+        segs.append((t, i, off, size))
+        off += size
+    return tuple(segs)
+
+
+def _pair_block(msg_c, msg_t):
+    """Ravel the two message trees into ONE (N, P_critic + P_tr) block,
+    columns trunks-first (the layout
+    :func:`~rcmarl_tpu.agents.updates.consensus_update_pair` slices)."""
+    pair = ((msg_c[:-1], msg_t[:-1]), (msg_c[-1], msg_t[-1]))
+    flat, _ = ravel_neighbor_tree(pair)
+    return flat
+
+
+def _critic_tr_epoch_netstack(
+    cfg: Config,
+    carry,
+    batch: Batch,
+    r_coop: jnp.ndarray,
+    ekey: jax.Array,
+    spec: CellSpec | None,
+    with_diag: bool,
+):
+    """The netstack twin of :func:`critic_tr_epoch` (``cfg.netstack``;
+    on TPU under the default ``'auto'`` policy): identical math and RNG
+    stream structure, but every hot launch happens ONCE for the
+    critic+TR pair instead of twice —
+
+    - phase I: each fit flavor is one (net, agent)-vmapped scan over the
+      stacked parameter block (:func:`coop_pair_fit` /
+      :func:`adv_pair_fit`; the malicious PRIVATE critic fit stays
+      unpaired — it has no TR twin);
+    - phase II: both message trees ravel into one
+      (N, P_critic + P_tr) block, so the neighbor gather, the
+      transport-fault transform, the trim/clip/mean, the projection
+      einsum, and the team head step each launch once
+      (:func:`consensus_update_pair`).
+
+    Outputs are pinned equivalent to the dual-launch arm leaf for leaf
+    (tests/test_netstack.py); the zero-padding that makes the two net
+    families stackable is exactly gradient-neutral
+    (tests/test_netstack_properties.py).
+    """
+    critic, tr, critic_local = carry
+    s, ns, sa, mask = batch.s, batch.ns, batch.sa, batch.mask
+    r_agents = jnp.moveaxis(batch.r, 1, 0)  # (N, B, 1) per-agent rewards
+    N = cfg.n_agents
+    traced = spec is not None
+    in_dims = (cfg.obs_dim, cfg.sa_dim)
+
+    x2 = netstack_pair_inputs(cfg, s, sa)
+    stack2 = netstack_stack(critic, tr)  # leaves (2, N, ...)
+    # The critic's TD bootstrap V(ns) with the pre-fit weights, computed
+    # ONCE at the unpadded width and reused by every fit pair below (the
+    # dual arm recomputes the identical forward inside each flavor).
+    v_ns = None
+    if traced or cfg.n_coop or cfg.has_role(Roles.GREEDY) or cfg.has_role(
+        Roles.MALICIOUS
+    ):
+        v_ns = jax.vmap(lambda p: mlp_forward(p, ns, dtype=cfg.dot_dtype))(
+            critic
+        )
+
+    def targets2(r):
+        return pair_bootstrap_targets(cfg, critic, ns, r, v=v_ns)
+
+    # ---- Phase I: local fits -> messages (+ persisted adversary updates)
+    msg2 = stack2  # Faulty default: transmit frozen nets
+    new2, new_critic_local = stack2, critic_local
+
+    if traced or cfg.n_coop:
+        r_team = jnp.broadcast_to(r_coop[None], (N, *r_coop.shape))
+        if traced:
+            r_applied = jnp.where(spec.common_reward, r_team, r_agents)
+        elif cfg.common_reward:
+            r_applied = r_team
+        else:
+            r_applied = r_agents
+        coop2, _ = coop_pair_fit(stack2, x2, targets2(r_applied), mask, cfg)
+        m = spec.coop if traced else _role_mask(cfg, Roles.COOPERATIVE)
+        msg2 = select_tree(m, coop2, msg2, axis=1)
+        # own nets restored (resilient_CAC_agents.py:120,138): new2 unchanged
+
+    k_gc, k_gt, k_ml, k_mc, k_mt = jax.random.split(ekey, 5)
+
+    if traced or cfg.has_role(Roles.GREEDY):
+        keys2 = jnp.stack(
+            [jax.random.split(k_gc, N), jax.random.split(k_gt, N)]
+        )
+        greedy2, _ = adv_pair_fit(
+            keys2, stack2, x2, targets2(r_agents), mask, cfg
+        )
+        m = spec.greedy if traced else _role_mask(cfg, Roles.GREEDY)
+        msg2 = select_tree(m, greedy2, msg2, axis=1)
+        new2 = select_tree(m, greedy2, new2, axis=1)  # persists
+
+    if traced or cfg.has_role(Roles.MALICIOUS):
+        # private critic on own reward (adversarial_CAC_agents.py:137-152)
+        mal_local, _ = jax.vmap(
+            lambda k, p, r: adv_critic_fit(k, p, s, ns, r, mask, cfg)
+        )(jax.random.split(k_ml, N), critic_local, r_agents)
+        # compromised critic/TR toward -r_coop (adversarial:121-135,154-165)
+        neg = jnp.broadcast_to(-r_coop[None], (N, *r_coop.shape))
+        keys2 = jnp.stack(
+            [jax.random.split(k_mc, N), jax.random.split(k_mt, N)]
+        )
+        mal2, _ = adv_pair_fit(keys2, stack2, x2, targets2(neg), mask, cfg)
+        m = spec.malicious if traced else _role_mask(cfg, Roles.MALICIOUS)
+        msg2 = select_tree(m, mal2, msg2, axis=1)
+        new2 = select_tree(m, mal2, new2, axis=1)  # persists
+        new_critic_local = select_tree(m, mal_local, new_critic_local)
+
+    new_critic, new_tr = netstack_split(new2, in_dims)
+
+    # ---- Phase II: resilient consensus, cooperative agents only — on
+    # ONE combined (N, n_in, P_critic + P_tr) gathered block
+    diag = zero_diag() if with_diag else None
+    if traced or cfg.n_coop:
+        _, valid_pad = cfg.padded_in_nodes()
+        if traced and valid_pad is not None:
+            raise ValueError(
+                "the fused-matrix path (traced CellSpec) requires a "
+                "uniform-degree graph; this config pads ragged "
+                "neighborhoods"
+            )
+        H = spec.H if traced else None
+        msg_c, msg_t = netstack_split(msg2, in_dims)
+        nbr = gather_neighbor_messages(cfg, _pair_block(msg_c, msg_t))
+        plan = cfg.fault_plan
+        if plan is not None and plan.active:
+            # Transport boundary on the combined block: per-tree masks /
+            # noise streams identical to the dual arm's two calls, and
+            # the stale-replay gather only happens when the stale branch
+            # is live (same gating as the dual arm).
+            fkey = jax.random.fold_in(ekey, _FAULT_STREAM)
+            if float(plan.stale_p) > 0.0:
+                stale = gather_neighbor_messages(cfg, _pair_block(critic, tr))
+            else:
+                stale = nbr
+            nbr = apply_link_faults_flat(
+                fkey, nbr, stale, plan, _pair_segments(msg_c, msg_t)
+            )
+        if with_diag:
+            H_diag = H if traced else cfg.H
+            valid_diag = (
+                None if valid_pad is None else jnp.asarray(np.array(valid_pad))
+            )
+            diag = fault_diagnostics(nbr, H_diag, valid_diag)
+        if valid_pad is None:
+            cons = jax.vmap(
+                lambda oc, ot, blk: consensus_update_pair(
+                    oc, ot, blk, x2, mask, cfg, H=H
+                ),
+                in_axes=(0, 0, 0),
+            )
+        else:
+            valid_arr = jnp.asarray(np.array(valid_pad))  # (N, n_in)
+            cons_v = jax.vmap(
+                lambda oc, ot, blk, v: consensus_update_pair(
+                    oc, ot, blk, x2, mask, cfg, valid=v
+                ),
+                in_axes=(0, 0, 0, 0),
+            )
+            cons = lambda oc, ot, blk: cons_v(oc, ot, blk, valid_arr)
+        cons_c, cons_t = cons(new_critic, new_tr, nbr)
+        m = spec.coop if traced else _role_mask(cfg, Roles.COOPERATIVE)
+        new_critic = select_tree(m, cons_c, new_critic)
+        new_tr = select_tree(m, cons_t, new_tr)
 
     if with_diag:
         return (new_critic, new_tr, new_critic_local), diag

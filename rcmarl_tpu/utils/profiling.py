@@ -177,25 +177,46 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
       one-launch layout), by ``cfg.consensus_impl``'s strategy.
     - ``clip_mean`` — the clip-and-average epilogue given precomputed
       bounds (the part every strategy shares).
-    - ``consensus`` — the full phase-II update of the critic net
-      (hidden consensus + projection + team head step), vmapped over
-      agents: what ``critic_tr_epoch`` actually runs.
+    - ``consensus`` — the full phase-II update of BOTH nets as the
+      epoch runs it: with ``cfg.netstack`` one fused
+      critic+TR pair update on the combined block, otherwise the two
+      per-tree vmapped updates back to back.
     - ``phase1_fits`` — the cooperative local critic+TR fits that
-      produce the messages (phase I).
+      produce the messages, as the epoch runs them: one
+      (net, agent)-vmapped netstack fit, or the two per-tree fits.
+    - ``epoch`` — the whole ``critic_tr_epoch`` sub-program (same
+      number as :func:`profile_phases`' ``critic_tr_epoch``).
+    - ``epoch_other`` — the residual ``epoch - consensus -
+      phase1_fits``: what the micro components do NOT cover (adversary
+      fits when present, select/mask plumbing, dispatch) so the
+      component shares of an epoch sum to ~100% in PERF.md. Can be
+      slightly negative on tiny configs (standalone timings amortize
+      dispatch differently than the fused epoch).
 
     Each component is jitted standalone with host-fetch barriers, like
     the phase profiler. Use :func:`consensus_tags` for the row tags.
     """
     from rcmarl_tpu.agents.updates import (
         consensus_update_one,
+        consensus_update_pair,
         coop_local_critic_fit,
         coop_local_tr_fit,
+        coop_pair_fit,
+        netstack_pair_inputs,
+        pair_bootstrap_targets,
     )
+    from rcmarl_tpu.models.mlp import netstack_stack
     from rcmarl_tpu.ops.aggregation import _trim_bounds, resolve_impl
     from rcmarl_tpu.training.buffer import update_batch
     from rcmarl_tpu.training.rollout import rollout_block
     from rcmarl_tpu.training.trainer import init_train_state, make_env
-    from rcmarl_tpu.training.update import gather_neighbor_messages
+    from rcmarl_tpu.training.update import (
+        _pair_block,
+        critic_tr_epoch,
+        gather_neighbor_messages,
+        netstack_enabled,
+        team_average_reward,
+    )
 
     if state is None:
         state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
@@ -205,7 +226,7 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
         lambda s, k: rollout_block(cfg, env, s.params, s.desired, k, s.initial)
     )(state, key)
     batch = jax.jit(update_batch)(state.buffer, fresh)
-    critic = state.params.critic
+    critic, tr = state.params.critic, state.params.tr
     out: Dict[str, float] = {}
 
     gather = jax.jit(lambda t: gather_neighbor_messages(cfg, t))
@@ -243,28 +264,74 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
     out["clip_mean"] = _timeit(jax.jit(clip_mean), flat, lo, hi, reps=reps)
 
     mask = batch.mask
-    cons = jax.jit(
-        jax.vmap(
-            lambda own, nb, x: consensus_update_one(own, nb, x, mask, cfg),
-            in_axes=(0, 0, None),
+    x2 = netstack_pair_inputs(cfg, batch.s, batch.sa)
+    stacked = netstack_enabled(cfg)
+    if stacked:
+        # phase II as the netstack epoch runs it: ONE fused pair update
+        # over the combined (N, n_in, P_c + P_t) gathered block
+        pair_nbr = gather(_pair_block(critic, tr))
+
+        cons2 = jax.jit(
+            jax.vmap(
+                lambda oc, ot, blk: consensus_update_pair(
+                    oc, ot, blk, x2, mask, cfg
+                ),
+                in_axes=(0, 0, 0),
+            )
         )
-    )
-    out["consensus"] = _timeit(cons, critic, nbr, batch.s, reps=reps)
+        out["consensus"] = _timeit(cons2, critic, tr, pair_nbr, reps=reps)
+    else:
+        nbr_t = gather(tr)
+
+        def cons_both(critic_p, tr_p, nc, nt):
+            c = jax.vmap(
+                lambda own, nb, x: consensus_update_one(own, nb, x, mask, cfg),
+                in_axes=(0, 0, None),
+            )(critic_p, nc, batch.s)
+            t = jax.vmap(
+                lambda own, nb, x: consensus_update_one(own, nb, x, mask, cfg),
+                in_axes=(0, 0, None),
+            )(tr_p, nt, batch.sa)
+            return c, t
+
+        out["consensus"] = _timeit(
+            jax.jit(cons_both), critic, tr, nbr, nbr_t, reps=reps
+        )
 
     r_agents = jnp.moveaxis(batch.r, 1, 0)  # (N, B, 1)
+    if stacked:
+        stack2 = netstack_stack(critic, tr)
+        fits2 = jax.jit(
+            lambda p2, cp, r: coop_pair_fit(
+                p2, x2, pair_bootstrap_targets(cfg, cp, batch.ns, r),
+                mask, cfg,
+            )[0]
+        )
+        out["phase1_fits"] = _timeit(fits2, stack2, critic, r_agents, reps=reps)
+    else:
 
-    def fits(critic_p, tr_p, r):
-        c, _ = jax.vmap(
-            lambda p, rr: coop_local_critic_fit(
-                p, batch.s, batch.ns, rr, mask, cfg
-            )
-        )(critic_p, r)
-        t, _ = jax.vmap(
-            lambda p, rr: coop_local_tr_fit(p, batch.sa, rr, mask, cfg)
-        )(tr_p, r)
-        return c, t
+        def fits(critic_p, tr_p, r):
+            c, _ = jax.vmap(
+                lambda p, rr: coop_local_critic_fit(
+                    p, batch.s, batch.ns, rr, mask, cfg
+                )
+            )(critic_p, r)
+            t, _ = jax.vmap(
+                lambda p, rr: coop_local_tr_fit(p, batch.sa, rr, mask, cfg)
+            )(tr_p, r)
+            return c, t
 
-    out["phase1_fits"] = _timeit(
-        jax.jit(fits), critic, state.params.tr, r_agents, reps=reps
+        out["phase1_fits"] = _timeit(
+            jax.jit(fits), critic, tr, r_agents, reps=reps
+        )
+
+    # the whole epoch + the residual the micro components don't cover
+    r_coop = team_average_reward(cfg, batch.r)
+    epoch = jax.jit(
+        lambda p, b, rc, k: critic_tr_epoch(
+            cfg, (p.critic, p.tr, p.critic_local), b, rc, k
+        )
     )
+    out["epoch"] = _timeit(epoch, state.params, batch, r_coop, key, reps=reps)
+    out["epoch_other"] = out["epoch"] - out["consensus"] - out["phase1_fits"]
     return out

@@ -66,5 +66,19 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
     --nrow 3 --ncol 3 \
     --n_episodes 4 --n_ep_fixed 2 --max_ep_len 4 --n_epochs 2 --H 1 \
     --consensus_layout flat --fault_drop_p 0.2 --fault_nan_p 0.2 \
-    --sanitize --summary_dir "$smoke_dir" --quiet
+    --sanitize --netstack off --summary_dir "$smoke_dir" --quiet
 echo "flattened ragged-graph smoke cell OK"
+
+# Netstack smoke cell: the same ragged + sanitize + fault-plan scenario
+# on the STACKED critic+TR path (--netstack on, the default) — the
+# combined-block gather + flat fault injection + masked sanitize
+# consensus end to end, i.e. the exact wire-up tests pin leaf-for-leaf
+# against the dual arm above (tests/test_netstack.py), proven here
+# through the full CLI -> Config -> trainer stack.
+timeout -k 10 180 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
+    --n_agents 4 --in_nodes '[[0,1,2,3],[1,2,3,0],[2,3,0],[3,0,1]]' \
+    --nrow 3 --ncol 3 \
+    --n_episodes 4 --n_ep_fixed 2 --max_ep_len 4 --n_epochs 2 --H 1 \
+    --netstack on --fault_drop_p 0.2 --fault_nan_p 0.2 --fault_stale_p 0.1 \
+    --sanitize --summary_dir "$smoke_dir" --quiet
+echo "netstack ragged smoke cell OK"

@@ -16,7 +16,9 @@
 #   2. per-phase TPU profile rows incl. the dense n16/n64 shapes behind
 #      the CPU tournament crossover refit, with the consensus
 #      micro-breakdown (gather vs trim-bounds vs clip/mean) enabled
-#      (PERF.jsonl; completes PERF.md's table)
+#      (PERF.jsonl; completes PERF.md's table), plus (2b) the netstack
+#      on/off A/B — the one-block critic+TR epoch vs the dual-launch
+#      arm, the on-chip confirmation of PERF.md's "netstack" CPU table
 #   3. a bfloat16 row for the 256-wide config (the MXU-native compute
 #      mode; its float32 comparator is step 1's n64_large_h2/xla row)
 #   4. the fused experiment matrix at the published scale - 16 cells x
@@ -60,6 +62,12 @@ run_step "2. per-phase profile rows (tournament-vs-sort arms + micro)" \
     timeout 3600 python -m rcmarl_tpu profile \
     --configs ref5_ring n16_full n64_full n64_large_h2 \
     --impl xla xla_sort pallas pallas_sort \
+    --consensus_micro --out PERF.jsonl
+
+run_step "2b. netstack A/B rows (one-block epoch vs dual-launch arm)" \
+    timeout 3600 python -m rcmarl_tpu profile \
+    --configs ref5_ring n16_full n64_full \
+    --netstack on off \
     --consensus_micro --out PERF.jsonl
 
 run_step "3. bfloat16 row (256-wide config)" \

@@ -1,0 +1,337 @@
+"""Netstack equivalence matrix: the one-block critic+TR epoch
+(``Config.netstack``, the default) pinned leaf-for-leaf against the
+dual-launch comparison arm (``netstack=False``) — the contract that lets
+the stacked path replace the historical one without renumbering any
+golden trajectory.
+
+The stacking is engineered to be exactly neutral: critic inputs and
+first-layer rows are zero-padded to the TR width (padded columns are
+exact zeros, so padded rows get bitwise-zero gradients —
+tests/test_netstack_properties.py), phase-II aggregation of the combined
+(n_in, P_critic + P_tr) block is elementwise along columns, and every
+RNG stream (adversary fit shuffles, fault masks, corruption noise) is
+drawn with the dual arm's exact key structure. On this backend the whole
+update block is bitwise-identical between the arms for every mode with
+hidden layers; the degenerate head-only (hidden=()) nets compare at
+float32 rounding (their stacked projection contracts over a padded
+feature axis).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from rcmarl_tpu.agents.updates import (
+    Batch,
+    adv_critic_fit,
+    adv_pair_fit,
+    adv_tr_fit,
+    consensus_update_one,
+    consensus_update_pair,
+    coop_local_critic_fit,
+    coop_local_tr_fit,
+    coop_pair_fit,
+    netstack_pair_inputs,
+    pair_bootstrap_targets,
+)
+from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+from rcmarl_tpu.faults import FaultPlan, apply_link_faults, apply_link_faults_flat
+from rcmarl_tpu.models.mlp import init_stacked_mlp, netstack_split, netstack_stack
+from rcmarl_tpu.training.update import (
+    _pair_block,
+    _pair_segments,
+    gather_neighbor_messages,
+    init_agent_params,
+    spec_from_config,
+    update_block,
+)
+
+BASE = dict(
+    n_agents=5,
+    agent_roles=(Roles.COOPERATIVE,) * 3 + (Roles.GREEDY, Roles.MALICIOUS),
+    in_nodes=circulant_in_nodes(5, 4),
+    H=1,
+    n_epochs=2,
+    hidden=(8, 8),
+    coop_fit_steps=3,
+    adv_fit_epochs=2,
+    adv_fit_batch=8,
+    batch_size=8,
+)
+
+RAGGED = ((0, 1, 2, 3), (1, 2, 3), (2, 3, 4, 0), (3, 4, 0), (4, 0, 1))
+
+PLAN = FaultPlan(
+    drop_p=0.1, stale_p=0.2, corrupt_p=0.2, flip_p=0.1, nan_p=0.05, inf_p=0.05
+)
+
+
+def _mk_batch(key, cfg, B, full=False):
+    ks = jax.random.split(key, 4)
+    b = Batch(
+        s=jax.random.normal(ks[0], (B, cfg.n_agents, cfg.n_states)),
+        ns=jax.random.normal(ks[1], (B, cfg.n_agents, cfg.n_states)),
+        a=jax.random.randint(ks[2], (B, cfg.n_agents, 1), 0, cfg.n_actions).astype(
+            jnp.float32
+        ),
+        r=jax.random.normal(ks[3], (B, cfg.n_agents, 1)),
+        mask=jnp.ones((B,), jnp.float32)
+        if full
+        else (jnp.arange(B) < B - 3).astype(jnp.float32),
+    )
+    return b
+
+
+def _run_block(cfg, spec=None):
+    params = init_agent_params(jax.random.PRNGKey(0), cfg)
+    batch = _mk_batch(jax.random.PRNGKey(1), cfg, 40)
+    fresh = _mk_batch(jax.random.PRNGKey(2), cfg, 16, full=True)
+    return update_block(cfg, params, batch, fresh, jax.random.PRNGKey(3), spec)
+
+
+def _assert_tree_equal(a, b, exact=True):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        if exact:
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        else:
+            np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-7
+            )
+
+
+class TestBlockEquivalence:
+    """update_block(netstack=True) == update_block(netstack=False),
+    leaf for leaf, across every consensus mode."""
+
+    MODES = {
+        "static_h1": {},
+        "h0": dict(H=0),
+        "sanitize": dict(consensus_sanitize=True),
+        "faults": dict(fault_plan=PLAN, consensus_sanitize=True),
+        "ragged_masked": dict(in_nodes=RAGGED),
+        "ragged_sanitize_faults": dict(
+            in_nodes=RAGGED, consensus_sanitize=True, fault_plan=PLAN
+        ),
+        "xla_sort": dict(consensus_impl="xla_sort"),
+        "pallas_interpret": dict(consensus_impl="pallas_interpret"),
+        "pallas_interpret_sort_sanitize": dict(
+            consensus_impl="pallas_interpret", consensus_sanitize=True
+        ),
+    }
+
+    @pytest.mark.parametrize("mode", sorted(MODES))
+    def test_pinned_leaf_for_leaf(self, mode):
+        kw = dict(BASE)
+        kw.update(self.MODES[mode])
+        on = _run_block(Config(**kw, netstack=True))
+        off = _run_block(Config(**kw, netstack=False))
+        _assert_tree_equal(on, off)
+
+    def test_traced_spec(self):
+        """The fused-matrix path: netstack spec-mode == dual spec-mode
+        (same traced-H trim and compute-all-then-mask role plumbing)."""
+        cfg_on = Config(**BASE, netstack=True)
+        cfg_off = Config(**BASE, netstack=False)
+        on = _run_block(cfg_on, spec_from_config(cfg_on))
+        off = _run_block(cfg_off, spec_from_config(cfg_off))
+        _assert_tree_equal(on, off)
+
+    def test_head_only_nets(self):
+        """hidden=() makes the two families' feature widths differ, so
+        the stacked projection contracts over a padded axis — equal to
+        float32 rounding rather than bitwise."""
+        kw = dict(BASE, hidden=())
+        on = _run_block(Config(**kw, netstack=True))
+        off = _run_block(Config(**kw, netstack=False))
+        _assert_tree_equal(on, off, exact=False)
+
+    def test_with_diag_counters_match(self):
+        """Degradation counters from the combined block == the sum the
+        dual arm computes over its two per-tree blocks."""
+        kw = dict(BASE, fault_plan=PLAN, consensus_sanitize=True)
+        args = lambda cfg: (
+            cfg,
+            init_agent_params(jax.random.PRNGKey(0), cfg),
+            _mk_batch(jax.random.PRNGKey(1), cfg, 40),
+            _mk_batch(jax.random.PRNGKey(2), cfg, 16, full=True),
+            jax.random.PRNGKey(3),
+        )
+        _, diag_on = update_block(*args(Config(**kw, netstack=True)), with_diag=True)
+        _, diag_off = update_block(*args(Config(**kw, netstack=False)), with_diag=True)
+        assert int(diag_on.nonfinite) == int(diag_off.nonfinite)
+        assert int(diag_on.deficit) == int(diag_off.deficit)
+
+
+class TestPairPrimitives:
+    """The netstack building blocks against their dual-arm twins."""
+
+    def _cfg(self, **kw):
+        return Config(**dict(BASE, **kw))
+
+    def _nets(self, cfg, key=0):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(key))
+        critic = init_stacked_mlp(k1, cfg.n_agents, cfg.obs_dim, cfg.hidden, 1)
+        tr = init_stacked_mlp(k2, cfg.n_agents, cfg.sa_dim, cfg.hidden, 1)
+        return critic, tr
+
+    def test_netstack_roundtrip(self):
+        cfg = self._cfg()
+        critic, tr = self._nets(cfg)
+        c2, t2 = netstack_split(
+            netstack_stack(critic, tr), (cfg.obs_dim, cfg.sa_dim)
+        )
+        _assert_tree_equal(critic, c2)
+        _assert_tree_equal(tr, t2)
+
+    def test_coop_pair_fit_matches_separate_fits(self):
+        cfg = self._cfg()
+        critic, tr = self._nets(cfg)
+        batch = _mk_batch(jax.random.PRNGKey(1), cfg, 24)
+        r = jnp.moveaxis(batch.r, 1, 0)
+        x2 = netstack_pair_inputs(cfg, batch.s, batch.sa)
+        stack2 = netstack_stack(critic, tr)
+        pair, _ = jax.jit(
+            lambda p2, cp, rr: coop_pair_fit(
+                p2, x2, pair_bootstrap_targets(cfg, cp, batch.ns, rr),
+                batch.mask, cfg,
+            )
+        )(stack2, critic, r)
+        c_pair, t_pair = netstack_split(pair, (cfg.obs_dim, cfg.sa_dim))
+        c_ref, _ = jax.jit(
+            jax.vmap(
+                lambda p, rr: coop_local_critic_fit(
+                    p, batch.s, batch.ns, rr, batch.mask, cfg
+                )
+            )
+        )(critic, r)
+        t_ref, _ = jax.jit(
+            jax.vmap(
+                lambda p, rr: coop_local_tr_fit(p, batch.sa, rr, batch.mask, cfg)
+            )
+        )(tr, r)
+        _assert_tree_equal(c_pair, c_ref)
+        _assert_tree_equal(t_pair, t_ref)
+
+    def test_adv_pair_fit_matches_separate_fits(self):
+        """Same keys -> same shuffles -> identical minibatch trajectories."""
+        cfg = self._cfg()
+        critic, tr = self._nets(cfg)
+        batch = _mk_batch(jax.random.PRNGKey(1), cfg, 24)
+        r = jnp.moveaxis(batch.r, 1, 0)
+        x2 = netstack_pair_inputs(cfg, batch.s, batch.sa)
+        kc, kt = jax.random.PRNGKey(10), jax.random.PRNGKey(11)
+        keys_c = jax.random.split(kc, cfg.n_agents)
+        keys_t = jax.random.split(kt, cfg.n_agents)
+        pair, _ = jax.jit(
+            lambda p2, cp, rr: adv_pair_fit(
+                jnp.stack([keys_c, keys_t]),
+                p2, x2, pair_bootstrap_targets(cfg, cp, batch.ns, rr),
+                batch.mask, cfg,
+            )
+        )(netstack_stack(critic, tr), critic, r)
+        c_pair, t_pair = netstack_split(pair, (cfg.obs_dim, cfg.sa_dim))
+        c_ref, _ = jax.jit(
+            jax.vmap(
+                lambda k, p, rr: adv_critic_fit(
+                    k, p, batch.s, batch.ns, rr, batch.mask, cfg
+                )
+            )
+        )(keys_c, critic, r)
+        t_ref, _ = jax.jit(
+            jax.vmap(
+                lambda k, p, rr: adv_tr_fit(k, p, batch.sa, rr, batch.mask, cfg)
+            )
+        )(keys_t, tr, r)
+        _assert_tree_equal(c_pair, c_ref)
+        _assert_tree_equal(t_pair, t_ref)
+
+    @pytest.mark.parametrize("valid", [None, (1.0, 1.0, 1.0, 0.0)])
+    def test_consensus_pair_matches_two_single_updates(self, valid):
+        cfg = self._cfg()
+        msg_c, msg_t = self._nets(cfg, key=1)  # n_in == n_agents messages
+        own_c = jax.tree.map(lambda l: l[0], msg_c)
+        own_t = jax.tree.map(lambda l: l[0], msg_t)
+        batch = _mk_batch(jax.random.PRNGKey(2), cfg, 16)
+        v = None if valid is None else jnp.asarray(valid)
+        blk = _pair_block(msg_c, msg_t)  # (n_in, P) — message stack as block
+        x2 = netstack_pair_inputs(cfg, batch.s, batch.sa)
+        pc, pt = jax.jit(
+            lambda oc, ot, b: consensus_update_pair(
+                oc, ot, b, x2, batch.mask, cfg, valid=v
+            )
+        )(own_c, own_t, blk[: cfg.n_in])
+        rc = jax.jit(
+            lambda own, nb, x: consensus_update_one(
+                own, nb, x, batch.mask, cfg, valid=v
+            )
+        )(own_c, jax.tree.map(lambda l: l[: cfg.n_in], msg_c), batch.s)
+        rt = jax.jit(
+            lambda own, nb, x: consensus_update_one(
+                own, nb, x, batch.mask, cfg, valid=v
+            )
+        )(own_t, jax.tree.map(lambda l: l[: cfg.n_in], msg_t), batch.sa)
+        _assert_tree_equal(pc, rc)
+        _assert_tree_equal(pt, rt)
+
+    def test_flat_faults_match_tree_faults(self):
+        """apply_link_faults_flat on the combined block == the two
+        per-tree apply_link_faults calls, raveled — masks, noise, and
+        stale replay all drawn from the dual arm's exact streams."""
+        cfg = self._cfg()
+        msg_c, msg_t = self._nets(cfg, key=3)
+        carry_c, carry_t = self._nets(cfg, key=4)
+        key = jax.random.PRNGKey(7)
+        nbr_c = gather_neighbor_messages(cfg, msg_c)
+        nbr_t = gather_neighbor_messages(cfg, msg_t)
+        stale_c = gather_neighbor_messages(cfg, carry_c)
+        stale_t = gather_neighbor_messages(cfg, carry_t)
+        ref_c = apply_link_faults(jax.random.fold_in(key, 0), nbr_c, stale_c, PLAN)
+        ref_t = apply_link_faults(jax.random.fold_in(key, 1), nbr_t, stale_t, PLAN)
+        flat = apply_link_faults_flat(
+            key,
+            gather_neighbor_messages(cfg, _pair_block(msg_c, msg_t)),
+            gather_neighbor_messages(cfg, _pair_block(carry_c, carry_t)),
+            PLAN,
+            _pair_segments(msg_c, msg_t),
+        )
+        # re-ravel the reference trees in the pair order and compare
+        ref_pair = (
+            (ref_c[:-1], ref_t[:-1]),
+            (ref_c[-1], ref_t[-1]),
+        )
+        N, n_in = cfg.n_agents, cfg.n_in
+        ref_flat = jnp.concatenate(
+            [l.reshape(N, n_in, -1) for l in jax.tree.leaves(ref_pair)], axis=-1
+        )
+        np.testing.assert_array_equal(
+            np.asarray(flat), np.asarray(ref_flat)
+        )
+
+    def test_auto_policy_resolves_by_backend(self):
+        """netstack='auto' (the Config default) is the measured backend
+        policy: dual-launch off-TPU, stacked on TPU — mirroring the
+        consensus_impl='auto' precedent."""
+        from rcmarl_tpu.training.update import netstack_enabled
+
+        cfg = Config(**BASE)  # default netstack='auto'
+        assert cfg.netstack == "auto"
+        expected = jax.default_backend() == "tpu"
+        assert netstack_enabled(cfg) == expected
+        assert netstack_enabled(cfg.replace(netstack=True)) is True
+        assert netstack_enabled(cfg.replace(netstack=False)) is False
+        with pytest.raises(ValueError, match="netstack"):
+            Config(**BASE, netstack="sideways")
+
+    def test_segments_cover_block(self):
+        cfg = self._cfg()
+        msg_c, msg_t = self._nets(cfg)
+        segs = _pair_segments(msg_c, msg_t)
+        P = _pair_block(msg_c, msg_t).shape[-1]
+        assert sum(s[3] for s in segs) == P
+        assert sorted({t for t, *_ in segs}) == [0, 1]
+        # offsets are contiguous and ordered
+        off = 0
+        for _, _, o, sz in segs:
+            assert o == off
+            off += sz

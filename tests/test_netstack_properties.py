@@ -1,0 +1,111 @@
+"""Property-based netstack contracts (hypothesis).
+
+Randomized twin of tests/test_netstack.py's deterministic matrix. The
+load-bearing property is the one the whole stacking trick rests on:
+zero-padded input columns contribute BITWISE-ZERO gradient to the
+padded first-layer rows — for arbitrary widths, batch contents,
+targets, and step counts — so a padded critic inside the netstack walks
+exactly the trajectory the unpadded critic walks, and the padded rows
+never drift from zero. Guarded like the other property modules: a
+missing hypothesis (the `test` extra) is a skip, never a collection
+error.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+pytest.importorskip("hypothesis")
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+from hypothesis.extra.numpy import arrays
+
+from rcmarl_tpu.models.mlp import (
+    init_mlp,
+    mlp_forward,
+    netstack_split,
+    netstack_stack,
+    pad_features,
+)
+from rcmarl_tpu.ops.fit import fit_mse_full_batch
+from rcmarl_tpu.ops.losses import weighted_mse
+
+finite = st.floats(-10.0, 10.0, allow_nan=False, width=32)
+
+
+@st.composite
+def fit_case(draw):
+    """(in_dim, pad_to, hidden, B, x, target, n_steps, seed)."""
+    in_dim = draw(st.integers(1, 6))
+    pad_to = in_dim + draw(st.integers(1, 5))
+    hidden = tuple(
+        draw(st.lists(st.integers(1, 6), min_size=0, max_size=2))
+    )
+    B = draw(st.integers(1, 8))
+    x = draw(arrays(np.float32, (B, in_dim), elements=finite))
+    target = draw(arrays(np.float32, (B, 1), elements=finite))
+    n_steps = draw(st.integers(1, 4))
+    seed = draw(st.integers(0, 2**16))
+    return in_dim, pad_to, hidden, B, x, target, n_steps, seed
+
+
+@settings(deadline=None, max_examples=25)
+@given(fit_case())
+def test_padded_columns_contribute_bitwise_zero_gradient(case):
+    """One gradient of the padded regression loss: the padded first-layer
+    rows' entries are EXACTLY 0.0 — not small, zero."""
+    in_dim, pad_to, hidden, B, x, target, _, seed = case
+    params = init_mlp(jax.random.PRNGKey(seed), in_dim, hidden, 1)
+    W1, b1 = params[0]
+    padded = ((jnp.pad(W1, ((0, pad_to - in_dim), (0, 0))), b1),) + params[1:]
+    xp = pad_features(jnp.asarray(x), pad_to)
+
+    g = jax.grad(
+        lambda p: weighted_mse(mlp_forward(p, xp), jnp.asarray(target))
+    )(padded)
+    pad_rows = np.asarray(g[0][0][in_dim:])
+    np.testing.assert_array_equal(pad_rows, np.zeros_like(pad_rows))
+
+
+@settings(deadline=None, max_examples=15)
+@given(fit_case())
+def test_padded_fit_rows_stay_zero_and_trim_to_unpadded_fit(case):
+    """Across a whole multi-step fit: padded rows stay exactly zero, and
+    the trimmed padded params equal the unpadded fit leaf for leaf."""
+    in_dim, pad_to, hidden, B, x, target, n_steps, seed = case
+    params = init_mlp(jax.random.PRNGKey(seed), in_dim, hidden, 1)
+    W1, b1 = params[0]
+    padded = ((jnp.pad(W1, ((0, pad_to - in_dim), (0, 0))), b1),) + params[1:]
+    x = jnp.asarray(x)
+    xp = pad_features(x, pad_to)
+    target = jnp.asarray(target)
+    mask = jnp.ones((B,), jnp.float32)
+    fwd = lambda p, xx: mlp_forward(p, xx)
+
+    fit_pad, _ = fit_mse_full_batch(padded, fwd, xp, target, mask, n_steps, 0.05)
+    fit_ref, _ = fit_mse_full_batch(params, fwd, x, target, mask, n_steps, 0.05)
+
+    pad_rows = np.asarray(fit_pad[0][0][in_dim:])
+    np.testing.assert_array_equal(pad_rows, np.zeros_like(pad_rows))
+    trimmed = ((fit_pad[0][0][:in_dim], fit_pad[0][1]),) + fit_pad[1:]
+    for a, b in zip(jax.tree.leaves(trimmed), jax.tree.leaves(fit_ref)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        )
+
+
+@settings(deadline=None, max_examples=10)
+@given(st.integers(1, 8), st.integers(1, 8), st.integers(1, 6), st.integers(0, 2**16))
+def test_netstack_roundtrip_property(d_a, extra, h, seed):
+    """stack -> split is the identity for arbitrary width pairs."""
+    d_b = d_a + extra
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    a = init_mlp(k1, d_a, (h,), 1)
+    b = init_mlp(k2, d_b, (h,), 1)
+    a2, b2 = netstack_split(netstack_stack(a, b), (d_a, d_b))
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(a2)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    for x, y in zip(jax.tree.leaves(b), jax.tree.leaves(b2)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))

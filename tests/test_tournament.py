@@ -273,7 +273,9 @@ class TestFlatLayoutMatchesPerLeaf:
 def test_flat_layout_end_to_end_block_matches_per_leaf():
     """One full training block under consensus_layout='flat' must
     reproduce 'per_leaf' bit-for-bit (raveling is elementwise-neutral,
-    so the whole trajectory is identical)."""
+    so the whole trajectory is identical). The layout knob only exists
+    on the dual-launch arm, so both configs pin netstack=False (the
+    netstack-vs-dual pin is tests/test_netstack.py)."""
     from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
     from rcmarl_tpu.training.trainer import init_train_state, train_block
 
@@ -295,8 +297,8 @@ def test_flat_layout_end_to_end_block_matches_per_leaf():
         batch_size=4,
         n_episodes=2,
     )
-    cfg_flat = Config(**kw, consensus_layout="flat")
-    cfg_leaf = Config(**kw, consensus_layout="per_leaf")
+    cfg_flat = Config(**kw, consensus_layout="flat", netstack=False)
+    cfg_leaf = Config(**kw, consensus_layout="per_leaf", netstack=False)
     s0 = init_train_state(cfg_flat, jax.random.PRNGKey(0))
     s_flat, m_flat = train_block(cfg_flat, s0)
     s_leaf, m_leaf = train_block(cfg_leaf, s0)
