@@ -1224,6 +1224,120 @@ def cmd_profile(argv) -> int:
 
 
 # --------------------------------------------------------------------------
+# lint
+# --------------------------------------------------------------------------
+
+
+def cmd_lint(argv) -> int:
+    p = argparse.ArgumentParser(
+        prog="rcmarl_tpu lint",
+        description="graftlint: static analysis + compiled-artifact "
+        "audits enforcing the framework's bitwise-reproducibility and "
+        "compile-once contracts (rcmarl_tpu.lint). The AST passes run "
+        "by default; the runtime audits are opt-in flags. Exit 0 = "
+        "zero findings.",
+    )
+    p.add_argument(
+        "--root",
+        type=str,
+        default=None,
+        help="source tree to lint (default: the installed rcmarl_tpu "
+        "package)",
+    )
+    p.add_argument(
+        "--retrace",
+        action="store_true",
+        help="also run the retrace auditor: tiny guarded+faulted train "
+        "runs on both netstack arms plus a clean donated run; every "
+        "jitted entry point must compile exactly once after warmup "
+        "(rcmarl_tpu.lint.retrace)",
+    )
+    p.add_argument(
+        "--donation",
+        action="store_true",
+        help="also audit the compiled donated entry points: declared "
+        "donate_argnums must survive to input_output_alias metadata in "
+        "the executable (rcmarl_tpu.lint.donation)",
+    )
+    p.add_argument(
+        "--backends",
+        action="store_true",
+        help="also audit the jaxprs of all six aggregation backends "
+        "(x sanitize) and both netstack epoch arms for forbidden "
+        "primitives and dtype/weak-type drift (rcmarl_tpu.lint.backends)",
+    )
+    p.add_argument(
+        "--all",
+        action="store_true",
+        help="shorthand for --retrace --donation --backends",
+    )
+    p.add_argument(
+        "--rules",
+        action="store_true",
+        help="print the rule-id table and the pragma escape syntax, "
+        "then exit",
+    )
+    args = p.parse_args(argv)
+
+    from rcmarl_tpu.lint import (
+        AUDIT_RULES,
+        SOURCE_RULES,
+        run_source_lint,
+    )
+
+    if args.rules:
+        print("AST rules (escape: '# lint: disable=<rule>' on the line,")
+        print("or '# lint: disable-file=<rule>' in the first 10 lines):")
+        for r in SOURCE_RULES:
+            print(f"  {r}")
+        print("runtime-audit rules (no pragma escape):")
+        for r in AUDIT_RULES:
+            print(f"  {r}")
+        return 0
+
+    findings = run_source_lint(args.root)
+    if findings and (args.retrace or args.donation or args.backends or args.all):
+        # fail fast: the runtime audits cost minutes of tiny training
+        # runs and compiles, and the exit status is already decided
+        for f in findings:
+            print(f)
+        print(
+            f"lint: {len(findings)} source finding(s); runtime audits "
+            "skipped (fix the source findings first)",
+            file=sys.stderr,
+        )
+        return 1
+    n_sections = 1
+    notes = []
+    if args.retrace or args.all:
+        from rcmarl_tpu.lint.retrace import audit_retrace
+
+        findings += audit_retrace()
+        n_sections += 1
+    if args.donation or args.all:
+        from rcmarl_tpu.lint.donation import audit_donation
+
+        f, nts = audit_donation()
+        findings += f
+        notes += nts
+        n_sections += 1
+    if args.backends or args.all:
+        from rcmarl_tpu.lint.backends import audit_backends
+
+        findings += audit_backends()
+        n_sections += 1
+    for note in notes:
+        print(f"# note: {note}", file=sys.stderr)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"lint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print(f"lint: OK ({n_sections} layer(s) clean)")
+    return 0
+
+
+# --------------------------------------------------------------------------
 # plot
 # --------------------------------------------------------------------------
 
@@ -1600,6 +1714,7 @@ def main(argv=None) -> int:
         "profile": cmd_profile,
         "parity": cmd_parity,
         "quality": cmd_quality,
+        "lint": cmd_lint,
     }
     if not argv or argv[0] in ("-h", "--help"):
         print(f"usage: python -m rcmarl_tpu {{{','.join(cmds)}}} [flags]")

@@ -1,0 +1,138 @@
+"""graftlint — static analysis + compiled-artifact audits for rcmarl_tpu.
+
+Two layers, one findings format (:mod:`.findings`), exposed as
+``python -m rcmarl_tpu lint``:
+
+**Layer 1 — AST source passes** over the package (no jax import, runs
+anywhere):
+
+================== ====================================================
+rule id            what it enforces
+================== ====================================================
+prng-reuse         every key consumed once; no sampling from split
+                   parents; no duplicate fold_in streams
+prng-split-discard split() entropy never thrown away positionally
+prng-int-seed      no PRNGKey/key minted inside jitted hot-path modules
+prng-fold-tag      fold_in stream tags are named constants (the
+                   faults.py dedicated-stream pattern), not magic ints
+host-sync          no device->host pulls (float/int/bool/np.asarray/
+                   .item()/device_get on traced values) in hot paths
+host-block         no block_until_ready barriers in hot-path modules
+static-unhashable  jit-static configs stay hashable (frozen-dataclass
+                   fields; mutable displays at static call positions)
+================== ====================================================
+
+**Layer 2 — compiled-artifact audits** (import jax, run real tiny
+programs; ``lint --retrace/--donation/--backends``):
+
+================== ====================================================
+retrace            each jitted entry point compiles exactly once after
+                   warmup across a guarded+faulted train run, on both
+                   netstack arms (:mod:`.retrace`)
+donation-dropped   update/train_block_donated keep their declared
+                   input->output buffer aliasing in the compiled
+                   executable (:mod:`.donation`)
+backend-impure     no callbacks/infeed/nondeterministic primitives in
+                   any aggregation-backend jaxpr (:mod:`.backends`)
+backend-dtype-drift aggregation outputs keep exact input dtype with no
+                   weak types, identical across all six backends and
+                   both netstack epoch arms (:mod:`.backends`)
+================== ====================================================
+
+Escape hatch for Layer 1: ``# lint: disable=<rule>`` on the flagged
+line (see :mod:`.findings`). The package itself must lint clean — CI
+runs the suite fail-fast (scripts/ci_tier1.sh, .github/workflows).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Optional
+
+from rcmarl_tpu.lint import hostsync, prng, staticargs
+from rcmarl_tpu.lint.findings import (
+    Finding,
+    PragmaIndex,
+    filter_pragmas,
+    is_hot_path,
+    iter_source_files,
+    package_root,
+    sort_findings,
+)
+
+__all__ = [
+    "Finding",
+    "SOURCE_RULES",
+    "AUDIT_RULES",
+    "lint_file",
+    "run_source_lint",
+]
+
+#: Layer-1 rule ids (stable; the pragma escape and docs key on these).
+SOURCE_RULES = (
+    "prng-reuse",
+    "prng-split-discard",
+    "prng-int-seed",
+    "prng-fold-tag",
+    "host-sync",
+    "host-block",
+    "static-unhashable",
+)
+
+#: Layer-2 rule ids.
+AUDIT_RULES = (
+    "retrace",
+    "donation-dropped",
+    "backend-impure",
+    "backend-dtype-drift",
+)
+
+_PASSES = (prng.run, hostsync.run, staticargs.run)
+
+
+def lint_file(
+    path: Path,
+    rel_path: Optional[str] = None,
+    hot_path: Optional[bool] = None,
+) -> List[Finding]:
+    """Run every AST pass over one file; pragma escapes applied.
+
+    ``rel_path`` is the display path (defaults to the path as given);
+    ``hot_path`` forces the traced-code rule scope (defaults to the
+    package-relative hot-path match — fixtures force it True).
+    """
+    path = Path(path)
+    rel = rel_path if rel_path is not None else str(path)
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=rel)
+    except SyntaxError as e:
+        return [
+            Finding(
+                "syntax-error", rel, e.lineno or 1, f"cannot parse: {e.msg}"
+            )
+        ]
+    hot = is_hot_path(rel) if hot_path is None else hot_path
+    findings: List[Finding] = []
+    for p in _PASSES:
+        findings.extend(p(rel, tree, hot))
+    return filter_pragmas(findings, PragmaIndex.from_source(source))
+
+
+def run_source_lint(root: "Path | str | None" = None) -> List[Finding]:
+    """Layer 1 over every ``.py`` under ``root`` (default: the installed
+    ``rcmarl_tpu`` package). Paths report relative to ``root``."""
+    root = package_root() if root is None else Path(root)
+    findings: List[Finding] = []
+    for path in iter_source_files(root):
+        # display paths keep the root's own name ('rcmarl_tpu/ops/…')
+        # so every layer — AST passes, retrace anchors, donation
+        # anchors — reports the same file the same way
+        rel = (
+            str(Path(root.name) / path.relative_to(root))
+            if path != root
+            else path.name
+        )
+        findings.extend(lint_file(path, rel_path=rel))
+    return sort_findings(findings)

@@ -1,0 +1,227 @@
+"""Backend purity / dtype audit — jaxpr-level contracts of the kernels.
+
+The cross-backend bitwise guarantee (PARITY.md, tests/test_faults.py)
+only holds if every aggregation backend stays a PURE, deterministic,
+transfer-free function of its inputs with exact dtype preservation. A
+callback smuggled into a kernel, a stateful-RNG primitive, or a
+``weak_type``/dtype drift between two backends would break the pin in
+ways unit tests only catch for the shapes they enumerate. This audit
+walks the actual jaxprs:
+
+- every mode in :data:`rcmarl_tpu.ops.aggregation.AUDIT_BACKEND_MODES`
+  (the six-backend contract table), with and without ``sanitize``,
+  traced over a representative two-leaf message tree;
+- both netstack epoch arms (``critic_tr_epoch`` with
+  ``netstack=True``/``False``) under an active fault plan + sanitize —
+  asserting identical output structure/shape/dtype leaf for leaf, so
+  the stacked and dual-launch programs cannot drift apart at the type
+  level.
+
+Findings: ``backend-impure`` (forbidden primitive in a jaxpr) and
+``backend-dtype-drift`` (dtype/weak-type change, or cross-arm aval
+mismatch). Anchored to the owning module; no pragma escape.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from rcmarl_tpu.lint.findings import Finding
+
+#: Primitives that must never appear in a consensus/epoch jaxpr: host
+#: callbacks and device->host transfers (the bitwise pin cannot survive
+#: a host round trip) and XLA's stateful RNG (nondeterministic across
+#: runs/backends; all sanctioned randomness is keyed threefry). Note
+#: ``device_put`` is NOT here: in a jaxpr it is host-constant placement
+#: ONTO the device (static config tables entering the program), the
+#: benign direction.
+FORBIDDEN_PRIMITIVES = frozenset(
+    {
+        "pure_callback",
+        "io_callback",
+        "debug_callback",
+        "callback",
+        "infeed",
+        "outfeed",
+        "rng_uniform",
+        "copy_to_host",
+    }
+)
+
+_AGG_ANCHOR = "rcmarl_tpu/ops/aggregation.py"
+_EPOCH_ANCHOR = "rcmarl_tpu/training/update.py"
+
+
+def _walk_primitives(jaxpr, acc=None):
+    """All primitive names in a jaxpr, recursing into sub-jaxprs
+    (scan/cond/pjit/pallas bodies)."""
+    acc = set() if acc is None else acc
+    for eqn in jaxpr.eqns:
+        acc.add(eqn.primitive.name)
+        for v in eqn.params.values():
+            items = v if isinstance(v, (list, tuple)) else [v]
+            for item in items:
+                inner = getattr(item, "jaxpr", None)
+                if inner is not None:
+                    _walk_primitives(inner, acc)
+                elif hasattr(item, "eqns"):
+                    _walk_primitives(item, acc)
+    return acc
+
+
+def _out_signature(closed_jaxpr):
+    return tuple(
+        (tuple(v.aval.shape), str(v.aval.dtype), bool(getattr(v.aval, "weak_type", False)))
+        for v in closed_jaxpr.jaxpr.outvars
+    )
+
+
+def _audit_aggregation() -> List[Finding]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rcmarl_tpu.ops.aggregation import (
+        AUDIT_BACKEND_MODES,
+        resilient_aggregate_tree,
+    )
+
+    findings: List[Finding] = []
+    tree = {
+        "w": jnp.ones((5, 3, 4), jnp.float32),
+        "b": jnp.ones((5, 7), jnp.float32),
+    }
+    valid = jnp.asarray(np.array([1.0, 1.0, 1.0, 1.0, 0.0]), jnp.float32)
+    signatures = {}
+    for name, recipe in AUDIT_BACKEND_MODES:
+        for sanitize in (False, True):
+            kwargs = {"impl": recipe["impl"], "sanitize": sanitize}
+            H = jnp.asarray(1, jnp.int32) if recipe.get("traced_h") else 1
+            if recipe.get("masked"):
+                kwargs["valid"] = valid
+            label = f"{name}{'+sanitize' if sanitize else ''}"
+            closed = jax.make_jaxpr(
+                lambda t, kw=kwargs, h=H: resilient_aggregate_tree(t, h, **kw)
+            )(tree)
+            bad = _walk_primitives(closed.jaxpr) & FORBIDDEN_PRIMITIVES
+            if bad:
+                findings.append(
+                    Finding(
+                        "backend-impure",
+                        _AGG_ANCHOR,
+                        1,
+                        f"backend {label}: forbidden primitive(s) "
+                        f"{sorted(bad)} in the aggregation jaxpr",
+                    )
+                )
+            sig = _out_signature(closed)
+            for shape, dtype, weak in sig:
+                if dtype != "float32" or weak:
+                    findings.append(
+                        Finding(
+                            "backend-dtype-drift",
+                            _AGG_ANCHOR,
+                            1,
+                            f"backend {label}: output aval "
+                            f"({shape}, {dtype}, weak={weak}) drifts from "
+                            "the exact strong-f32 contract",
+                        )
+                    )
+            signatures.setdefault(sanitize, {})[name] = sig
+    for sanitize, by_name in signatures.items():
+        ref_name, ref_sig = next(iter(by_name.items()))
+        for name, sig in by_name.items():
+            if sig != ref_sig:
+                findings.append(
+                    Finding(
+                        "backend-dtype-drift",
+                        _AGG_ANCHOR,
+                        1,
+                        f"backends {ref_name} and {name} disagree on "
+                        f"output avals (sanitize={sanitize}): the "
+                        "cross-backend bitwise pin cannot hold across "
+                        "differing types",
+                    )
+                )
+    return findings
+
+
+def _netstack_cfg(netstack: bool):
+    from rcmarl_tpu.lint.configs import tiny_faulted_cfg
+
+    return tiny_faulted_cfg(netstack)
+
+
+def _audit_netstack_arms() -> List[Finding]:
+    import jax
+    import jax.numpy as jnp
+
+    from rcmarl_tpu.agents.updates import Batch
+    from rcmarl_tpu.training.update import critic_tr_epoch, init_agent_params
+
+    findings: List[Finding] = []
+    B = 24
+    arms = {}
+    for netstack in (False, True):
+        cfg = _netstack_cfg(netstack)
+        params = jax.eval_shape(
+            lambda k, c=cfg: init_agent_params(k, c), jax.random.PRNGKey(0)
+        )
+        N = cfg.n_agents
+        batch = Batch(
+            s=jnp.zeros((B, N, cfg.n_states), jnp.float32),
+            ns=jnp.zeros((B, N, cfg.n_states), jnp.float32),
+            a=jnp.zeros((B, N, 1), jnp.float32),
+            r=jnp.zeros((B, N, 1), jnp.float32),
+            mask=jnp.ones((B,), jnp.float32),
+        )
+        r_coop = jnp.zeros((B, 1), jnp.float32)
+        carry_avals = (params.critic, params.tr, params.critic_local)
+        carry = jax.tree.map(
+            lambda a: jnp.zeros(a.shape, a.dtype), carry_avals
+        )
+        fn = lambda c, b, rc, k, cfg=cfg: critic_tr_epoch(
+            cfg, c, b, rc, k, with_diag=True
+        )
+        key = jax.random.PRNGKey(0)
+        closed = jax.make_jaxpr(fn)(carry, batch, r_coop, key)
+        bad = _walk_primitives(closed.jaxpr) & FORBIDDEN_PRIMITIVES
+        arm = "stacked" if netstack else "dual"
+        if bad:
+            findings.append(
+                Finding(
+                    "backend-impure",
+                    _EPOCH_ANCHOR,
+                    1,
+                    f"netstack {arm} arm: forbidden primitive(s) "
+                    f"{sorted(bad)} in the epoch jaxpr",
+                )
+            )
+        out = jax.eval_shape(fn, carry, batch, r_coop, key)
+        arms[arm] = jax.tree.map(
+            lambda a: (tuple(a.shape), str(a.dtype)), out
+        )
+    dual, stacked = arms["dual"], arms["stacked"]
+    try:
+        same = jax.tree.all(jax.tree.map(lambda a, b: a == b, dual, stacked))
+    except ValueError:  # structure mismatch
+        same = False
+    if not same:
+        findings.append(
+            Finding(
+                "backend-dtype-drift",
+                _EPOCH_ANCHOR,
+                1,
+                "netstack arms disagree on epoch output "
+                "structure/shapes/dtypes: the stacked and dual-launch "
+                "programs have drifted apart at the type level",
+            )
+        )
+    return findings
+
+
+def audit_backends() -> List[Finding]:
+    """``lint --backends``: the full jaxpr-level purity/dtype audit —
+    all six aggregation backends (× sanitize) plus both netstack epoch
+    arms. Pure tracing; no compilation, runs on any host."""
+    return _audit_aggregation() + _audit_netstack_arms()

@@ -1,0 +1,43 @@
+"""The ONE tiny training Config the runtime audits share.
+
+Retrace, donation, and backend audits all exercise the same miniature
+scenario (3 cooperative agents, full 3-ring, 3x3 grid, 2-episode
+blocks, H=1) and differ only in the netstack / fault-plan / sanitize
+knobs they probe. Keeping the base here means a Config signature or
+validation change — exactly the drift class this suite polices — is
+fixed once, and the three audits provably audit the same workload.
+"""
+
+from __future__ import annotations
+
+
+def tiny_cfg(**overrides):
+    """A 3-agent audit config; keyword overrides win over the base."""
+    from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+
+    base = dict(
+        n_agents=3,
+        agent_roles=(Roles.COOPERATIVE,) * 3,
+        in_nodes=circulant_in_nodes(3, 3),
+        nrow=3,
+        ncol=3,
+        n_episodes=6,
+        n_ep_fixed=2,
+        max_ep_len=4,
+        n_epochs=2,
+        H=1,
+    )
+    base.update(overrides)
+    return Config(**base)
+
+
+def tiny_faulted_cfg(netstack, **overrides):
+    """The guarded+faulted variant (drop+NaN+stale plan, sanitize on)."""
+    from rcmarl_tpu.faults import FaultPlan
+
+    return tiny_cfg(
+        netstack=netstack,
+        fault_plan=FaultPlan(drop_p=0.2, nan_p=0.2, stale_p=0.1),
+        consensus_sanitize=True,
+        **overrides,
+    )

@@ -1,0 +1,117 @@
+"""Finding records, pragma escapes, and source-file discovery.
+
+Every lint rule — AST pass or compiled-artifact audit — reports through
+the same :class:`Finding` record: a stable rule id, a ``file:line``
+anchor, and a one-line message. Findings are what the CLI prints, what
+``tests/test_lint.py`` pins, and what the pragma escape suppresses.
+
+Pragma syntax (checked per physical line of the flagged location):
+
+    x = float(traced_value)  # lint: disable=host-sync
+    # lint: disable=host-sync,prng-reuse     (several rules at once)
+
+A file-level escape in the first ``_FILE_PRAGMA_WINDOW`` lines disables
+a rule for the whole file:
+
+    # lint: disable-file=prng-int-seed
+
+Runtime-audit findings (retrace/donation/backends) anchor to the module
+that owns the audited artifact rather than a source line; they have no
+pragma escape — a broken compiled-artifact contract cannot be waived
+inline, only fixed (or the audit not requested).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, List, Sequence
+
+#: Modules whose functions run under jit in the training hot path — the
+#: scope of the traced-value rules (host-sync, prng-int-seed,
+#: prng-fold-tag). Entries ending in ``/`` match a directory anywhere
+#: in the path; others match as a path suffix — so the set holds for
+#: package-relative, repo-relative, and absolute display paths alike.
+HOT_PATH_PATTERNS = ("ops/", "agents/updates.py", "training/update.py")
+
+_LINE_PRAGMA = re.compile(r"#\s*lint:\s*disable=([\w,\-]+)")
+_FILE_PRAGMA = re.compile(r"#\s*lint:\s*disable-file=([\w,\-]+)")
+_FILE_PRAGMA_WINDOW = 10
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding: stable rule id + ``file:line`` + message."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+
+@dataclass
+class PragmaIndex:
+    """Per-file map of pragma-disabled rules (see module docstring)."""
+
+    line_disables: dict = field(default_factory=dict)  # line -> {rule,...}
+    file_disables: set = field(default_factory=set)
+
+    @classmethod
+    def from_source(cls, source: str) -> "PragmaIndex":
+        idx = cls()
+        for lineno, text in enumerate(source.splitlines(), start=1):
+            m = _LINE_PRAGMA.search(text)
+            if m:
+                idx.line_disables[lineno] = set(m.group(1).split(","))
+            if lineno <= _FILE_PRAGMA_WINDOW:
+                m = _FILE_PRAGMA.search(text)
+                if m:
+                    idx.file_disables |= set(m.group(1).split(","))
+        return idx
+
+    def disabled(self, rule: str, line: int) -> bool:
+        return rule in self.file_disables or rule in self.line_disables.get(
+            line, ()
+        )
+
+
+def filter_pragmas(
+    findings: Iterable[Finding], pragmas: PragmaIndex
+) -> List[Finding]:
+    return [f for f in findings if not pragmas.disabled(f.rule, f.line)]
+
+
+def is_hot_path(rel_path: str) -> bool:
+    """Whether a display path is in the jitted hot-path set — robust to
+    how the caller anchored it ('ops/fit.py', 'rcmarl_tpu/ops/fit.py',
+    or an absolute path all match)."""
+    rel = "/" + rel_path.replace("\\", "/")
+    for p in HOT_PATH_PATTERNS:
+        if p.endswith("/"):
+            if f"/{p}" in rel + "/":
+                return True
+        elif rel.endswith("/" + p):
+            return True
+    return False
+
+
+def package_root() -> Path:
+    """The ``rcmarl_tpu`` package directory (the default lint target)."""
+    return Path(__file__).resolve().parent.parent
+
+
+def iter_source_files(root: Path | None = None) -> List[Path]:
+    """Every ``.py`` file under ``root`` (default: the package itself),
+    sorted for stable output."""
+    root = package_root() if root is None else Path(root)
+    if root.is_file():
+        return [root]
+    return sorted(root.rglob("*.py"))
+
+
+def sort_findings(findings: Sequence[Finding]) -> List[Finding]:
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

@@ -1,0 +1,267 @@
+"""Host-synchronization AST pass (hot-path modules only).
+
+The throughput story (PERF.md, arXiv:2104.06272's compile-once /
+device-resident discipline) depends on the training hot path never
+forcing a device→host transfer mid-program: one stray ``float()`` on a
+traced value inside the update block serializes the dispatch queue.
+These rules police exactly the modules that trace under jit
+(:data:`.findings.HOT_PATH_PATTERNS`); host-side orchestration (CLI,
+trainer loop, analysis) is free to fetch.
+
+- ``host-sync`` — ``float()`` / ``int()`` / ``bool()`` /
+  ``np.asarray`` / ``np.array`` / ``jax.device_get`` applied to an
+  expression that is not provably STATIC, or any ``.item()`` call.
+  Static means derivable at trace time: literals, ``cfg``/``config``/
+  ``plan`` roots and locals assigned from them, module-level
+  ``UPPER_CASE`` constants, ``.shape``/``.ndim``/``.size``/``.dtype``
+  attributes (static on ANY object under jit), and compositions of
+  those through arithmetic, indexing, ``len``/``max``/``np.prod``-style
+  calls, and comprehensions. ``float(plan.stale_p)`` and
+  ``int(np.prod(l.shape[1:]))`` pass; ``float(loss)`` does not.
+- ``host-block`` — ``.block_until_ready()`` or
+  ``jax.block_until_ready(...)`` in a hot-path module: a deliberate
+  barrier belongs in the profiler/benchmark layers, never inside code
+  that traces into the production block.
+
+The static-expression analysis is a single linear pass per function
+(assignment order, no branches merged), which is exactly as smart as
+the hot-path modules need — anything cleverer should probably not be in
+the hot path in the first place.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from rcmarl_tpu.lint.findings import Finding
+
+#: Names that are jit-static by convention wherever they appear.
+STATIC_NAMES = frozenset({"cfg", "config", "plan"})
+
+#: Builtins/helpers that are static when all their arguments are.
+STATIC_CALLS = frozenset(
+    {
+        "abs", "bool", "dict", "enumerate", "float", "frozenset", "getattr",
+        "int", "isinstance", "len", "list", "max", "min", "range", "round",
+        "set", "sorted", "str", "sum", "tuple", "zip",
+    }
+)
+
+#: Modules whose attribute calls are host-side but static-safe on
+#: static inputs (shape math, config tables).
+STATIC_MODULES = frozenset({"np", "numpy", "math"})
+
+#: Attributes that are static on ANY object under jit (aval metadata).
+STATIC_ATTRS = frozenset({"shape", "ndim", "size", "dtype"})
+
+#: Call targets that force a host transfer when fed a traced value.
+SYNC_BUILTINS = frozenset({"float", "int", "bool"})
+SYNC_NP_FNS = frozenset({"asarray", "array", "float32", "float64", "int32"})
+
+
+class _FnScope(ast.NodeVisitor):
+    """Analyze one function: a linear static-locals dataflow feeding the
+    host-sync checks."""
+
+    def __init__(self, outer: "HostSyncPass") -> None:
+        self.outer = outer
+        self.static: Set[str] = set()
+
+    # ---- static-expression analysis ------------------------------------
+
+    def _static_fn(self, func: ast.expr) -> bool:
+        if isinstance(func, ast.Name):
+            return func.id in STATIC_CALLS
+        if isinstance(func, ast.Attribute):
+            root = func.value
+            if isinstance(root, ast.Name) and root.id in STATIC_MODULES:
+                return True  # np.prod / np.array / math.sqrt on statics
+            return self.is_static(root)  # cfg.padded_in_nodes(), plan.to_dict()
+        return False
+
+    def is_static(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, ast.Name):
+            return (
+                node.id in STATIC_NAMES
+                or node.id in self.static
+                or node.id in STATIC_MODULES
+                or node.id.isupper()
+            )
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return True
+            return self.is_static(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_static(node.value) and self.is_static(node.slice)
+        if isinstance(node, ast.Slice):
+            return all(
+                part is None or self.is_static(part)
+                for part in (node.lower, node.upper, node.step)
+            )
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return all(self.is_static(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return all(
+                k is not None and self.is_static(k) and self.is_static(v)
+                for k, v in zip(node.keys, node.values)
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self.is_static(node.operand)
+        if isinstance(node, ast.BinOp):
+            return self.is_static(node.left) and self.is_static(node.right)
+        if isinstance(node, ast.BoolOp):
+            return all(self.is_static(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self.is_static(node.left) and all(
+                self.is_static(c) for c in node.comparators
+            )
+        if isinstance(node, ast.IfExp):
+            return (
+                self.is_static(node.test)
+                and self.is_static(node.body)
+                and self.is_static(node.orelse)
+            )
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            saved = set(self.static)
+            ok = True
+            for gen in node.generators:
+                ok = ok and self.is_static(gen.iter)
+                for name in ast.walk(gen.target):
+                    if isinstance(name, ast.Name):
+                        self.static.add(name.id)
+                ok = ok and all(self.is_static(i) for i in gen.ifs)
+            ok = ok and self.is_static(node.elt)
+            self.static = saved
+            return ok
+        if isinstance(node, ast.Call):
+            return self._static_fn(node.func) and all(
+                self.is_static(a)
+                for a in list(node.args)
+                + [kw.value for kw in node.keywords if kw.arg != "self"]
+            )
+        if isinstance(node, ast.Starred):
+            return self.is_static(node.value)
+        return False
+
+    # ---- dataflow -------------------------------------------------------
+
+    def visit_Assign(self, node):  # noqa: N802
+        self.visit(node.value)
+        value_static = self.is_static(node.value)
+        for target in node.targets:
+            names = [
+                n.id
+                for n in ast.walk(target)
+                if isinstance(n, ast.Name)
+            ]
+            for name in names:
+                if value_static:
+                    self.static.add(name)
+                else:
+                    self.static.discard(name)
+
+    def visit_For(self, node):  # noqa: N802
+        if self.is_static(node.iter):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    self.static.add(n.id)
+        self.generic_visit(node)
+
+    # ---- the checks -----------------------------------------------------
+
+    def _flag(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.outer.findings.append(
+            Finding(rule, self.outer.path, node.lineno, msg)
+        )
+
+    def visit_Call(self, node):  # noqa: N802
+        func = node.func
+        # .item() / .block_until_ready() method calls
+        if isinstance(func, ast.Attribute):
+            if func.attr == "item":
+                self._flag(
+                    "host-sync",
+                    node,
+                    ".item() forces a device->host transfer inside the "
+                    "jitted hot path",
+                )
+            elif func.attr == "block_until_ready":
+                target = (
+                    ast.unparse(node.args[0])
+                    if isinstance(func.value, ast.Name)
+                    and func.value.id in ("jax",)
+                    and node.args
+                    else ast.unparse(func.value)
+                )
+                self._flag(
+                    "host-block",
+                    node,
+                    f"block_until_ready on {target!r}: completion "
+                    "barriers belong in the profiler/benchmark layers, "
+                    "not hot-path modules",
+                )
+            elif func.attr == "device_get" and isinstance(
+                func.value, ast.Name
+            ):
+                self._flag(
+                    "host-sync",
+                    node,
+                    "jax.device_get inside the jitted hot path is a "
+                    "host transfer",
+                )
+            elif (
+                isinstance(func.value, ast.Name)
+                and func.value.id in ("np", "numpy")
+                and func.attr in SYNC_NP_FNS
+                and node.args
+                and not all(self.is_static(a) for a in node.args)
+            ):
+                self._flag(
+                    "host-sync",
+                    node,
+                    f"np.{func.attr}() on a non-static value pulls the "
+                    "array to the host mid-trace; use jnp (or keep the "
+                    "input config-derived)",
+                )
+        elif (
+            isinstance(func, ast.Name)
+            and func.id in SYNC_BUILTINS
+            and node.args
+            and not all(self.is_static(a) for a in node.args)
+        ):
+            self._flag(
+                "host-sync",
+                node,
+                f"{func.id}() on a non-static value synchronizes the "
+                "device inside the hot path; only config/shape-derived "
+                "scalars may cross to Python here",
+            )
+        self.generic_visit(node)
+
+
+class HostSyncPass(ast.NodeVisitor):
+    """Run one :class:`_FnScope` per function (module-level code in the
+    hot-path modules is import-time, not traced — skipped)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.findings: List[Finding] = []
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        scope = _FnScope(self)
+        for stmt in node.body:
+            scope.visit(stmt)
+        # nested defs were visited by the scope walker already
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def run(path: str, tree: ast.Module, hot_path: bool) -> List[Finding]:
+    if not hot_path:
+        return []
+    p = HostSyncPass(path)
+    p.visit(tree)
+    return p.findings

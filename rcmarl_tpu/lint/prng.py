@@ -1,0 +1,263 @@
+"""PRNG-discipline AST pass.
+
+The framework's reproducibility contract (PARITY.md, tests' golden
+pins) rests on every PRNG stream staying exactly where the spec puts
+it: keys are split or folded into dedicated sub-streams, each sub-key
+is consumed exactly once, and jitted code never mints keys from raw
+ints (a key baked into a traced program makes every trace replay the
+same stream). These rules caught nothing less than the clean structure
+the package already has — their job is to keep it that way:
+
+- ``prng-reuse`` — a key expression consumed by more than one direct
+  ``jax.random`` sampler call in a scope, consumed after being passed
+  to ``split`` (the classic parent-key footgun), split after being
+  consumed, or folded twice with the same static tag (two identical
+  derived streams). Rebinding the name (``key = fold_in(key, tag)``)
+  resets its history.
+- ``prng-split-discard`` — ``split()`` entropy thrown away: an
+  ``_``-target in the unpack, a direct subscript of the split call, or
+  a split whose result is discarded entirely. Use ``fold_in`` (or
+  split fewer keys) instead of discarding streams positionally.
+- ``prng-int-seed`` — ``jax.random.PRNGKey``/``jax.random.key`` called
+  inside the jitted hot-path modules (:data:`.findings.HOT_PATH_PATTERNS`):
+  keys must flow in as arguments; a constant seed inside traced code is
+  a compile-time constant stream. (Host-side modules — CLI, trainer
+  setup, analysis — mint keys freely.)
+- ``prng-fold-tag`` — ``fold_in`` with a bare integer-literal tag in a
+  hot-path module. Dedicated streams follow the named-constant pattern
+  ``faults.py`` established (``_FAULT_STREAM``): the tag is part of the
+  RNG-layout spec and must be greppable, not a magic number.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from rcmarl_tpu.lint.findings import Finding
+
+#: Direct jax.random samplers: calls that CONSUME their first-arg key.
+CONSUMERS = frozenset(
+    {
+        "ball", "bernoulli", "beta", "binomial", "bits", "categorical",
+        "cauchy", "chisquare", "choice", "dirichlet", "double_sided_maxwell",
+        "exponential", "f", "gamma", "generalized_normal", "geometric",
+        "gumbel", "laplace", "loggamma", "logistic", "lognormal", "maxwell",
+        "multivariate_normal", "normal", "orthogonal", "pareto",
+        "permutation", "poisson", "rademacher", "randint", "rayleigh", "t",
+        "triangular", "truncated_normal", "uniform", "wald", "weibull_min",
+    }
+)
+
+KEY_MAKERS = frozenset({"PRNGKey", "key"})
+
+
+def _random_aliases(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    """(jax module names, jax.random module names) bound by imports."""
+    jax_names, random_names = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax":
+                    jax_names.add(a.asname or "jax")
+                elif a.name == "jax.random":
+                    random_names.add(a.asname or "random")
+        elif isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for a in node.names:
+                if a.name == "random":
+                    random_names.add(a.asname or "random")
+    return jax_names, random_names
+
+
+class _Scope:
+    """Per-function linear history of key uses (text-keyed)."""
+
+    def __init__(self) -> None:
+        self.consumed: Dict[str, int] = {}
+        self.split: Dict[str, int] = {}
+        self.fold_tags: Dict[Tuple[str, str], int] = {}
+
+    def rebind(self, name: str) -> None:
+        for table in (self.consumed, self.split):
+            for text in [t for t in table if t == name]:
+                del table[text]
+        for key in [k for k in self.fold_tags if k[0] == name]:
+            del self.fold_tags[key]
+
+
+class PRNGPass(ast.NodeVisitor):
+    """See module docstring. ``hot_path`` gates the traced-code rules."""
+
+    def __init__(self, path: str, tree: ast.Module, hot_path: bool) -> None:
+        self.path = path
+        self.hot_path = hot_path
+        self.findings: List[Finding] = []
+        self._jax, self._random = _random_aliases(tree)
+        self._scopes: List[_Scope] = [_Scope()]
+
+    # ---- classification -------------------------------------------------
+
+    def _random_fn(self, func: ast.expr) -> Optional[str]:
+        """The jax.random function name of a call target, or None."""
+        if not isinstance(func, ast.Attribute):
+            return None
+        value = func.value
+        if isinstance(value, ast.Attribute) and value.attr == "random":
+            if isinstance(value.value, ast.Name) and value.value.id in self._jax:
+                return func.attr
+        if isinstance(value, ast.Name) and value.id in self._random:
+            return func.attr
+        return None
+
+    @staticmethod
+    def _text(node: ast.expr) -> str:
+        return ast.unparse(node)
+
+    # ---- scope plumbing -------------------------------------------------
+
+    @property
+    def _scope(self) -> _Scope:
+        return self._scopes[-1]
+
+    def _in_new_scope(self, node: ast.AST) -> None:
+        self._scopes.append(_Scope())
+        self.generic_visit(node)
+        self._scopes.pop()
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        self._in_new_scope(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):  # noqa: N802
+        self._in_new_scope(node)
+
+    # ---- events ---------------------------------------------------------
+
+    def _flag(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.findings.append(Finding(rule, self.path, node.lineno, msg))
+
+    def visit_Assign(self, node):  # noqa: N802
+        self.visit(node.value)  # uses happen before the (re)bind
+        is_split = (
+            isinstance(node.value, ast.Call)
+            and self._random_fn(node.value.func) == "split"
+        )
+        for target in node.targets:
+            if isinstance(target, (ast.Tuple, ast.List)):
+                if is_split and any(
+                    isinstance(e, ast.Name) and e.id == "_"
+                    for e in target.elts
+                ):
+                    self._flag(
+                        "prng-split-discard",
+                        node,
+                        "split() sub-key discarded via '_' unpack; use "
+                        "fold_in (or split fewer keys) instead of "
+                        "throwing a stream away",
+                    )
+                for e in target.elts:
+                    if isinstance(e, ast.Name):
+                        self._scope.rebind(e.id)
+            elif isinstance(target, ast.Name):
+                self._scope.rebind(target.id)
+
+    def visit_Expr(self, node):  # noqa: N802
+        if (
+            isinstance(node.value, ast.Call)
+            and self._random_fn(node.value.func) == "split"
+        ):
+            self._flag(
+                "prng-split-discard",
+                node,
+                "split() result discarded entirely (statement has no "
+                "effect on any stream)",
+            )
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):  # noqa: N802
+        if (
+            isinstance(node.value, ast.Call)
+            and self._random_fn(node.value.func) == "split"
+        ):
+            self._flag(
+                "prng-split-discard",
+                node,
+                "subscripting split() discards the other sub-keys; "
+                "fold_in a dedicated tag instead",
+            )
+        self.generic_visit(node)
+
+    def visit_Call(self, node):  # noqa: N802
+        fn = self._random_fn(node.func)
+        scope = self._scope
+        if fn in KEY_MAKERS and self.hot_path:
+            self._flag(
+                "prng-int-seed",
+                node,
+                f"jax.random.{fn}() inside a jitted hot-path module bakes "
+                "a constant stream into the traced program; pass keys in "
+                "as arguments",
+            )
+        elif fn == "split" and node.args:
+            text = self._text(node.args[0])
+            if text in scope.consumed:
+                self._flag(
+                    "prng-reuse",
+                    node,
+                    f"key {text!r} split after already being consumed "
+                    f"(line {scope.consumed[text]}); derive sub-keys "
+                    "BEFORE sampling from a key",
+                )
+            scope.split[text] = node.lineno
+        elif fn == "fold_in" and len(node.args) >= 2:
+            text = self._text(node.args[0])
+            tag = node.args[1]
+            if (
+                self.hot_path
+                and isinstance(tag, ast.Constant)
+                and isinstance(tag.value, int)
+            ):
+                self._flag(
+                    "prng-fold-tag",
+                    node,
+                    f"fold_in({text}, {tag.value}) uses a bare literal "
+                    "stream tag; name it like faults.py's dedicated "
+                    "_FAULT_STREAM so the RNG layout stays greppable",
+                )
+            pair = (text, ast.dump(tag))
+            if pair in scope.fold_tags:
+                self._flag(
+                    "prng-reuse",
+                    node,
+                    f"fold_in({text}, {self._text(tag)}) duplicates the "
+                    f"stream derived at line {scope.fold_tags[pair]}: two "
+                    "identical tags give the SAME sub-stream",
+                )
+            scope.fold_tags[pair] = node.lineno
+        elif fn in CONSUMERS and node.args:
+            text = self._text(node.args[0])
+            if text in scope.consumed:
+                self._flag(
+                    "prng-reuse",
+                    node,
+                    f"key {text!r} consumed again (first consumed at "
+                    f"line {scope.consumed[text]}); every sampler call "
+                    "needs its own split/fold_in sub-key",
+                )
+            elif text in scope.split:
+                self._flag(
+                    "prng-reuse",
+                    node,
+                    f"key {text!r} consumed after being split "
+                    f"(line {scope.split[text]}); sample from the "
+                    "sub-keys, not the parent",
+                )
+            scope.consumed[text] = node.lineno
+        self.generic_visit(node)
+
+
+def run(path: str, tree: ast.Module, hot_path: bool) -> List[Finding]:
+    p = PRNGPass(path, tree, hot_path)
+    p.visit(tree)
+    return p.findings

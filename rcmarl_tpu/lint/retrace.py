@@ -1,0 +1,184 @@
+"""Retrace auditor — the compile-once contract, enforced at runtime.
+
+Podracer-style throughput (arXiv:2104.06272, PERF.md) assumes the
+steady-state loop re-dispatches ONE compiled program per entry point:
+a shape drift, an unhashable static arg, or a Python-value knob that
+changes per block silently turns every block into a recompile, and the
+regression surfaces only as mysterious wall-clock (DRIFT.md's week).
+This module makes the contract mechanical:
+
+- :class:`RetraceAuditor` — snapshot the tracing-cache sizes of the
+  registered jitted entry points
+  (:func:`rcmarl_tpu.utils.profiling.jit_entry_points`), run arbitrary
+  code under :meth:`~RetraceAuditor.expect_no_compiles`, and get a
+  ``retrace`` finding for every entry point that compiled again —
+  naming the offender and, via jax's cache-miss explanations, the
+  argument that changed.
+- :func:`audit_retrace` — the ``lint --retrace`` mode: tiny
+  guarded+faulted train runs on BOTH netstack arms plus a clean
+  (donated-path) run; one warmup block compiles, every later block must
+  hit the cache.
+
+Retrace findings have no pragma escape: a retracing entry point is a
+broken contract, not a style choice.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import logging
+import re
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from rcmarl_tpu.lint.findings import Finding
+
+_MISS = re.compile(r"TRACING CACHE MISS.*?because:\n((?:\s+.*\n?)*)")
+
+
+def _anchor(fn) -> tuple:
+    """(path, line) of a jitted entry point's wrapped function, with
+    the path relativized to the package parent so retrace findings use
+    the same 'rcmarl_tpu/…' display convention as every other layer."""
+    from rcmarl_tpu.lint.findings import package_root
+
+    wrapped = getattr(fn, "__wrapped__", fn)
+    code = getattr(wrapped, "__code__", None)
+    if code is None:
+        return "<jit>", 1
+    path = Path(code.co_filename)
+    try:
+        path = path.relative_to(package_root().parent)
+    except ValueError:
+        pass
+    return str(path), code.co_firstlineno
+
+
+class RetraceAuditor:
+    """Compile-count watchdog over the jitted entry points."""
+
+    def __init__(self, entries: Optional[Dict[str, object]] = None) -> None:
+        if entries is None:
+            from rcmarl_tpu.utils.profiling import jit_entry_points
+
+            entries = jit_entry_points()
+        for name, fn in entries.items():
+            if not hasattr(fn, "_cache_size"):
+                raise RuntimeError(
+                    f"entry point {name!r} exposes no _cache_size(); "
+                    "this jax version cannot be audited"
+                )
+        self.entries = dict(entries)
+        self.findings: List[Finding] = []
+
+    def snapshot(self) -> Dict[str, int]:
+        return {k: int(f._cache_size()) for k, f in self.entries.items()}
+
+    @contextlib.contextmanager
+    def expect_no_compiles(self, context: str = ""):
+        """Fail (as findings) any entry-point compile inside the block.
+
+        Enables ``jax_explain_cache_misses`` and captures jax's log so
+        a finding can say WHAT changed, not just who recompiled.
+        """
+        import jax
+
+        before = self.snapshot()
+        logger = logging.getLogger("jax")
+        buf = io.StringIO()
+        handler = logging.StreamHandler(buf)
+        handler.setLevel(logging.WARNING)
+        prev_level = logger.level
+        prev_explain = jax.config.jax_explain_cache_misses
+        prev_propagate = logger.propagate
+        prev_handlers = list(logger.handlers)
+        jax.config.update("jax_explain_cache_misses", True)
+        # capture, don't spray: jax hangs its own stderr StreamHandler
+        # directly on the 'jax' logger, so the explanations would double
+        # as console noise unless the handler list is swapped wholesale;
+        # they belong in findings, not on the audited run's stderr
+        logger.handlers = [handler]
+        logger.propagate = False
+        if logger.getEffectiveLevel() > logging.WARNING:
+            logger.setLevel(logging.WARNING)
+        try:
+            yield self
+        finally:
+            logger.handlers = prev_handlers
+            logger.setLevel(prev_level)
+            logger.propagate = prev_propagate
+            jax.config.update("jax_explain_cache_misses", prev_explain)
+        after = self.snapshot()
+        explanations = buf.getvalue()
+        for name, fn in self.entries.items():
+            grew = after[name] - before[name]
+            if grew <= 0:
+                continue
+            path, line = _anchor(fn)
+            why = self._explanation(explanations, fn)
+            ctx = f" during {context}" if context else ""
+            self.findings.append(
+                Finding(
+                    "retrace",
+                    path,
+                    line,
+                    f"{name} compiled {grew} more time(s) after warmup"
+                    f"{ctx}: the steady-state loop must reuse ONE "
+                    "program per entry point"
+                    + (f" — jax explains: {why}" if why else ""),
+                )
+            )
+
+    @staticmethod
+    def _explanation(captured: str, fn) -> str:
+        """The first cache-miss explanation mentioning the entry's
+        wrapped function, compressed to one line."""
+        wrapped = getattr(fn, "__wrapped__", fn)
+        target = getattr(wrapped, "__name__", "")
+        best = ""
+        for m in _MISS.finditer(captured):
+            reason = " ".join(m.group(1).split())
+            if target and target in m.group(0):
+                return reason[:300]
+            best = best or reason
+        return best[:300]
+
+
+def _tiny_cfg(netstack, faulted: bool):
+    from rcmarl_tpu.lint.configs import tiny_cfg, tiny_faulted_cfg
+
+    if faulted:
+        return tiny_faulted_cfg(netstack)
+    return tiny_cfg(netstack=netstack)
+
+
+def audit_retrace(steady_blocks: int = 2) -> List[Finding]:
+    """``lint --retrace``: prove exactly-once compilation on tiny runs.
+
+    Three cases cover the production paths: a guarded+faulted run on
+    each netstack arm (the undonated retry-capable entries, diag on)
+    and a clean run (the donated steady-state entries). Each trains ONE
+    warmup block outside the watchdog, then ``steady_blocks`` more
+    inside it — any further compile is a ``retrace`` finding naming the
+    entry point and jax's explanation of what changed.
+    """
+    import jax
+
+    from rcmarl_tpu.training.trainer import train
+
+    auditor = RetraceAuditor()
+    cases = [
+        ("faulted+guarded, netstack off", _tiny_cfg(False, True)),
+        ("faulted+guarded, netstack on", _tiny_cfg(True, True)),
+        ("clean donated, netstack off", _tiny_cfg(False, False)),
+    ]
+    for label, cfg in cases:
+        state, _ = train(cfg, n_episodes=cfg.n_ep_fixed)  # warmup: compiles
+        with auditor.expect_no_compiles(context=label):
+            train(
+                cfg,
+                n_episodes=cfg.n_ep_fixed * steady_blocks,
+                state=state,
+            )
+    return auditor.findings

@@ -1,0 +1,199 @@
+"""Config-hashability / jit-static-argument AST pass.
+
+Every jitted entry point in this framework closes over ``Config`` as a
+STATIC argument (``jax.jit(..., static_argnums=0)``): jit caches on
+``hash(cfg)``, so an unhashable or mutable value reaching a static slot
+either crashes at dispatch or — worse — hashes by identity and
+silently retraces per call (the drift class DRIFT.md documents). Two
+rules:
+
+- ``static-unhashable`` (field form) — a ``@dataclass(frozen=True)``
+  class declares a field with a mutable container annotation
+  (``list``/``dict``/``set``/``List``/``Dict``/``Set``/ndarray) or a
+  mutable default. Frozen dataclasses hash by field values; one list
+  field makes the whole config unhashable, and an ndarray field hashes
+  never (``Config`` and ``FaultPlan`` are the contracts here — tuples
+  and scalars only).
+- ``static-unhashable`` (call form) — a call site in the same module
+  passes a ``list``/``dict``/``set`` display (or ``list()``/``dict()``/
+  ``set()`` constructor) in a position that the called name declared
+  static via ``jax.jit(..., static_argnums=...)`` or
+  ``functools.partial(jax.jit, static_argnums=...)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from rcmarl_tpu.lint.findings import Finding
+
+MUTABLE_TYPE_NAMES = frozenset(
+    {"list", "dict", "set", "List", "Dict", "Set", "MutableMapping",
+     "bytearray", "ndarray", "Array"}
+)
+
+
+def _annotation_names(node: ast.expr) -> Set[str]:
+    """Base type names mentioned by an annotation expression.
+
+    String annotations (``"bool | str"``) parse too — postponed
+    evaluation must not hide a mutable field type.
+    """
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return set()
+    names: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            names.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            names.add(n.attr)
+    return names
+
+
+def _is_frozen_dataclass(node: ast.ClassDef) -> bool:
+    for dec in node.decorator_list:
+        if isinstance(dec, ast.Call):
+            fn = dec.func
+            name = fn.id if isinstance(fn, ast.Name) else getattr(fn, "attr", "")
+            if name == "dataclass":
+                for kw in dec.keywords:
+                    if (
+                        kw.arg == "frozen"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True
+                    ):
+                        return True
+    return False
+
+
+def _jit_static_positions(call: ast.Call) -> "Tuple[int, ...] | None":
+    """static_argnums of a ``jax.jit(...)`` / ``partial(jax.jit, ...)``
+    call expression, or None when it is not such a call."""
+    fn = call.func
+    is_jit = (
+        isinstance(fn, ast.Attribute)
+        and fn.attr == "jit"
+        and isinstance(fn.value, ast.Name)
+        and fn.value.id == "jax"
+    )
+    is_partial_jit = (
+        (isinstance(fn, ast.Name) and fn.id == "partial")
+        or (isinstance(fn, ast.Attribute) and fn.attr == "partial")
+    ) and any(
+        isinstance(a, ast.Attribute)
+        and a.attr == "jit"
+        and isinstance(a.value, ast.Name)
+        and a.value.id == "jax"
+        for a in call.args
+    )
+    if not (is_jit or is_partial_jit):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = tuple(
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                )
+                return out or ()
+    return ()
+
+
+def _mutable_display(node: ast.expr) -> "str | None":
+    if isinstance(node, ast.List):
+        return "list"
+    if isinstance(node, ast.Dict):
+        return "dict"
+    if isinstance(node, ast.Set):
+        return "set"
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        if node.func.id in ("list", "dict", "set", "bytearray"):
+            return node.func.id
+    return None
+
+
+class StaticArgsPass(ast.NodeVisitor):
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.findings: List[Finding] = []
+        #: name -> static positions, for jit-wrapped module-level names
+        self._static_of: Dict[str, Tuple[int, ...]] = {}
+
+    def visit_ClassDef(self, node):  # noqa: N802
+        if _is_frozen_dataclass(node):
+            for stmt in node.body:
+                if not isinstance(stmt, ast.AnnAssign):
+                    continue
+                bad = _annotation_names(stmt.annotation) & MUTABLE_TYPE_NAMES
+                default_kind = (
+                    _mutable_display(stmt.value) if stmt.value else None
+                )
+                if bad or default_kind:
+                    target = (
+                        stmt.target.id
+                        if isinstance(stmt.target, ast.Name)
+                        else ast.unparse(stmt.target)
+                    )
+                    what = (
+                        f"mutable annotation {sorted(bad)}"
+                        if bad
+                        else f"mutable {default_kind} default"
+                    )
+                    self.findings.append(
+                        Finding(
+                            "static-unhashable",
+                            self.path,
+                            stmt.lineno,
+                            f"frozen dataclass field {target!r} has "
+                            f"{what}: this config is jit-static and must "
+                            "hash — use tuples/scalars",
+                        )
+                    )
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):  # noqa: N802
+        if isinstance(node.value, ast.Call):
+            statics = _jit_static_positions(node.value)
+            if statics is None and isinstance(node.value.func, ast.Call):
+                # partial(jax.jit, static_argnums=...)(fn) — the inner
+                # call carries the static spec
+                statics = _jit_static_positions(node.value.func)
+            if statics:
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        self._static_of[target.id] = statics
+        self.generic_visit(node)
+
+    def visit_Call(self, node):  # noqa: N802
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in self._static_of:
+            for pos in self._static_of[fn.id]:
+                if pos < len(node.args):
+                    kind = _mutable_display(node.args[pos])
+                    if kind:
+                        self.findings.append(
+                            Finding(
+                                "static-unhashable",
+                                self.path,
+                                node.lineno,
+                                f"{fn.id}() receives a {kind} in static "
+                                f"position {pos}: unhashable static args "
+                                "crash at dispatch (or retrace per call "
+                                "when hashed by identity)",
+                            )
+                        )
+        self.generic_visit(node)
+
+
+def run(path: str, tree: ast.Module, hot_path: bool) -> List[Finding]:
+    p = StaticArgsPass(path)
+    p.visit(tree)
+    return p.findings

@@ -87,6 +87,23 @@ PALLAS_CROSSOVER_VOLUME = 256
 SELECT_MAX_N_IN = None
 
 
+#: The six concrete backend modes of the aggregation contract, as
+#: (name, kwargs-recipe) rows: every cross-backend bitwise pin (tests)
+#: and the purity/dtype audit (rcmarl_tpu.lint.backends) iterate THIS
+#: table instead of hand-maintaining the list, so a seventh backend
+#: cannot ship unaudited. ``masked``/``traced_h`` are recipe flags the
+#: caller expands (a padded-graph validity mask / a traced H scalar);
+#: the Pallas arms audit in interpreter-traceable form on any host.
+AUDIT_BACKEND_MODES = (
+    ("xla", {"impl": "xla"}),
+    ("xla_sort", {"impl": "xla_sort"}),
+    ("masked", {"impl": "xla", "masked": True}),
+    ("traced_h", {"impl": "xla", "traced_h": True}),
+    ("pallas_select", {"impl": "pallas_interpret"}),
+    ("pallas_sort", {"impl": "pallas_sort"}),
+)
+
+
 def _selection_favored(n_in: int, H: int) -> bool:
     """Measured rule for where tournament selection beats the full sort
     at epoch granularity (see :data:`SELECT_MAX_N_IN`; ``H`` stays in

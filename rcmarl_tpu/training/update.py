@@ -67,6 +67,14 @@ from rcmarl_tpu.ops.optim import adam_init
 #: every golden-pinned trajectory) is untouched when fault_plan is None.
 _FAULT_STREAM = 0xFA17
 
+#: Per-tree fault sub-stream tags off the fault stream. These are the
+#: SAME ids :func:`_pair_segments` stamps on the combined netstack
+#: block's segments (``apply_link_faults_flat`` folds them internally),
+#: so the dual-launch arm's two ``fold_in`` calls and the stacked arm's
+#: one flat call draw bitwise-identical per-tree fault patterns.
+_FAULT_TREE_CRITIC = 0
+_FAULT_TREE_TR = 1
+
 
 def init_agent_params(key: jax.Array, cfg: Config) -> AgentParams:
     """All-agent learnable state; each agent draws an independent
@@ -289,10 +297,12 @@ def critic_tr_epoch(
             else:
                 stale_c, stale_t = nbr_c, nbr_t
             nbr_c = apply_link_faults(
-                jax.random.fold_in(fkey, 0), nbr_c, stale_c, plan
+                jax.random.fold_in(fkey, _FAULT_TREE_CRITIC), nbr_c,
+                stale_c, plan,
             )
             nbr_t = apply_link_faults(
-                jax.random.fold_in(fkey, 1), nbr_t, stale_t, plan
+                jax.random.fold_in(fkey, _FAULT_TREE_TR), nbr_t,
+                stale_t, plan,
             )
         if with_diag:
             H_diag = H if traced else cfg.H
@@ -344,11 +354,12 @@ def _pair_segments(msg_c, msg_t):
     dual-arm fault streams on the combined block. Leaf sizes strip the
     leading agent axis (the gathered block is (N, n_in, P_total))."""
     lc, lt = jax.tree.leaves(msg_c), jax.tree.leaves(msg_t)
+    C, T = _FAULT_TREE_CRITIC, _FAULT_TREE_TR
     order = (
-        [(0, i) for i in range(len(lc) - 2)]
-        + [(1, i) for i in range(len(lt) - 2)]
-        + [(0, len(lc) - 2), (0, len(lc) - 1)]
-        + [(1, len(lt) - 2), (1, len(lt) - 1)]
+        [(C, i) for i in range(len(lc) - 2)]
+        + [(T, i) for i in range(len(lt) - 2)]
+        + [(C, len(lc) - 2), (C, len(lc) - 1)]
+        + [(T, len(lt) - 2), (T, len(lt) - 1)]
     )
     segs, off = [], 0
     for t, i in order:

@@ -36,6 +36,37 @@ import jax.numpy as jnp
 from rcmarl_tpu.training.update import team_average_reward
 
 
+def jit_entry_points() -> Dict[str, object]:
+    """The framework's jitted steady-state entry points, by name.
+
+    The canonical registry for compile-count accounting: these are the
+    programs whose compile-once contract the retrace auditor
+    (:mod:`rcmarl_tpu.lint.retrace`) enforces — every other jit in the
+    package is a diagnostic/benchmark standalone. Imported lazily so
+    ``utils`` stays cheap to import.
+    """
+    from rcmarl_tpu.training.trainer import train_block, train_block_donated
+    from rcmarl_tpu.training.update import update_block, update_block_donated
+
+    return {
+        "update_block": update_block,
+        "update_block_donated": update_block_donated,
+        "train_block": train_block,
+        "train_block_donated": train_block_donated,
+    }
+
+
+def compile_counts() -> Dict[str, int]:
+    """Tracing-cache sizes of :func:`jit_entry_points` — how many
+    distinct programs each entry point has compiled in this process.
+    The retrace auditor diffs snapshots of this; it is also handy
+    interactively ("did my sweep really share one program?")."""
+    return {
+        name: int(fn._cache_size())
+        for name, fn in jit_entry_points().items()
+    }
+
+
 @contextlib.contextmanager
 def trace(logdir: str, *, create_perfetto_link: bool = False):
     """Record a device trace of everything run inside the block.

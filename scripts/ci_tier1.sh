@@ -82,3 +82,14 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
     --netstack on --fault_drop_p 0.2 --fault_nan_p 0.2 --fault_stale_p 0.1 \
     --sanitize --summary_dir "$smoke_dir" --quiet
 echo "netstack ragged smoke cell OK"
+
+# graftlint cell: the AST passes over the installed package (zero
+# findings is the contract — rcmarl_tpu.lint) plus the retrace audit,
+# which runs tiny guarded+faulted 2-block trains on both netstack arms
+# and a clean donated run and fails if any jitted entry point compiles
+# more than once after its warmup block. The donation + backend-purity
+# audits run inside the pytest suite above (tests/test_lint.py); the
+# retrace repeat here proves the compile-once contract through the real
+# CLI entry, not just the test harness.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rcmarl_tpu lint --retrace
+echo "graftlint cell OK"

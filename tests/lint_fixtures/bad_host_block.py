@@ -1,0 +1,12 @@
+"""Seeded-bad fixture: completion barriers inside hot-path code
+(rcmarl_tpu.lint rule ``host-block``). Never imported — AST-parsed
+only."""
+
+import jax
+
+
+def synced_step(params, grads):
+    out = params
+    out = jax.block_until_ready(out)  # RULE: host-block
+    grads.block_until_ready()  # RULE: host-block
+    return out

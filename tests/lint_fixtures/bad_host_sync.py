@@ -1,0 +1,23 @@
+"""Seeded-bad fixture: device->host pulls on traced values in hot-path
+code (rcmarl_tpu.lint rule ``host-sync``). Static config/shape pulls
+are legal and must NOT fire. Never imported — AST-parsed only."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def leaky_update(params, grads, cfg, plan):
+    loss = jnp.mean(grads)
+    scale = float(loss)  # RULE: host-sync (traced value)
+    host = np.asarray(grads)  # RULE: host-sync (traced value)
+    stop = bool(loss > 0)  # RULE: host-sync (traced compare)
+    item = loss.item()  # RULE: host-sync (.item())
+    fetched = jax.device_get(params)  # RULE: host-sync (transfer)
+
+    # the static pulls the real hot path performs — all clean:
+    lr = float(plan.stale_p) if plan is not None else float(cfg.slow_lr)
+    n = int(np.prod(grads.shape[1:], dtype=np.int64))
+    roles = np.array(cfg.agent_roles)
+    return scale, host, stop, item, fetched, lr, n, roles

@@ -1,0 +1,14 @@
+"""Seeded-bad fixture: fold_in with a magic-number stream tag in
+hot-path code (rcmarl_tpu.lint rule ``prng-fold-tag``; the dedicated-
+stream pattern wants named constants like faults.py's _FAULT_STREAM).
+Never imported — AST-parsed only."""
+
+import jax
+
+_MY_STREAM = 0xBEEF
+
+
+def derive_streams(ekey):
+    fkey = jax.random.fold_in(ekey, 3)  # RULE: prng-fold-tag (magic int)
+    ok = jax.random.fold_in(ekey, _MY_STREAM)  # named constant: clean
+    return fkey, ok

@@ -1,0 +1,16 @@
+"""Seeded-bad fixture: a raw-int seed minted inside jitted hot-path
+code (rcmarl_tpu.lint rule ``prng-int-seed``; the test forces the
+hot-path scope). Never imported — AST-parsed only."""
+
+import jax
+
+
+def traced_update(params, cfg):
+    key = jax.random.PRNGKey(0)  # RULE: prng-int-seed (constant stream)
+    noise = jax.random.normal(key, (3,))
+    return params, noise
+
+
+def also_new_style(params):
+    key = jax.random.key(42)  # RULE: prng-int-seed
+    return jax.random.normal(key, (3,))

@@ -1,0 +1,30 @@
+"""Seeded-bad fixture: the same key consumed twice, and a parent key
+sampled after being split (rcmarl_tpu.lint rule ``prng-reuse``). Never
+imported — tests/test_lint.py parses it only."""
+
+import jax
+
+
+def double_consume(key):
+    a = jax.random.normal(key, (3,))
+    b = jax.random.uniform(key, (3,))  # RULE: prng-reuse (second consume)
+    return a + b
+
+
+def sample_split_parent(key):
+    k1, k2 = jax.random.split(key)
+    noise = jax.random.normal(key, (3,))  # RULE: prng-reuse (parent key)
+    return k1, k2, noise
+
+
+def duplicate_fold_stream(key):
+    a = jax.random.fold_in(key, 7)
+    b = jax.random.fold_in(key, 7)  # RULE: prng-reuse (same derived stream)
+    return a, b
+
+
+def clean_twin(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (3,))
+    b = jax.random.uniform(k2, (3,))
+    return a + b

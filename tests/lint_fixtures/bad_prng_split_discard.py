@@ -1,0 +1,24 @@
+"""Seeded-bad fixture: split() entropy thrown away (rcmarl_tpu.lint
+rule ``prng-split-discard``). Never imported — AST-parsed only."""
+
+import jax
+
+
+def underscore_unpack(key):
+    k1, _ = jax.random.split(key)  # RULE: prng-split-discard
+    return jax.random.normal(k1, (3,))
+
+
+def subscript_split(key):
+    k = jax.random.split(key, 4)[0]  # RULE: prng-split-discard
+    return jax.random.normal(k, (3,))
+
+
+def discarded_entirely(key):
+    jax.random.split(key)  # RULE: prng-split-discard (no effect)
+    return key
+
+
+def clean_twin(key):
+    k1, k2 = jax.random.split(key)
+    return jax.random.normal(k1, (3,)) + jax.random.normal(k2, (3,))

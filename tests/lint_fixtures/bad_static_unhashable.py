@@ -1,0 +1,34 @@
+"""Seeded-bad fixture: unhashable/mutable values in jit-static
+positions (rcmarl_tpu.lint rule ``static-unhashable``): a frozen
+dataclass (jit-static config contract) with mutable fields, and a list
+display passed where the jitted callee declared the slot static. Never
+imported — AST-parsed only."""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import List
+
+import jax
+
+
+@dataclass(frozen=True)
+class BadConfig:
+    n_agents: int = 5
+    in_nodes: List[int] = None  # RULE: static-unhashable (mutable anno)
+    weights: dict = None  # RULE: static-unhashable (mutable anno)
+    topology: tuple = (0, 1)  # clean: hashable
+
+
+def _step(cfg, x):
+    return x * cfg.n_agents
+
+
+step = jax.jit(_step, static_argnums=(0,))
+step_p = partial(jax.jit, static_argnums=(0,))(_step)
+
+
+def run(x):
+    a = step([1, 2, 3], x)  # RULE: static-unhashable (list in static slot)
+    b = step_p({"n": 3}, x)  # RULE: static-unhashable (dict in static slot)
+    c = step((1, 2, 3), x)  # clean: tuple hashes
+    return a, b, c
