@@ -98,6 +98,14 @@ def _measure(
     states = init_states(cfg, list(range(100, 100 + n_seeds)))
     run = jax.jit(jax.vmap(lambda s: train_scanned(cfg, s, n_blocks)))
 
+    # Hash the lowered program BEFORE timing it: the emitted row is tied
+    # to the exact compiled program it measured (the AUDIT.jsonl ledger
+    # convention, rcmarl_tpu.lint.cost) — a later "benched arm A,
+    # shipped arm B" drift is then detectable from the artifact alone.
+    from rcmarl_tpu.utils.profiling import program_fingerprint
+
+    fingerprint = program_fingerprint(run.lower(states))
+
     # Warmup: compile + one full execution (buffers reach steady state).
     states, metrics = run(states)
     fetch(states, metrics)
@@ -118,6 +126,7 @@ def _measure(
                 "unit": "steps/s",
                 "vs_baseline": round(steps / dt / BASELINE_STEPS_PER_SEC, 1),
                 "platform": jax.devices()[0].platform,
+                "cost_fingerprint": fingerprint,
                 # Self-describing workload (VERDICT r2 item 7): TPU and CPU
                 # fallback measure different shapes, so cross-round numbers
                 # are only comparable when these fields match.

@@ -962,6 +962,7 @@ def cmd_bench(argv) -> int:
                 file=sys.stderr,
             )
             continue
+        fingerprint = None
         if shard is None:
             state = init_train_state(cfg, jax.random.PRNGKey(0))
             run = jax.jit(
@@ -991,6 +992,15 @@ def cmd_bench(argv) -> int:
                 return st, metrics
 
         try:
+            if shard is None:
+                # tie the row to the EXACT program being timed (the
+                # ledger convention, lint/cost.py): the hash of this
+                # lowering is what catches "benched arm A, shipped arm
+                # B" drift. Inside the fault-isolation block: a
+                # lowering failure is a cell failure, not a matrix one.
+                from rcmarl_tpu.utils.profiling import program_fingerprint
+
+                fingerprint = program_fingerprint(run.lower(state))
             state, metrics = run(state)  # compile + warm
             jax.device_get(metrics.true_team_returns)
             best = float("inf")
@@ -1037,6 +1047,7 @@ def cmd_bench(argv) -> int:
                         "mesh_devices": len(jax.devices()),
                     }
                 ),
+                "cost_fingerprint": fingerprint,
                 "env_steps_per_sec": round(steps / best, 1),
                 "sec_per_block": round(best / args.blocks, 4),
                 "workload": {
@@ -1126,6 +1137,7 @@ def cmd_profile(argv) -> int:
         consensus_tags,
         profile_consensus,
         profile_phases,
+        train_block_fingerprint,
     )
 
     n_failed = 0
@@ -1144,6 +1156,7 @@ def cmd_profile(argv) -> int:
             )
             continue
         try:
+            fingerprint = train_block_fingerprint(cfg)
             phases = profile_phases(cfg, reps=args.reps)
             micro = (
                 profile_consensus(cfg, reps=args.reps)
@@ -1184,6 +1197,7 @@ def cmd_profile(argv) -> int:
                 "n_agents": cfg.n_agents,
                 "hidden": list(cfg.hidden),
                 "H": cfg.H,
+                "cost_fingerprint": fingerprint,
                 "ms": {k: round(v * 1e3, 3) for k, v in phases.items()},
                 "ms_epochs_total": round(
                     cfg.n_epochs * phases["critic_tr_epoch"] * 1e3, 3
@@ -1213,6 +1227,7 @@ def cmd_profile(argv) -> int:
                     "layout": cfg.consensus_layout,
                     "netstack": netstack_enabled(cfg),
                     "compute_dtype": cfg.compute_dtype,
+                    "cost_fingerprint": fingerprint,
                     **consensus_tags(cfg),
                     "ms": {k: round(v * 1e3, 3) for k, v in micro.items()},
                     "platform": jax.devices()[0].platform,
@@ -1267,9 +1282,53 @@ def cmd_lint(argv) -> int:
         "primitives and dtype/weak-type drift (rcmarl_tpu.lint.backends)",
     )
     p.add_argument(
+        "--cost",
+        action="store_true",
+        help="also run the compiled-cost gate: lower+compile every "
+        "jitted entry point (both netstack arms, donated + guarded "
+        "variants, all six aggregation-backend modes) and fail when "
+        "XLA's cost/memory analysis grew beyond --cost_tol vs the "
+        "--baseline ledger (rcmarl_tpu.lint.cost)",
+    )
+    p.add_argument(
+        "--collectives",
+        action="store_true",
+        help="also run the HLO collective census of the seed×agent "
+        "sharded programs: zero collectives on the seed-only program, "
+        "the enumerated bounded set + ledger-exact counts when the "
+        "agent axis is sharded, and no host transfer anywhere "
+        "(rcmarl_tpu.lint.collectives)",
+    )
+    p.add_argument(
+        "--baseline",
+        type=str,
+        default="AUDIT.jsonl",
+        help="the committed cost/collective ledger the --cost and "
+        "--collectives gates compare against (default: ./AUDIT.jsonl); "
+        "on gate failure the fresh ledger is written to <baseline>.new "
+        "so the diff is one click away",
+    )
+    p.add_argument(
+        "--write_baseline",
+        action="store_true",
+        help="regenerate the requested --cost/--collectives rows and "
+        "write them to --baseline (rows of kinds not being regenerated "
+        "are kept) instead of gating — the ledger-update step of a "
+        "legitimate perf PR; unconditional invariants (host transfers, "
+        "out-of-set collectives) still fail",
+    )
+    p.add_argument(
+        "--cost_tol",
+        type=float,
+        default=None,
+        help="relative growth tolerance for the --cost gate (default: "
+        "rcmarl_tpu.lint.cost.COST_TOLERANCE = 0.01)",
+    )
+    p.add_argument(
         "--all",
         action="store_true",
-        help="shorthand for --retrace --donation --backends",
+        help="shorthand for --retrace --donation --backends --cost "
+        "--collectives",
     )
     p.add_argument(
         "--rules",
@@ -1295,8 +1354,27 @@ def cmd_lint(argv) -> int:
             print(f"  {r}")
         return 0
 
+    any_audit = (
+        args.retrace or args.donation or args.backends or args.cost
+        or args.collectives or args.all
+    )
+    if args.collectives or args.all:
+        # The collective census needs a multi-device mesh. Mirror
+        # tests/conftest.py: force a virtual 8-device host platform.
+        # XLA reads this at BACKEND INIT, not jax import, so setting it
+        # here (before the first audit touches a device) still works
+        # under main()'s eager _honor_platform_env import; if a backend
+        # was somehow already initialized, the census notes the entries
+        # it cannot measure instead of passing them. No-op on real TPU
+        # backends.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
     findings = run_source_lint(args.root)
-    if findings and (args.retrace or args.donation or args.backends or args.all):
+    if findings and any_audit:
         # fail fast: the runtime audits cost minutes of tiny training
         # runs and compiles, and the exit status is already decided
         for f in findings:
@@ -1326,6 +1404,70 @@ def cmd_lint(argv) -> int:
 
         findings += audit_backends()
         n_sections += 1
+    fresh_rows = []
+    skipped_entries = set()
+    gate_findings = 0
+    if args.cost or args.all:
+        from rcmarl_tpu.lint.cost import COST_TOLERANCE, audit_cost, cost_rows
+
+        tol = COST_TOLERANCE if args.cost_tol is None else args.cost_tol
+        if args.write_baseline:
+            rows, nts, skipped = cost_rows()
+            fresh_rows += rows
+            skipped_entries |= skipped
+        else:
+            f, nts, rows = audit_cost(args.baseline, tol)
+            findings += f
+            gate_findings += len(f)
+            fresh_rows += rows
+        notes += nts
+        n_sections += 1
+    if args.collectives or args.all:
+        from rcmarl_tpu.lint.collectives import audit_collectives, census_rows
+
+        if args.write_baseline:
+            # invariants (host transfers, out-of-set kinds) still enforced
+            rows, f, nts, skipped = census_rows()
+            findings += f
+            fresh_rows += rows
+            skipped_entries |= skipped
+        else:
+            f, nts, rows = audit_collectives(args.baseline)
+            findings += f
+            gate_findings += len(f)
+            fresh_rows += rows
+        notes += nts
+        n_sections += 1
+    if args.write_baseline and fresh_rows:
+        from rcmarl_tpu.lint.cost import read_ledger, write_ledger
+
+        regenerated = {r["kind"] for r in fresh_rows}
+        # rows of regenerated kinds are replaced — EXCEPT entries this
+        # host could not measure (noted as skipped, e.g. a real Pallas
+        # backend on CPU or a too-small census mesh): their rows from a
+        # platform that COULD measure them stay in the ledger, matching
+        # the skipped-is-not-stale exemption in the comparison
+        kept = [
+            r
+            for r in read_ledger(args.baseline)
+            if r.get("kind") not in regenerated
+            or r.get("entry") in skipped_entries
+        ]
+        write_ledger(args.baseline, kept + fresh_rows)
+        print(
+            f"wrote {len(fresh_rows)} fresh + {len(kept)} kept row(s) "
+            f"to {args.baseline}"
+        )
+    elif gate_findings and fresh_rows:
+        from rcmarl_tpu.lint.cost import write_ledger
+
+        write_ledger(f"{args.baseline}.new", fresh_rows)
+        print(
+            f"# fresh ledger written to {args.baseline}.new — diff it "
+            f"against {args.baseline}; if the cost change is "
+            "intentional, regenerate with --write_baseline and commit",
+            file=sys.stderr,
+        )
     for note in notes:
         print(f"# note: {note}", file=sys.stderr)
     for f in findings:

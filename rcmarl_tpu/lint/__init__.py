@@ -23,7 +23,7 @@ static-unhashable  jit-static configs stay hashable (frozen-dataclass
 ================== ====================================================
 
 **Layer 2 — compiled-artifact audits** (import jax, run real tiny
-programs; ``lint --retrace/--donation/--backends``):
+programs; ``lint --retrace/--donation/--backends/--cost/--collectives``):
 
 ================== ====================================================
 retrace            each jitted entry point compiles exactly once after
@@ -37,6 +37,19 @@ backend-impure     no callbacks/infeed/nondeterministic primitives in
 backend-dtype-drift aggregation outputs keep exact input dtype with no
                    weak types, identical across all six backends and
                    both netstack epoch arms (:mod:`.backends`)
+cost-regression    a compiled entry point's FLOPs / bytes accessed /
+                   buffer bytes grew past tolerance vs the committed
+                   AUDIT.jsonl ledger (:mod:`.cost`)
+cost-unbaselined   a compiled entry has no (matching) ledger row, or a
+                   ledger row went stale — regenerate AUDIT.jsonl in
+                   the same PR (:mod:`.cost`)
+collective-census  the sharded seed×agent programs' collective set /
+                   counts drifted from the ledger, left the enumerated
+                   pod-readiness set, or the seed-only program grew a
+                   collective (:mod:`.collectives`)
+host-transfer      a device->host transfer (infeed/outfeed/host memory
+                   space/host callback) inside a compiled train block
+                   (:mod:`.collectives`)
 ================== ====================================================
 
 Escape hatch for Layer 1: ``# lint: disable=<rule>`` on the flagged
@@ -86,6 +99,10 @@ AUDIT_RULES = (
     "donation-dropped",
     "backend-impure",
     "backend-dtype-drift",
+    "cost-regression",
+    "cost-unbaselined",
+    "collective-census",
+    "host-transfer",
 )
 
 _PASSES = (prng.run, hostsync.run, staticargs.run)

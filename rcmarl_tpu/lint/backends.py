@@ -11,11 +11,12 @@ walks the actual jaxprs:
 - every mode in :data:`rcmarl_tpu.ops.aggregation.AUDIT_BACKEND_MODES`
   (the six-backend contract table), with and without ``sanitize``,
   traced over a representative two-leaf message tree;
-- both netstack epoch arms (``critic_tr_epoch`` with
-  ``netstack=True``/``False``) under an active fault plan + sanitize —
-  asserting identical output structure/shape/dtype leaf for leaf, so
-  the stacked and dual-launch programs cannot drift apart at the type
-  level.
+- both netstack arms' full guarded update-block jaxprs
+  (``netstack=True``/``False`` under an active fault plan + sanitize,
+  traced once via the shared
+  :func:`rcmarl_tpu.utils.profiling.entry_jaxprs`) — asserting
+  identical output structure/shape/dtype, so the stacked and
+  dual-launch programs cannot drift apart at the type level.
 
 Findings: ``backend-impure`` (forbidden primitive in a jaxpr) and
 ``backend-dtype-drift`` (dtype/weak-type change, or cross-arm aval
@@ -153,40 +154,29 @@ def _netstack_cfg(netstack: bool):
 
 
 def _audit_netstack_arms() -> List[Finding]:
+    """Walk the full guarded UPDATE-BLOCK jaxpr of each netstack arm —
+    the whole entry point (epoch scan + actor phase + fault plumbing),
+    not just the epoch — via the shared memoized
+    :func:`rcmarl_tpu.utils.profiling.entry_jaxprs`, so repeat audits
+    in one process never re-trace and the cost arm shares the same
+    tiny-input pipeline."""
     import jax
-    import jax.numpy as jnp
 
-    from rcmarl_tpu.agents.updates import Batch
-    from rcmarl_tpu.training.update import critic_tr_epoch, init_agent_params
+    from rcmarl_tpu.utils.profiling import entry_jaxprs, entry_out_shapes
 
     findings: List[Finding] = []
-    B = 24
     arms = {}
+    shapes = {}
     for netstack in (False, True):
         cfg = _netstack_cfg(netstack)
-        params = jax.eval_shape(
-            lambda k, c=cfg: init_agent_params(k, c), jax.random.PRNGKey(0)
-        )
-        N = cfg.n_agents
-        batch = Batch(
-            s=jnp.zeros((B, N, cfg.n_states), jnp.float32),
-            ns=jnp.zeros((B, N, cfg.n_states), jnp.float32),
-            a=jnp.zeros((B, N, 1), jnp.float32),
-            r=jnp.zeros((B, N, 1), jnp.float32),
-            mask=jnp.ones((B,), jnp.float32),
-        )
-        r_coop = jnp.zeros((B, 1), jnp.float32)
-        carry_avals = (params.critic, params.tr, params.critic_local)
-        carry = jax.tree.map(
-            lambda a: jnp.zeros(a.shape, a.dtype), carry_avals
-        )
-        fn = lambda c, b, rc, k, cfg=cfg: critic_tr_epoch(
-            cfg, c, b, rc, k, with_diag=True
-        )
-        key = jax.random.PRNGKey(0)
-        closed = jax.make_jaxpr(fn)(carry, batch, r_coop, key)
-        bad = _walk_primitives(closed.jaxpr) & FORBIDDEN_PRIMITIVES
         arm = "stacked" if netstack else "dual"
+        closed = entry_jaxprs(cfg, with_diag=True, names=("update_block",))[
+            "update_block"
+        ]
+        shapes[arm] = entry_out_shapes(
+            cfg, with_diag=True, names=("update_block",)
+        )["update_block"]
+        bad = _walk_primitives(closed.jaxpr) & FORBIDDEN_PRIMITIVES
         if bad:
             findings.append(
                 Finding(
@@ -194,25 +184,31 @@ def _audit_netstack_arms() -> List[Finding]:
                     _EPOCH_ANCHOR,
                     1,
                     f"netstack {arm} arm: forbidden primitive(s) "
-                    f"{sorted(bad)} in the epoch jaxpr",
+                    f"{sorted(bad)} in the guarded update-block jaxpr",
                 )
             )
-        out = jax.eval_shape(fn, carry, batch, r_coop, key)
-        arms[arm] = jax.tree.map(
-            lambda a: (tuple(a.shape), str(a.dtype)), out
-        )
-    dual, stacked = arms["dual"], arms["stacked"]
+        arms[arm] = _out_signature(closed)
+    # flat avals (shape/dtype/weak) off the jaxpr, PLUS the original
+    # output pytree: a re-nesting with identical flat leaves is still
+    # structure drift (tree.map raises ValueError on mismatch)
     try:
-        same = jax.tree.all(jax.tree.map(lambda a, b: a == b, dual, stacked))
+        same_tree = jax.tree.all(
+            jax.tree.map(
+                lambda a, b: tuple(a.shape) == tuple(b.shape)
+                and a.dtype == b.dtype,
+                shapes["dual"],
+                shapes["stacked"],
+            )
+        )
     except ValueError:  # structure mismatch
-        same = False
-    if not same:
+        same_tree = False
+    if arms["dual"] != arms["stacked"] or not same_tree:
         findings.append(
             Finding(
                 "backend-dtype-drift",
                 _EPOCH_ANCHOR,
                 1,
-                "netstack arms disagree on epoch output "
+                "netstack arms disagree on guarded update-block output "
                 "structure/shapes/dtypes: the stacked and dual-launch "
                 "programs have drifted apart at the type level",
             )
@@ -222,6 +218,8 @@ def _audit_netstack_arms() -> List[Finding]:
 
 def audit_backends() -> List[Finding]:
     """``lint --backends``: the full jaxpr-level purity/dtype audit —
-    all six aggregation backends (× sanitize) plus both netstack epoch
-    arms. Pure tracing; no compilation, runs on any host."""
+    all six aggregation backends (× sanitize) plus both netstack arms'
+    guarded update blocks. Tracing only, apart from the tiny shared
+    input pipeline (one rollout compile per arm config, memoized across
+    the audit arms); runs on any host."""
     return _audit_aggregation() + _audit_netstack_arms()

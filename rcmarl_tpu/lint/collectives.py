@@ -1,0 +1,362 @@
+"""Collective census — the pod-readiness contract of the sharded programs.
+
+ROADMAP item 2 (the Podracer/Anakin story, arXiv:2104.06272) promotes
+the ``mesh={'seed', 'agent'}`` matrix program to a real multi-chip pod.
+That promotion is only safe if the compiled programs' communication
+stays what PARALLELISM.md measured: the seed axis embarrassingly
+parallel (ZERO collectives), the agent-sharded consensus gather a
+bounded, enumerated set of ICI collectives (all-gather / all-reduce /
+collective-permute from the flat ``(n_in, P_total)`` block's halo
+exchange), and — non-negotiably — no device->host transfer anywhere in
+a train block. This module compiles the :mod:`rcmarl_tpu.parallel`
+programs under a seed×agent mesh (lowering only; the collectives are
+never executed, so single-core hosts are safe) and takes an HLO census:
+
+- ``seeds@unsharded`` — replica program, agent axis unsharded: any
+  collective at all is a finding (the zero-collective invariant).
+- ``seeds@sharded`` / ``matrix@sharded`` — agent axis partitioned: the
+  collective kinds must stay inside :data:`ALLOWED_COLLECTIVES` (the
+  matrix program additionally carries the ledger-pinned all-to-all
+  reshards of :data:`EXTRA_ALLOWED_COLLECTIVES` between its
+  heterogeneous cell layouts), and the per-kind counts are ledger rows
+  gated EXACTLY (integer counts, zero tolerance) against the committed
+  ``AUDIT.jsonl``.
+- every program — host transfers (infeed/outfeed/copy-to-host, host
+  memory spaces, host-callback custom-calls) fail unconditionally,
+  baseline or not.
+
+Rules: ``collective-census`` (out-of-set kind, count drift vs the
+ledger, unbaselined/stale rows, zero-collective violation) and
+``host-transfer``. Hosts with too few devices for a mesh yield notes,
+never silent passes.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Sequence, Tuple
+
+from rcmarl_tpu.lint.findings import Finding
+
+#: The enumerated collective set the flat consensus block is allowed to
+#: lower to under the seed×agent mesh — the pod-readiness precondition
+#: for sharding the neighbor axis (all-reduce only the trim bounds).
+#: Anything else (an all-to-all in a seeds program, a ragged fallback's
+#: gather-of-everything) is a census finding even before the ledger
+#: comparison.
+ALLOWED_COLLECTIVES = frozenset(
+    {"all-gather", "all-reduce", "collective-permute", "reduce-scatter"}
+)
+
+#: Per-program-family extensions to the allowed set, keyed by entry
+#: prefix. The fused heterogeneous matrix program (`train_matrix`)
+#: additionally reshards activations between its cells' agent-sharded
+#: layouts, which GSPMD lowers to tuple-variant ``all-to-all`` ops —
+#: ICI-native on a pod and pinned to an exact ledger count like every
+#: other kind. The seeds programs get NO extension: the flat
+#: ``(n_in, P_total)`` consensus block must stay inside
+#: :data:`ALLOWED_COLLECTIVES` alone.
+EXTRA_ALLOWED_COLLECTIVES = {"matrix": frozenset({"all-to-all"})}
+
+#: HLO op kinds the census counts (async -start/-done pairs count once,
+#: on the -start). The op name is matched at its call position
+#: (whitespace-preceded, directly followed by the operand paren) rather
+#: than anchored on the result type, because async -start ops and
+#: infeed carry TUPLE result types with internal whitespace (e.g.
+#: ``%ags = (f32[2]{0}, f32[8]{0}) all-gather-start(...)``) that a
+#: single-token type anchor would miss — undercounting exactly on the
+#: TPU platform the pod-readiness invariant exists for. ``-done`` ops
+#: never match (the alternation requires ``(`` right after the kind or
+#: its ``-start`` suffix), and operand/attr references (``%all-gather.1``,
+#: ``calls=%...``) are never followed by ``(``.
+_COLLECTIVE_RE = re.compile(
+    r"\s(all-gather|all-reduce|collective-permute|reduce-scatter|"
+    r"all-to-all)(?:-start)?\("
+)
+
+#: Device->host transfer signatures: infeed/outfeed ops, explicit
+#: copy-to-host, buffers placed in a host memory space (``S(5)``
+#: layout annotations), and host-callback custom-calls (pure_callback /
+#: io_callback lower to ``xla_*_callback`` targets).
+_HOST_TRANSFER_PATTERNS = (
+    # call-position match, not a result-type anchor: infeed's result is
+    # a tuple type with internal whitespace (see _COLLECTIVE_RE note)
+    re.compile(r"\s(infeed|outfeed|copy-to-host)(?:-start)?\("),
+    re.compile(r"\{[0-9,]*:\s*\S*S\(5\)\S*\}"),
+    re.compile(r'custom-call.*custom_call_target="[^"]*(callback|host)'),
+)
+
+_ANCHORS = {
+    "seeds": "rcmarl_tpu/parallel/seeds.py",
+    "matrix": "rcmarl_tpu/parallel/matrix.py",
+}
+
+
+def collective_census(hlo_text: str) -> Dict[str, int]:
+    """Per-kind collective-op counts in a compiled HLO module."""
+    counts: Dict[str, int] = {}
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+    return counts
+
+
+def host_transfer_ops(hlo_text: str) -> List[str]:
+    """The HLO lines (trimmed) that smuggle a device->host transfer."""
+    hits: List[str] = []
+    for line in hlo_text.splitlines():
+        if any(p.search(line) for p in _HOST_TRANSFER_PATTERNS):
+            hits.append(line.strip()[:160])
+    return hits
+
+
+def _census_programs():
+    """entry name -> (build_lowered, min_devices, mesh shape, sharded).
+
+    Builders are thunks so a too-small host can note-and-skip without
+    paying any tracing.
+    """
+    from rcmarl_tpu.config import Roles
+    from rcmarl_tpu.lint.configs import census_cfg
+    from rcmarl_tpu.parallel.matrix import lower_matrix
+    from rcmarl_tpu.parallel.seeds import lower_parallel, make_mesh
+
+    cfg = census_cfg()
+    mal = cfg.replace(
+        agent_roles=(Roles.COOPERATIVE,) * 3 + (Roles.MALICIOUS,)
+    )
+    return {
+        "seeds@unsharded": (
+            lambda: lower_parallel(
+                cfg, [0, 1], 1, make_mesh(2, seed_axis=2), False
+            ),
+            2,
+            {"seed": 2, "agent": 1},
+            False,
+        ),
+        "seeds@sharded": (
+            lambda: lower_parallel(
+                cfg, [0, 1], 1, make_mesh(4, seed_axis=2), True
+            ),
+            4,
+            {"seed": 2, "agent": 2},
+            True,
+        ),
+        "matrix@sharded": (
+            lambda: lower_matrix(
+                cfg, [cfg, mal], [0, 1], 1, make_mesh(4, seed_axis=2), True
+            ),
+            4,
+            {"seed": 2, "agent": 2},
+            True,
+        ),
+    }
+
+
+def census_rows(
+    programs=None,
+) -> Tuple[List[dict], List[Finding], List[str], set]:
+    """Compile the census programs and extract ledger rows.
+
+    Returns (rows, unconditional findings, notes, skipped entry names).
+    The unconditional findings — host transfers, out-of-set collective
+    kinds, collectives in the seed-only program — hold with or without
+    a baseline: they are invariants, not regressions. Skipped entries
+    (too few devices for the mesh) are noted, and the comparison must
+    not read their ledger rows as stale. ``programs`` overrides the
+    default :func:`_census_programs` table (the planted-regression
+    tests feed deliberately bad programs through the same finding
+    pipeline).
+    """
+    import jax
+
+    from rcmarl_tpu.lint.configs import census_cfg
+    from rcmarl_tpu.utils.profiling import (
+        config_fingerprint,
+        program_fingerprint,
+    )
+
+    rows: List[dict] = []
+    findings: List[Finding] = []
+    notes: List[str] = []
+    skipped: set = set()
+    n_dev = len(jax.devices())
+    fp = config_fingerprint(census_cfg())
+    if programs is None:
+        programs = _census_programs()
+    for entry, (build, min_dev, mesh_shape, sharded) in programs.items():
+        anchor = _ANCHORS.get(
+            entry.split("@", 1)[0], "rcmarl_tpu/lint/collectives.py"
+        )
+        if n_dev < min_dev:
+            notes.append(
+                f"{entry}: needs >= {min_dev} devices for the "
+                f"{mesh_shape} mesh, host has {n_dev}; census skipped here"
+            )
+            skipped.add(entry)
+            continue
+        lowered = build()
+        text = lowered.compile().as_text()
+        counts = collective_census(text)
+        hosts = host_transfer_ops(text)
+        for line in hosts[:3]:
+            findings.append(
+                Finding(
+                    "host-transfer",
+                    anchor,
+                    1,
+                    f"{entry}: device->host transfer inside the compiled "
+                    f"train block: {line}",
+                )
+            )
+        if hosts[3:]:
+            findings.append(
+                Finding(
+                    "host-transfer",
+                    anchor,
+                    1,
+                    f"{entry}: ... and {len(hosts) - 3} more host-transfer "
+                    "op(s)",
+                )
+            )
+        if not sharded and counts:
+            findings.append(
+                Finding(
+                    "collective-census",
+                    anchor,
+                    1,
+                    f"{entry}: the seed-only program must contain ZERO "
+                    f"collectives (data parallelism is embarrassingly "
+                    f"parallel), found {counts}",
+                )
+            )
+        allowed = ALLOWED_COLLECTIVES | EXTRA_ALLOWED_COLLECTIVES.get(
+            entry.split("@", 1)[0], frozenset()
+        )
+        bad_kinds = set(counts) - allowed
+        if bad_kinds:
+            findings.append(
+                Finding(
+                    "collective-census",
+                    anchor,
+                    1,
+                    f"{entry}: collective kind(s) {sorted(bad_kinds)} "
+                    f"outside the enumerated pod-readiness set "
+                    f"{sorted(allowed)}",
+                )
+            )
+        rows.append(
+            {
+                "v": 1,
+                "kind": "collectives",
+                "entry": entry,
+                "fingerprint": fp,
+                "program": program_fingerprint(lowered),
+                "platform": jax.devices()[0].platform,
+                "jax": jax.__version__,
+                "n_devices": n_dev,
+                "mesh": mesh_shape,
+                "collectives": counts,
+                "host_transfers": len(hosts),
+            }
+        )
+    return rows, findings, notes, skipped
+
+
+def compare_census(
+    baseline: Sequence[dict], fresh: Sequence[dict], skipped=frozenset()
+) -> Tuple[List[Finding], List[str]]:
+    """Diff fresh census rows against the ledger — EXACT (integer
+    counts, zero tolerance). Any drift means either a regression or a
+    deliberate communication change that must regenerate AUDIT.jsonl in
+    the same PR. Entries in ``skipped`` could not be measured on this
+    host (already noted) and are exempt from the stale-row check."""
+    findings: List[Finding] = []
+    notes: List[str] = []
+    base_by_entry = {
+        r["entry"]: r for r in baseline if r.get("kind") == "collectives"
+    }
+    fresh_entries = set()
+    for row in fresh:
+        entry = row["entry"]
+        fresh_entries.add(entry)
+        anchor = _ANCHORS.get(
+            entry.split("@", 1)[0], "rcmarl_tpu/lint/collectives.py"
+        )
+        base = base_by_entry.get(entry)
+        if base is None:
+            findings.append(
+                Finding(
+                    "collective-census",
+                    anchor,
+                    1,
+                    f"{entry}: no row in the baseline ledger — regenerate "
+                    "and commit AUDIT.jsonl in this PR "
+                    "(lint --cost --collectives --write_baseline)",
+                )
+            )
+            continue
+        if base.get("fingerprint") != row.get("fingerprint"):
+            findings.append(
+                Finding(
+                    "collective-census",
+                    anchor,
+                    1,
+                    f"{entry}: canonical census config changed (ledger "
+                    f"fingerprint {base.get('fingerprint')} != "
+                    f"{row.get('fingerprint')}); regenerate AUDIT.jsonl",
+                )
+            )
+            continue
+        if (
+            base.get("platform") != row.get("platform")
+            or base.get("n_devices") != row.get("n_devices")
+        ):
+            notes.append(
+                f"{entry}: ledger measured on {base.get('platform')!r} x "
+                f"{base.get('n_devices')} device(s), running "
+                f"{row.get('platform')!r} x {row.get('n_devices')}; "
+                "census not comparable here"
+            )
+            continue
+        if base.get("collectives", {}) != row.get("collectives", {}):
+            findings.append(
+                Finding(
+                    "collective-census",
+                    anchor,
+                    1,
+                    f"{entry}: collective set drifted from the ledger — "
+                    f"{base.get('collectives')} -> {row.get('collectives')} "
+                    "(a deliberate communication change must regenerate "
+                    "AUDIT.jsonl in the same PR)",
+                )
+            )
+    for entry in sorted(set(base_by_entry) - fresh_entries - set(skipped)):
+        findings.append(
+            Finding(
+                "collective-census",
+                _ANCHORS.get(
+                    entry.split("@", 1)[0], "rcmarl_tpu/lint/collectives.py"
+                ),
+                1,
+                f"{entry}: ledger row has no current counterpart (entry "
+                "removed or renamed); regenerate AUDIT.jsonl",
+            )
+        )
+    return findings, notes
+
+
+def audit_collectives(
+    baseline_path="AUDIT.jsonl",
+) -> Tuple[List[Finding], List[str], List[dict]]:
+    """``lint --collectives``: (findings, notes, fresh rows)."""
+    from rcmarl_tpu.lint.cost import read_ledger
+
+    fresh, findings, notes, skipped = census_rows()
+    baseline = read_ledger(baseline_path)
+    if not baseline:
+        notes.append(
+            f"baseline ledger {baseline_path} missing or empty; every "
+            "census row below reports unbaselined"
+        )
+    cmp_findings, cmp_notes = compare_census(baseline, fresh, skipped)
+    return findings + cmp_findings, notes + cmp_notes, fresh

@@ -41,3 +41,18 @@ def tiny_faulted_cfg(netstack, **overrides):
         consensus_sanitize=True,
         **overrides,
     )
+
+
+def census_cfg(**overrides):
+    """The collective-census variant: 4 cooperative agents on a
+    circulant degree-3 ring, so the agent axis tiles evenly over a
+    2-wide mesh 'agent' dimension (the seed×agent sharding the census
+    compiles; 3 agents would not tile)."""
+    from rcmarl_tpu.config import Roles, circulant_in_nodes
+
+    return tiny_cfg(
+        n_agents=4,
+        agent_roles=(Roles.COOPERATIVE,) * 4,
+        in_nodes=circulant_in_nodes(4, 3),
+        **overrides,
+    )

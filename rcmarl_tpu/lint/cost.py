@@ -1,0 +1,419 @@
+"""Compiled-cost ledger — the perf contracts, CI-gated.
+
+graftlint's other arms enforce *correctness* contracts (exactly-once
+retrace, donation aliasing, backend purity); this arm enforces the
+*cost* contracts the perf PRs fought for. Every registered jitted entry
+point (:func:`rcmarl_tpu.utils.profiling.jit_entry_points`) — both
+netstack arms, the donated twins, the guarded+faulted diag variant —
+plus all six aggregation-backend modes at a canonical tiny shape is
+lowered and compiled through the shared memoized helpers, and XLA's own
+``cost_analysis()`` / ``memory_analysis()`` are extracted into ledger
+rows: FLOPs, bytes accessed, argument/output/temp buffer bytes, and the
+derived peak. The committed ``AUDIT.jsonl`` is the baseline; ``python
+-m rcmarl_tpu lint --cost --baseline AUDIT.jsonl`` fails with a
+per-entry finding when any metric grows beyond a small tolerance
+without a ledger update, so "the one-launch consensus block got
+cheaper" stops being a bench-only claim and becomes a CI invariant.
+
+Rules: ``cost-regression`` (a gated metric grew past the tolerance) and
+``cost-unbaselined`` (a compiled entry has no matching ledger row — new
+entry, changed canonical config fingerprint, or a stale ledger row
+whose entry no longer exists). Platforms exposing no cost metadata
+yield notes (donation-audit style), never silent passes. When a perf PR
+legitimately changes costs, regenerate and commit the ledger in the
+same PR: ``python -m rcmarl_tpu lint --cost --collectives
+--write_baseline``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from rcmarl_tpu.lint.findings import Finding
+
+#: Default relative growth tolerance for the gated cost metrics
+#: (absorbs constant-folding jitter across minor toolchain revisions; a
+#: real regression — a widened layer, a dropped donation, a second
+#: gather — moves these numbers by far more).
+COST_TOLERANCE = 0.01
+
+#: Absolute slack in metric units (bytes / flops) applied ONLY to
+#: zero baselines, where the relative gate is meaningless — keeps a
+#: 0 -> 64-byte scratch buffer from tripping, without loosening the
+#: tiny canonical rows (flops in the low thousands) whose full
+#: relative sensitivity is the point of the gate.
+COST_ABS_SLACK = 256.0
+
+#: The metrics the gate compares (growth beyond tolerance = finding).
+#: ``alias_bytes`` is recorded but NOT gated: the donation audit owns
+#: that contract with leaf-count semantics, and here a donation gain
+#: would read as "regression" under a naive growth gate.
+GATED_METRICS = (
+    "flops",
+    "bytes_accessed",
+    "argument_bytes",
+    "output_bytes",
+    "temp_bytes",
+    "peak_bytes",
+)
+
+_ANCHORS = {
+    "update_block": "rcmarl_tpu/training/update.py",
+    "train_block": "rcmarl_tpu/training/trainer.py",
+    "aggregation": "rcmarl_tpu/ops/aggregation.py",
+}
+
+
+def _anchor_for(entry: str) -> str:
+    for prefix, path in _ANCHORS.items():
+        if entry.startswith(prefix):
+            return path
+    return "rcmarl_tpu/lint/cost.py"
+
+
+# --------------------------------------------------------------------------
+# Ledger IO — canonical, sorted, byte-stable
+# --------------------------------------------------------------------------
+
+
+def canonical_rows(rows: Sequence[dict]) -> List[dict]:
+    """Rows in the committed order: sorted by (kind, entry) with sorted
+    keys inside each row — regenerating an unchanged ledger must leave
+    a byte-identical file, whatever order the arms produced rows in."""
+    return sorted(
+        (json.loads(json.dumps(r, sort_keys=True)) for r in rows),
+        key=lambda r: (r.get("kind", ""), r.get("entry", "")),
+    )
+
+
+def write_ledger(path, rows: Sequence[dict]) -> None:
+    """One canonical JSON object per line, trailing newline."""
+    lines = [json.dumps(r, sort_keys=True) for r in canonical_rows(rows)]
+    Path(path).write_text("\n".join(lines) + "\n" if lines else "")
+
+
+def read_ledger(path) -> List[dict]:
+    """Parse an AUDIT.jsonl; missing file reads as an empty ledger (the
+    comparison then reports every fresh row unbaselined, which is the
+    correct loud failure for a deleted baseline)."""
+    p = Path(path)
+    if not p.exists():
+        return []
+    return [
+        json.loads(line)
+        for line in p.read_text().splitlines()
+        if line.strip()
+    ]
+
+
+# --------------------------------------------------------------------------
+# Row extraction
+# --------------------------------------------------------------------------
+
+
+def _compiled_metrics(compiled) -> Optional[Dict[str, float]]:
+    """The gated metric dict off a jax.stages.Compiled, or None when
+    the platform exposes no cost metadata (reported as a note)."""
+    try:
+        cost = compiled.cost_analysis()
+        mem = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — platform without the API
+        return None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if cost is None or mem is None:
+        return None
+    arg = float(getattr(mem, "argument_size_in_bytes", 0))
+    out = float(getattr(mem, "output_size_in_bytes", 0))
+    tmp = float(getattr(mem, "temp_size_in_bytes", 0))
+    alias = float(getattr(mem, "alias_size_in_bytes", 0))
+    return {
+        "flops": float(cost.get("flops", 0.0)),
+        "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "temp_bytes": tmp,
+        "alias_bytes": alias,
+        # live-at-once upper bound: arguments + outputs + scratch,
+        # minus the buffers donation lets XLA reuse in place
+        "peak_bytes": arg + out + tmp - alias,
+    }
+
+
+def _row(entry: str, fingerprint: str, program: str, metrics) -> dict:
+    import jax
+
+    return {
+        "v": 1,
+        "kind": "cost",
+        "entry": entry,
+        "fingerprint": fingerprint,
+        "program": program,
+        "platform": jax.devices()[0].platform,
+        "jax": jax.__version__,
+        "metrics": metrics,
+    }
+
+
+def cost_arms() -> Dict[str, tuple]:
+    """The entry-point compile matrix: arm name -> (config, with_diag,
+    entry names). Dual covers the donated twins (the donation audit's
+    exact programs, shared via the compile cache); guarded is the
+    undonated diag path the fault-plan trainer actually runs."""
+    from rcmarl_tpu.lint.configs import tiny_cfg, tiny_faulted_cfg
+
+    return {
+        "dual": (
+            tiny_cfg(netstack=False),
+            False,
+            (
+                "update_block",
+                "train_block",
+                "update_block_donated",
+                "train_block_donated",
+            ),
+        ),
+        "stacked": (
+            tiny_cfg(netstack=True),
+            False,
+            ("update_block", "train_block"),
+        ),
+        "guarded": (
+            tiny_faulted_cfg(False),
+            True,
+            ("update_block", "train_block"),
+        ),
+    }
+
+
+def entry_cost_rows(
+    arms: Optional[Dict[str, tuple]] = None,
+) -> Tuple[List[dict], List[str], set]:
+    """Ledger rows for the jitted entry points, via the shared memoized
+    compile helpers. Returns (rows, notes, skipped entry names) —
+    skipped entries are unverifiable HERE (noted), and the comparison
+    must not read their ledger rows as stale."""
+    from rcmarl_tpu.utils.profiling import (
+        compiled_entry_points,
+        config_fingerprint,
+    )
+
+    rows: List[dict] = []
+    notes: List[str] = []
+    skipped: set = set()
+    for arm, (cfg, with_diag, names) in (arms or cost_arms()).items():
+        fp = config_fingerprint(cfg) + ("+diag" if with_diag else "")
+        for name, ce in compiled_entry_points(cfg, with_diag, names).items():
+            entry = f"{name}@{arm}"
+            metrics = _compiled_metrics(ce.compiled)
+            if metrics is None:
+                notes.append(
+                    f"{entry}: platform exposes no cost/memory analysis; "
+                    "cost unverifiable here"
+                )
+                skipped.add(entry)
+                continue
+            rows.append(_row(entry, fp, ce.fingerprint, metrics))
+    return rows, notes, skipped
+
+
+def aggregation_cost_rows() -> Tuple[List[dict], List[str], set]:
+    """Ledger rows for all six aggregation-backend modes (× sanitize)
+    at the canonical tiny shape the backend purity audit uses. Returns
+    (rows, notes, skipped entry names)."""
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rcmarl_tpu.ops.aggregation import (
+        AUDIT_BACKEND_MODES,
+        resilient_aggregate_tree,
+    )
+    from rcmarl_tpu.utils.profiling import program_fingerprint
+
+    rows: List[dict] = []
+    notes: List[str] = []
+    skipped: set = set()
+    tree = {
+        "w": jnp.ones((5, 3, 4), jnp.float32),
+        "b": jnp.ones((5, 7), jnp.float32),
+    }
+    valid = jnp.asarray(np.array([1.0, 1.0, 1.0, 1.0, 0.0]), jnp.float32)
+    for name, recipe in AUDIT_BACKEND_MODES:
+        for sanitize in (False, True):
+            kwargs = {"impl": recipe["impl"], "sanitize": sanitize}
+            H = jnp.asarray(1, jnp.int32) if recipe.get("traced_h") else 1
+            if recipe.get("masked"):
+                kwargs["valid"] = valid
+            entry = f"aggregation[{name}{'+sanitize' if sanitize else ''}]"
+            fp = hashlib.sha256(
+                repr((name, sorted(kwargs.items()), "5x3x4+5x7")).encode()
+            ).hexdigest()[:12]
+            try:
+                lowered = jax.jit(
+                    lambda t, kw=kwargs, h=H: resilient_aggregate_tree(
+                        t, h, **kw
+                    )
+                ).lower(tree)
+                compiled = lowered.compile()
+            except Exception as e:  # noqa: BLE001 — e.g. a real Pallas
+                # kernel on a CPU host: not compilable here, so its cost
+                # is noted as unverifiable, never silently passed
+                notes.append(
+                    f"{entry}: not compilable on this platform "
+                    f"({type(e).__name__}: {str(e)[:120]}); cost "
+                    "unverifiable here"
+                )
+                skipped.add(entry)
+                continue
+            metrics = _compiled_metrics(compiled)
+            if metrics is None:
+                notes.append(
+                    f"{entry}: platform exposes no cost/memory analysis; "
+                    "cost unverifiable here"
+                )
+                skipped.add(entry)
+                continue
+            rows.append(_row(entry, fp, program_fingerprint(lowered), metrics))
+    return rows, notes, skipped
+
+
+def cost_rows() -> Tuple[List[dict], List[str], set]:
+    """All cost-kind ledger rows: entry points + aggregation modes.
+    Returns (rows, notes, skipped entry names)."""
+    rows, notes, skipped = entry_cost_rows()
+    arows, anotes, askipped = aggregation_cost_rows()
+    return rows + arows, notes + anotes, skipped | askipped
+
+
+# --------------------------------------------------------------------------
+# The gate
+# --------------------------------------------------------------------------
+
+
+def _grew(old: float, new: float, tol: float) -> bool:
+    """``new`` grew past ``old``: relative tolerance on a nonzero
+    baseline; on a ZERO baseline the absolute :data:`COST_ABS_SLACK`
+    (a 0 -> tiny scratch buffer is noise, anything bigger is real)."""
+    return new > (old * (1.0 + tol) if old else COST_ABS_SLACK)
+
+
+def compare_cost(
+    baseline: Sequence[dict],
+    fresh: Sequence[dict],
+    tol: float = COST_TOLERANCE,
+    skipped=frozenset(),
+) -> Tuple[List[Finding], List[str]]:
+    """Diff fresh cost rows against the committed ledger.
+
+    Findings: ``cost-regression`` when a gated metric grows beyond
+    ``tol`` (relative; :data:`COST_ABS_SLACK` absolute on a zero
+    baseline);
+    ``cost-unbaselined`` for fresh entries with no ledger row, ledger
+    rows whose config fingerprint no longer matches (the canonical
+    audit shape changed), and stale ledger rows with no fresh
+    counterpart — except entries in ``skipped``, which this host could
+    not measure (already noted, not stale). Notes: platform mismatches
+    (not comparable here) and metrics that SHRANK beyond tolerance (an
+    unclaimed win — refresh the ledger to lock it in).
+    """
+    findings: List[Finding] = []
+    notes: List[str] = []
+    base_by_entry = {
+        r["entry"]: r for r in baseline if r.get("kind") == "cost"
+    }
+    fresh_entries = set()
+    for row in fresh:
+        entry = row["entry"]
+        fresh_entries.add(entry)
+        anchor = _anchor_for(entry)
+        base = base_by_entry.get(entry)
+        if base is None:
+            findings.append(
+                Finding(
+                    "cost-unbaselined",
+                    anchor,
+                    1,
+                    f"{entry}: no row in the baseline ledger — regenerate "
+                    "and commit AUDIT.jsonl in this PR "
+                    "(lint --cost --collectives --write_baseline)",
+                )
+            )
+            continue
+        if base.get("fingerprint") != row.get("fingerprint"):
+            findings.append(
+                Finding(
+                    "cost-unbaselined",
+                    anchor,
+                    1,
+                    f"{entry}: canonical audit config changed "
+                    f"(ledger fingerprint {base.get('fingerprint')} != "
+                    f"{row.get('fingerprint')}); regenerate AUDIT.jsonl",
+                )
+            )
+            continue
+        if base.get("platform") != row.get("platform"):
+            notes.append(
+                f"{entry}: ledger measured on {base.get('platform')!r}, "
+                f"running on {row.get('platform')!r}; cost not comparable "
+                "here"
+            )
+            continue
+        jax_skew = (
+            f" (ledger generated under jax {base.get('jax')}, running "
+            f"{row.get('jax')} — regenerate if this is a toolchain bump)"
+            if base.get("jax") != row.get("jax")
+            else ""
+        )
+        for metric in GATED_METRICS:
+            old = float(base["metrics"].get(metric, 0.0))
+            new = float(row["metrics"].get(metric, 0.0))
+            if _grew(old, new, tol):
+                ratio = new / old if old else float("inf")
+                findings.append(
+                    Finding(
+                        "cost-regression",
+                        anchor,
+                        1,
+                        f"{entry}: {metric} grew {old:.0f} -> {new:.0f} "
+                        f"({ratio:.3f}x > 1+{tol:g} tolerance) without a "
+                        f"ledger update{jax_skew}",
+                    )
+                )
+            elif _grew(new, old, tol):
+                notes.append(
+                    f"{entry}: {metric} shrank {old:.0f} -> {new:.0f}; "
+                    "refresh AUDIT.jsonl to lock the improvement in"
+                )
+    for entry in sorted(set(base_by_entry) - fresh_entries - set(skipped)):
+        findings.append(
+            Finding(
+                "cost-unbaselined",
+                _anchor_for(entry),
+                1,
+                f"{entry}: ledger row has no current counterpart (entry "
+                "removed or renamed); regenerate AUDIT.jsonl",
+            )
+        )
+    return findings, notes
+
+
+def audit_cost(
+    baseline_path="AUDIT.jsonl", tol: float = COST_TOLERANCE
+) -> Tuple[List[Finding], List[str], List[dict]]:
+    """``lint --cost``: (findings, notes, fresh rows). The fresh rows
+    are returned so the CLI can write them next to a failing baseline
+    (the one-click ledger diff CI uploads)."""
+    fresh, notes, skipped = cost_rows()
+    baseline = read_ledger(baseline_path)
+    if not baseline:
+        notes.append(
+            f"baseline ledger {baseline_path} missing or empty; every "
+            "entry below reports unbaselined"
+        )
+    findings, cmp_notes = compare_cost(baseline, fresh, tol, skipped)
+    return findings, notes + cmp_notes, fresh

@@ -25,7 +25,6 @@ pass of the contract.
 from __future__ import annotations
 
 import re
-import warnings
 from typing import Dict, List, Optional, Tuple
 
 from rcmarl_tpu.lint.findings import Finding
@@ -42,29 +41,6 @@ def alias_pair_count(compiled_text: str) -> Optional[int]:
     return header.count("may-alias") + header.count("must-alias")
 
 
-def _tiny_inputs():
-    """(cfg, state, batch, fresh, key): real tiny-config inputs for
-    lowering the donated entry points (shared with the regression
-    test). The dual-launch arm is forced so the audit is
-    deterministic across backends."""
-    import jax
-
-    from rcmarl_tpu.lint.configs import tiny_cfg
-    from rcmarl_tpu.training.buffer import update_batch
-    from rcmarl_tpu.training.rollout import rollout_block
-    from rcmarl_tpu.training.trainer import init_train_state, make_env
-
-    cfg = tiny_cfg(netstack=False)
-    state = init_train_state(cfg, jax.random.PRNGKey(0))
-    env = make_env(cfg)
-    key = jax.random.PRNGKey(1)
-    fresh, _ = jax.jit(
-        lambda s, k: rollout_block(cfg, env, s.params, s.desired, k, s.initial)
-    )(state, key)
-    batch = jax.jit(update_batch)(state.buffer, fresh)
-    return cfg, state, batch, fresh, key
-
-
 def donation_report() -> Dict[str, dict]:
     """Compile both donated entry points and report their aliasing:
     ``{name: {alias_pairs, expected_min, has_metadata, warnings}}``.
@@ -73,37 +49,38 @@ def donation_report() -> Dict[str, dict]:
     the stacked nets and optimizer moments whose in-place update is the
     entire point of the donation. XLA may alias more (replay buffer,
     RNG carry); it must not alias fewer.
+
+    The compiles ride the shared memoized helpers
+    (:func:`rcmarl_tpu.utils.profiling.compiled_entry_points`, dual-
+    launch arm for cross-backend determinism): in a ``lint --all`` run
+    the cost arm and this audit read the SAME compiled artifacts, each
+    entry point compiled once. Donation-relevant XLA warnings are
+    captured at compile time by the helper, whichever arm compiles
+    first.
     """
     import jax
 
-    from rcmarl_tpu.training.trainer import train_block_donated
-    from rcmarl_tpu.training.update import update_block_donated
+    from rcmarl_tpu.lint.configs import tiny_cfg
+    from rcmarl_tpu.utils.profiling import (
+        compiled_entry_points,
+        entry_point_inputs,
+    )
 
-    cfg, state, batch, fresh, key = _tiny_inputs()
+    cfg = tiny_cfg(netstack=False)
+    state, _, _, _ = entry_point_inputs(cfg)
     n_param_leaves = len(jax.tree.leaves(state.params))
     report: Dict[str, dict] = {}
-    cases = [
-        (
-            "update_block_donated",
-            lambda: update_block_donated.lower(
-                cfg, state.params, batch, fresh, key
-            ),
-        ),
-        ("train_block_donated", lambda: train_block_donated.lower(cfg, state)),
-    ]
-    for name, lower in cases:
-        with warnings.catch_warnings(record=True) as caught:
-            warnings.simplefilter("always")
-            compiled = lower().compile()
-        pairs = alias_pair_count(compiled.as_text())
+    entries = compiled_entry_points(
+        cfg, names=("update_block_donated", "train_block_donated")
+    )
+    for name, entry in entries.items():
+        pairs = alias_pair_count(entry.compiled.as_text())
         report[name] = {
             "alias_pairs": pairs,
             "expected_min": n_param_leaves,
             "has_metadata": pairs is not None,
             "warnings": [
-                str(w.message)
-                for w in caught
-                if "donat" in str(w.message).lower()
+                w for w in entry.warnings if "donat" in w.lower()
             ],
         }
     return report

@@ -54,6 +54,7 @@ from rcmarl_tpu.parallel.seeds import (
 __all__ = [
     "matrix_specs",
     "train_matrix",
+    "lower_matrix",
     "reset_matrix_for_phase",
     "split_matrix_metrics",
 ]
@@ -141,6 +142,27 @@ def train_matrix(
     ``len(cells) * len(seeds)`` in cell-major order; None when
     ``compile_only``.
     """
+    fn, states, specs = _matrix_program(
+        base, cells, seeds, n_blocks, mesh, states, shard_agents
+    )
+    if compile_only:
+        fn.lower(states, specs).compile()
+        return None
+    return fn(states, specs)
+
+
+def _matrix_program(
+    base: Config,
+    cells: Sequence[Config],
+    seeds: Sequence[int],
+    n_blocks: int,
+    mesh: Optional[Mesh] = None,
+    states: Optional[TrainState] = None,
+    shard_agents: bool = False,
+):
+    """(jitted fn, device-placed states, device-placed specs): the fused
+    matrix executable, shared by :func:`train_matrix` and
+    :func:`lower_matrix`."""
     _check_fusable(base, cells)
     n_rep = len(cells) * len(seeds)
     if mesh is None:
@@ -176,10 +198,25 @@ def train_matrix(
             out_shardings=(in_shard, NamedSharding(mesh, P("seed"))),
         ),
     )
-    if compile_only:
-        fn.lower(states, specs).compile()
-        return None
-    return fn(states, specs)
+    return fn, states, specs
+
+
+def lower_matrix(
+    base: Config,
+    cells: Sequence[Config],
+    seeds: Sequence[int],
+    n_blocks: int = 1,
+    mesh: Optional[Mesh] = None,
+    shard_agents: bool = False,
+):
+    """Lower (without executing) the fused-matrix program — the
+    ``jax.stages.Lowered`` the graftlint collective census audits for
+    the heterogeneous seed×agent mesh. Inspects lowering only; never
+    runs the collectives."""
+    fn, states, specs = _matrix_program(
+        base, cells, seeds, n_blocks, mesh, None, shard_agents
+    )
+    return fn.lower(states, specs)
 
 
 def reset_matrix_for_phase(

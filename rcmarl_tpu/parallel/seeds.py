@@ -154,6 +154,49 @@ def cached_jit(key, build):
     return fn
 
 
+def _parallel_program(
+    cfg: Config,
+    states: TrainState,
+    n_blocks: int,
+    mesh: Mesh,
+    shard_agents: bool,
+):
+    """(jitted fn, device-placed states): the sharded multi-replica
+    executable, shared by :func:`train_parallel` (which executes it)
+    and :func:`lower_parallel` (which only inspects its lowering — the
+    graftlint collective census). One ``cached_jit`` slot per program
+    shape either way."""
+    in_shard = state_shardings(mesh, states, shard_agents)
+    states = jax.device_put(states, in_shard)
+    fn = cached_jit(
+        ("seeds", cfg, n_blocks, mesh, shard_agents),
+        lambda: jax.jit(
+            jax.vmap(lambda s: train_scanned(cfg, s, n_blocks)),
+            in_shardings=(in_shard,),
+            out_shardings=(in_shard, NamedSharding(mesh, P("seed"))),
+        ),
+    )
+    return fn, states
+
+
+def lower_parallel(
+    cfg: Config,
+    seeds,
+    n_blocks: int = 1,
+    mesh: Optional[Mesh] = None,
+    shard_agents: bool = False,
+):
+    """Lower (without executing) the sharded replica program: the
+    ``jax.stages.Lowered`` whose compiled HLO the collective census
+    audits. Safe on single-core hosts — nothing here runs the
+    collectives, it only compiles them."""
+    states = init_states(cfg, seeds)
+    if mesh is None:
+        mesh = make_mesh()
+    fn, states = _parallel_program(cfg, states, n_blocks, mesh, shard_agents)
+    return fn.lower(states)
+
+
 def train_parallel(
     cfg: Config,
     seeds=None,
@@ -193,17 +236,7 @@ def train_parallel(
     if states is None:
         states = init_states(cfg, seeds)
 
-    in_shard = state_shardings(mesh, states, shard_agents)
-    states = jax.device_put(states, in_shard)
-
-    fn = cached_jit(
-        ("seeds", cfg, n_blocks, mesh, shard_agents),
-        lambda: jax.jit(
-            jax.vmap(lambda s: train_scanned(cfg, s, n_blocks)),
-            in_shardings=(in_shard,),
-            out_shardings=(in_shard, NamedSharding(mesh, P("seed"))),
-        ),
-    )
+    fn, states = _parallel_program(cfg, states, n_blocks, mesh, shard_agents)
     return fn(states)
 
 

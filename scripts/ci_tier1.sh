@@ -84,12 +84,18 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
 echo "netstack ragged smoke cell OK"
 
 # graftlint cell: the AST passes over the installed package (zero
-# findings is the contract — rcmarl_tpu.lint) plus the retrace audit,
-# which runs tiny guarded+faulted 2-block trains on both netstack arms
-# and a clean donated run and fails if any jitted entry point compiles
-# more than once after its warmup block. The donation + backend-purity
-# audits run inside the pytest suite above (tests/test_lint.py); the
-# retrace repeat here proves the compile-once contract through the real
-# CLI entry, not just the test harness.
-timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rcmarl_tpu lint --retrace
+# findings is the contract — rcmarl_tpu.lint) plus the retrace audit
+# (tiny guarded+faulted 2-block trains on both netstack arms + a clean
+# donated run; any post-warmup compile fails) plus the COST GATE and
+# COLLECTIVE CENSUS against the committed AUDIT.jsonl ledger: every
+# jitted entry point recompiled and its FLOPs / bytes-accessed / buffer
+# bytes compared to the ledger, the seed×agent sharded programs' HLO
+# collective counts matched exactly, host transfers forbidden. The
+# donation + backend-purity audits run inside the pytest suite above
+# (tests/test_lint.py); the repeat here proves the contracts through
+# the real CLI entry, not just the test harness. On a cost/census
+# failure the CLI writes AUDIT.jsonl.new next to the baseline — ci.yml
+# uploads it as an artifact so the ledger diff is one click away.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m rcmarl_tpu lint \
+    --retrace --cost --collectives --baseline AUDIT.jsonl
 echo "graftlint cell OK"

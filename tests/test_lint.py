@@ -16,6 +16,13 @@ Three pins:
    compiled executable (xfail where the platform exposes no aliasing
    metadata); the backend purity/dtype audit passes over all six
    aggregation backends and both netstack epoch arms.
+4. **Cost ledger + collective census** — AUDIT.jsonl round-trips
+   canonically and byte-stably; a planted hidden-width regression and
+   a planted host callback each trip their gate (`cost-regression` /
+   `host-transfer`) at exactly the offending entry; sharded-program
+   collective counts gate exactly and stay inside the enumerated
+   pod-readiness set; and (slow) the full audits report zero findings
+   against the COMMITTED ledger.
 """
 
 from __future__ import annotations
@@ -172,6 +179,246 @@ class TestDonationAudit:
         from rcmarl_tpu.lint.donation import audit_donation
 
         findings, _notes = audit_donation()
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+
+class TestCostLedger:
+    """The compiled-cost gate (lint --cost): AUDIT.jsonl round-trips
+    canonically, clean self-comparison, and the planted regressions
+    trip at exactly the offending entry with a stable rule id."""
+
+    @pytest.fixture(scope="class")
+    def base_rows(self):
+        from rcmarl_tpu.lint.configs import tiny_cfg
+        from rcmarl_tpu.lint.cost import entry_cost_rows
+
+        arms = {"dual": (tiny_cfg(netstack=False), False, ("update_block",))}
+        rows, notes, skipped = entry_cost_rows(arms)
+        assert notes == [] and skipped == set() and len(rows) == 1
+        return rows
+
+    def test_ledger_roundtrip_and_canonical_order(self, tmp_path):
+        from rcmarl_tpu.lint.cost import (
+            canonical_rows,
+            read_ledger,
+            write_ledger,
+        )
+
+        rows = [
+            {"v": 1, "kind": "cost", "entry": "z", "metrics": {"b": 2, "a": 1}},
+            {"kind": "collectives", "entry": "a", "v": 1},
+            {"v": 1, "kind": "cost", "entry": "a", "metrics": {}},
+        ]
+        path = tmp_path / "AUDIT.jsonl"
+        write_ledger(path, rows)
+        back = read_ledger(path)
+        assert back == canonical_rows(rows)
+        assert [(r["kind"], r["entry"]) for r in back] == [
+            ("collectives", "a"), ("cost", "a"), ("cost", "z"),
+        ]
+        # byte-stable: rewriting the read-back rows (any order) changes
+        # nothing — the committed artifact never churns spuriously
+        first = path.read_bytes()
+        write_ledger(path, list(reversed(back)))
+        assert path.read_bytes() == first
+        assert read_ledger(tmp_path / "missing.jsonl") == []
+
+    def test_self_comparison_is_clean(self, base_rows):
+        from rcmarl_tpu.lint.cost import compare_cost
+
+        findings, notes = compare_cost(base_rows, base_rows)
+        assert findings == [] and notes == []
+
+    def test_metric_growth_trips_exactly_the_entry(self, base_rows):
+        import copy
+
+        from rcmarl_tpu.lint.cost import compare_cost
+
+        other = {
+            "v": 1, "kind": "cost", "entry": "aggregation[xla]",
+            "fingerprint": "f", "program": "p", "platform": "cpu",
+            "jax": "x", "metrics": {"flops": 100.0},
+        }
+        fresh = copy.deepcopy(base_rows) + [copy.deepcopy(other)]
+        fresh[0]["metrics"]["flops"] *= 1.10
+        findings, _ = compare_cost(base_rows + [other], fresh)
+        assert {f.rule for f in findings} == {"cost-regression"}
+        assert len(findings) == 1 and "update_block@dual" in findings[0].message
+        assert "flops" in findings[0].message
+
+    def test_planted_hidden_width_regression(self, base_rows):
+        """Widen one hidden layer and recompile: FLOPs/bytes grow, and
+        the gate trips cost-regression at exactly the offending entry.
+        (The fresh rows reuse the baseline fingerprint: a program-side
+        cost change at the fixed canonical config — the drift class the
+        metric gate owns; config-side changes are covered below.)"""
+        from rcmarl_tpu.lint.configs import tiny_cfg
+        from rcmarl_tpu.lint.cost import compare_cost, entry_cost_rows
+
+        arms = {
+            "dual": (
+                tiny_cfg(netstack=False, hidden=(40, 20)),
+                False,
+                ("update_block",),
+            )
+        }
+        fresh, _, _ = entry_cost_rows(arms)
+        assert fresh[0]["metrics"]["flops"] > base_rows[0]["metrics"]["flops"]
+        fresh[0]["fingerprint"] = base_rows[0]["fingerprint"]
+        findings, _ = compare_cost(base_rows, fresh)
+        assert findings, "widened hidden layer did not trip the cost gate"
+        assert {f.rule for f in findings} == {"cost-regression"}
+        assert all("update_block@dual" in f.message for f in findings)
+        assert any("flops" in f.message for f in findings)
+
+    def test_config_change_reports_unbaselined(self, base_rows):
+        import copy
+
+        from rcmarl_tpu.lint.cost import compare_cost
+
+        fresh = copy.deepcopy(base_rows)
+        fresh[0]["fingerprint"] = "somethingelse"
+        findings, _ = compare_cost(base_rows, fresh)
+        assert {f.rule for f in findings} == {"cost-unbaselined"}
+
+    def test_missing_and_stale_rows_are_findings(self, base_rows):
+        from rcmarl_tpu.lint.cost import compare_cost
+
+        findings, _ = compare_cost([], base_rows)  # no baseline row
+        assert {f.rule for f in findings} == {"cost-unbaselined"}
+        findings, _ = compare_cost(base_rows, [])  # stale baseline row
+        assert {f.rule for f in findings} == {"cost-unbaselined"}
+        # ...but a row this host could not MEASURE is a note, not stale
+        findings, _ = compare_cost(
+            base_rows, [], skipped={base_rows[0]["entry"]}
+        )
+        assert findings == []
+
+
+class TestCollectiveCensus:
+    """lint --collectives: the sharded programs' communication stays
+    the bounded enumerated set, counts gate exactly, and a planted
+    host transfer trips with a stable rule id. Compile/inspect only —
+    no collective ever executes, so single-core hosts are safe."""
+
+    @pytest.fixture(scope="class")
+    def rows(self):
+        import jax
+
+        from rcmarl_tpu.lint.collectives import _census_programs, census_rows
+
+        if len(jax.devices()) < 4:
+            pytest.skip("census needs >= 4 (virtual) devices")
+        # the seeds programs; the matrix program rides the slow
+        # committed-ledger test and the CI graftlint cell
+        programs = {
+            k: v for k, v in _census_programs().items() if k.startswith("seeds")
+        }
+        rows, findings, notes, skipped = census_rows(programs)
+        assert findings == [] and notes == [] and skipped == set()
+        return rows
+
+    def test_seed_axis_has_zero_collectives(self, rows):
+        by_entry = {r["entry"]: r for r in rows}
+        assert by_entry["seeds@unsharded"]["collectives"] == {}
+
+    def test_sharded_set_is_bounded_and_enumerated(self, rows):
+        from rcmarl_tpu.lint.collectives import ALLOWED_COLLECTIVES
+
+        sharded = {r["entry"]: r for r in rows}["seeds@sharded"]
+        assert sharded["collectives"], "agent sharding produced no collectives"
+        assert set(sharded["collectives"]) <= ALLOWED_COLLECTIVES
+        assert sharded["host_transfers"] == 0
+
+    def test_self_comparison_is_clean(self, rows):
+        from rcmarl_tpu.lint.collectives import compare_census
+
+        findings, notes = compare_census(rows, rows)
+        assert findings == [] and notes == []
+
+    def test_census_counts_async_tuple_typed_ops(self):
+        """TPU lowers collectives to async pairs whose -start op (and
+        infeed) carry TUPLE result types with internal whitespace; the
+        census must count the -start exactly once and must not count
+        the -done or operand references."""
+        from rcmarl_tpu.lint.collectives import (
+            collective_census,
+            host_transfer_ops,
+        )
+
+        hlo = "\n".join([
+            "  %ags = (f32[2]{0}, f32[8]{0}) all-gather-start(f32[2]{0}"
+            " %p), replica_groups={}, dimensions={0}",
+            "  %agd = f32[8]{0} all-gather-done((f32[2]{0}, f32[8]{0})"
+            " %ags)",
+            "  %ar = f32[4]{0} all-reduce(f32[4]{0} %q), to_apply=%add",
+            "  %if = ((f32[4]{0}), token[]) infeed(token[] %tok)",
+        ])
+        assert collective_census(hlo) == {"all-gather": 1, "all-reduce": 1}
+        assert len(host_transfer_ops(hlo)) == 1
+
+    def test_count_drift_trips_exactly_the_entry(self, rows):
+        import copy
+
+        from rcmarl_tpu.lint.collectives import compare_census
+
+        fresh = copy.deepcopy(rows)
+        for r in fresh:
+            if r["entry"] == "seeds@sharded":
+                r["collectives"]["all-reduce"] = (
+                    r["collectives"].get("all-reduce", 0) + 1
+                )
+        findings, _ = compare_census(rows, fresh)
+        assert {f.rule for f in findings} == {"collective-census"}
+        assert len(findings) == 1 and "seeds@sharded" in findings[0].message
+
+    def test_planted_host_callback_trips_host_transfer(self):
+        """The runtime twin of the AST host-sync rule: a spurious
+        device->host pull (a host callback, the only way one survives
+        into a compiled program) must trip `host-transfer` at exactly
+        the planted entry."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from rcmarl_tpu.lint.collectives import census_rows, host_transfer_ops
+
+        def planted(x):
+            y = jax.pure_callback(
+                lambda v: np.asarray(v), jax.ShapeDtypeStruct((4,), jnp.float32), x
+            )
+            return y + 1.0
+
+        lowered = jax.jit(planted).lower(jnp.ones(4, jnp.float32))
+        assert host_transfer_ops(lowered.compile().as_text())
+        programs = {
+            "seeds@planted": (lambda: lowered, 1, {"seed": 1, "agent": 1}, True)
+        }
+        rows, findings, notes, _skipped = census_rows(programs)
+        assert notes == []
+        assert any(f.rule == "host-transfer" for f in findings)
+        assert all("seeds@planted" in f.message for f in findings)
+        assert rows[0]["host_transfers"] >= 1
+
+
+@pytest.mark.slow
+class TestCommittedLedger:
+    """The acceptance bar: the full cost + collective audits report
+    zero findings against the COMMITTED AUDIT.jsonl on this host (the
+    same gate ci_tier1.sh runs through the real CLI)."""
+
+    BASELINE = Path(__file__).parent.parent / "AUDIT.jsonl"
+
+    def test_cost_gate_is_clean(self):
+        from rcmarl_tpu.lint.cost import audit_cost
+
+        findings, _notes, _rows = audit_cost(self.BASELINE)
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+    def test_collective_census_is_clean(self):
+        from rcmarl_tpu.lint.collectives import audit_collectives
+
+        findings, _notes, _rows = audit_collectives(self.BASELINE)
         assert findings == [], "\n".join(str(f) for f in findings)
 
 
