@@ -16,7 +16,7 @@ actor updates — runs as vmapped/jitted XLA programs. Independent
 training seeds are vmapped/sharded across TPU cores.
 """
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 from rcmarl_tpu.config import (  # noqa: F401
     Config,
@@ -27,9 +27,12 @@ from rcmarl_tpu.config import (  # noqa: F401
 from rcmarl_tpu.faults import (  # noqa: F401
     FaultDiag,
     FaultPlan,
+    ReplicaFaultPlan,
     apply_link_faults,
+    apply_replica_faults,
     fault_diagnostics,
     tree_all_finite,
+    tree_finite_per_replica,
 )
 
 # Heavier layers (jax-compiled trainers, the reference compat twins) are

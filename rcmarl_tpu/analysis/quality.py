@@ -370,6 +370,85 @@ def plot_quality_crossing(
     return save_figure(fig, out_path)
 
 
+def gossip_evidence_section(artifact_path) -> list:
+    """QUALITY.md lines for the Byzantine gossip-replica experiment,
+    rendered from the committed ``scripts/gossip_experiment.py``
+    artifact (``simulation_results/gossip_byzantine.json``) — like the
+    wall-clock columns, the section regenerates byte-stably from the
+    evidence file instead of hand-maintained rows. Empty when the
+    artifact does not exist."""
+    import json
+
+    p = Path(artifact_path)
+    if not p.exists():
+        return []
+    data = json.loads(p.read_text())
+    cfg = data["config"]
+    lines = [
+        "",
+        "## Replica-level degradation (gossip)",
+        "",
+        "`--replicas` runs train R learner replicas mixed by trimmed-mean "
+        "gossip (README \"Replica-level resilience\"); their degradation "
+        "counters (`df.attrs['gossip']`: mix rounds, per-replica "
+        "rollbacks, mix exclusions, non-finite payload entries, "
+        "degree-deficit fallbacks) are read exactly like the link-fault "
+        "curves above — per-replica rollbacks > 0 mean degradation came "
+        "from replica-level containment (lost segments on the poisoned "
+        "replica only), while healthy replicas' curves should track the "
+        "clean baseline. The committed Byzantine experiment "
+        f"(`{p.name}`, `scripts/gossip_experiment.py`: R={cfg['replicas']} "
+        f"replicas, full graph, gossip_H={cfg['gossip_H']}, replicas "
+        f"{cfg['byzantine']} always-adversarial):",
+        "",
+        "| mix | byzantine mode | healthy replicas finite | team return "
+        "(first→last window) | non-finite payload entries |",
+        "|---|---|---|---|---|",
+    ]
+    for row in data["arms"]:
+        n_ok = sum(
+            1
+            for r, h in enumerate(row["replica_healthy"])
+            if h and r not in set(row["byzantine"])
+        )
+        ret = (
+            f"{row['team_return_first']} → {row['team_return_last']}"
+            if row["team_return_last"] is not None
+            else "poisoned (NaN)"
+        )
+        lines.append(
+            f"| {row['mix']} | "
+            f"{row['byzantine_mode'] or 'none (control)'} | "
+            f"{n_ok}/{row['n_healthy_expected']} | {ret} | "
+            f"{row['nonfinite_payload_entries']} |"
+        )
+    lines += [
+        "",
+        "Reading: NaN-bombing destroys the plain-mean arm outright — "
+        "every replica's POST-MIX parameters go non-finite (its return "
+        "column stays finite only where the per-replica guard keeps "
+        "re-serving each replica's last good parameters; the training "
+        "signal is gone) — while the trimmed arm absorbs the same "
+        "payload bombs as elementwise exclusions and tracks the clean "
+        "control. Finite-value attacks (sign_flip) cannot NaN a mean, "
+        "so both arms stay finite there; the trimmed arm's clip bounds "
+        "keep the healthy replicas inside their own envelope "
+        "(hypothesis-pinned) where the mean arm is dragged by the "
+        "adversarial payloads.",
+    ]
+    ov = data.get("overhead")
+    if ov:
+        lines += [
+            "",
+            f"Gossip overhead on this host ({ov['platform']}): "
+            f"{ov['ms_per_mix']} ms per mix — "
+            f"{100 * ov['overhead_per_block']:.2f}% of block time at "
+            f"`gossip_every={ov['gossip_every']}` (the `gossip_overhead` "
+            "row in PERF.jsonl).",
+        ]
+    return lines
+
+
 def write_quality_md(
     table: pd.DataFrame,
     out_path,
@@ -557,6 +636,12 @@ def write_quality_md(
         "not from consensus noise, so episodes-to-threshold inflates "
         "roughly by the skip fraction. Degenerate/asymmetric labels "
         "keep their clean-run meaning.",
+    ]
+    gossip_artifact = (
+        Path(out_path).parent / "simulation_results/gossip_byzantine.json"
+    )
+    lines += gossip_evidence_section(gossip_artifact)
+    lines += [
         "",
         "## Related artifacts",
         "",
@@ -568,6 +653,12 @@ def write_quality_md(
         "- `simulation_results/figures/quality_*.png` — per-cell "
         "crossing figures (`python -m rcmarl_tpu plot --quality`)",
     ]
+    if gossip_artifact.exists():
+        lines.append(
+            "- `simulation_results/gossip_byzantine.json` — the "
+            "Byzantine gossip-replica experiment behind the replica-"
+            "level degradation section (`scripts/gossip_experiment.py`)"
+        )
     # like cmd_parity's related-artifacts list: only link the robustness
     # companion when it exists, and never from itself
     companion = Path(out_path).parent / "QUALITY_SEEDS456.md"

@@ -200,6 +200,125 @@ def fault_plan_from_args(args):
     return plan if plan.active else None
 
 
+def _add_gossip_flags(p: argparse.ArgumentParser) -> None:
+    """Gossip-replicated learners + the replica-level threat model
+    (rcmarl_tpu.parallel.gossip / rcmarl_tpu.faults.ReplicaFaultPlan)."""
+    g = p.add_argument_group("gossip-replicated learners")
+    g.add_argument(
+        "--replicas",
+        type=int,
+        default=0,
+        help="train this many learner replicas as one vmapped seed-axis "
+        "program, mixing their parameters by trimmed-mean gossip "
+        "(0 = the solo trainer, unchanged)",
+    )
+    g.add_argument(
+        "--gossip_every",
+        type=int,
+        default=1,
+        help="mix the replicas every K blocks (0 = never mix: "
+        "independent replicas, bitwise the seed-axis behavior)",
+    )
+    g.add_argument(
+        "--gossip_graph",
+        type=str,
+        default="ring",
+        choices=["ring", "full", "random_geometric"],
+        help="replica communication graph (random_geometric: "
+        "deterministic unit-square positions from --gossip_seed, "
+        "degree-1 nearest neighbors)",
+    )
+    g.add_argument(
+        "--gossip_degree",
+        type=int,
+        default=3,
+        help="replica in-degree incl. self for ring/random_geometric "
+        "graphs (full ignores it)",
+    )
+    g.add_argument(
+        "--gossip_H",
+        type=int,
+        default=1,
+        help="replica-level trim parameter: up to H Byzantine/corrupted "
+        "replicas per gossip neighborhood are trimmed away "
+        "(needs 2H <= degree-1)",
+    )
+    g.add_argument(
+        "--gossip_mix",
+        type=str,
+        default="trimmed",
+        choices=["trimmed", "mean"],
+        help="mixing operator: trimmed = the sanitized resilient "
+        "clip-and-average (hardened default), mean = plain mean (the "
+        "unhardened comparison arm one NaN replica poisons)",
+    )
+    g.add_argument(
+        "--gossip_seed",
+        type=int,
+        default=0,
+        help="gossip-stream namespace (graph positions + replica fault "
+        "draws), independent of the training seeds",
+    )
+    rf = p.add_argument_group(
+        "replica faults (per directed gossip link per round)"
+    )
+    rf.add_argument("--replica_fault_drop_p", type=float, default=0.0,
+                    help="P(gossip link delivers nothing -> NaN payload)")
+    rf.add_argument("--replica_fault_stale_p", type=float, default=0.0,
+                    help="P(link replays the sender's LAST-round params)")
+    rf.add_argument("--replica_fault_corrupt_p", type=float, default=0.0,
+                    help="P(additive Gaussian corruption of the payload)")
+    rf.add_argument("--replica_fault_corrupt_scale", type=float, default=1.0,
+                    help="stddev of the additive corruption noise")
+    rf.add_argument("--replica_fault_flip_p", type=float, default=0.0,
+                    help="P(sign-flip corruption of the payload)")
+    rf.add_argument("--replica_fault_nan_p", type=float, default=0.0,
+                    help="P(all-NaN payload bomb)")
+    rf.add_argument("--replica_fault_inf_p", type=float, default=0.0,
+                    help="P(+Inf payload bomb)")
+    rf.add_argument("--replica_fault_seed", type=int, default=0,
+                    help="replica-fault-stream namespace")
+    rf.add_argument(
+        "--replica_byzantine",
+        nargs="+",
+        type=int,
+        default=None,
+        help="replica indices that are ALWAYS adversarial: every payload "
+        "they send is replaced per --replica_byzantine_mode "
+        "(deterministic, not probabilistic)",
+    )
+    rf.add_argument(
+        "--replica_byzantine_mode",
+        type=str,
+        default="nan",
+        choices=["nan", "sign_flip", "inf"],
+        help="what a Byzantine replica sends: all-NaN bombs, the "
+        "negation of its current params, or +Inf bombs",
+    )
+
+
+def replica_fault_plan_from_args(args):
+    """The CLI replica-fault flags as a ReplicaFaultPlan, or None when
+    inactive (clean gossip links, bitwise the fault-free mix)."""
+    from rcmarl_tpu.faults import ReplicaFaultPlan
+
+    plan = ReplicaFaultPlan(
+        drop_p=getattr(args, "replica_fault_drop_p", 0.0),
+        stale_p=getattr(args, "replica_fault_stale_p", 0.0),
+        corrupt_p=getattr(args, "replica_fault_corrupt_p", 0.0),
+        corrupt_scale=getattr(args, "replica_fault_corrupt_scale", 1.0),
+        flip_p=getattr(args, "replica_fault_flip_p", 0.0),
+        nan_p=getattr(args, "replica_fault_nan_p", 0.0),
+        inf_p=getattr(args, "replica_fault_inf_p", 0.0),
+        byzantine_replicas=tuple(
+            getattr(args, "replica_byzantine", None) or ()
+        ),
+        byzantine_mode=getattr(args, "replica_byzantine_mode", "nan"),
+        seed=getattr(args, "replica_fault_seed", 0),
+    )
+    return plan if plan.active else None
+
+
 def _netstack_value(arm: str):
     """CLI arm string -> Config.netstack value."""
     return {"on": True, "off": False}.get(arm, "auto")
@@ -259,6 +378,14 @@ def config_from_args(args) -> Config:
         compute_dtype=args.compute_dtype,
         fault_plan=fault_plan_from_args(args),
         consensus_sanitize=args.sanitize,
+        replicas=getattr(args, "replicas", 0),
+        gossip_every=getattr(args, "gossip_every", 1),
+        gossip_graph=getattr(args, "gossip_graph", "ring"),
+        gossip_degree=getattr(args, "gossip_degree", 3),
+        gossip_H=getattr(args, "gossip_H", 1),
+        gossip_mix=getattr(args, "gossip_mix", "trimmed"),
+        gossip_seed=getattr(args, "gossip_seed", 0),
+        replica_fault_plan=replica_fault_plan_from_args(args),
     )
 
 
@@ -273,6 +400,7 @@ def cmd_train(argv) -> int:
         description="Train RPBCAC agents (reference main.py equivalent)",
     )
     _add_config_flags(p)
+    _add_gossip_flags(p)
     p.add_argument("--random_seed", type=int, default=300)
     p.add_argument("--summary_dir", type=str, default="./simulation_results/")
     p.add_argument(
@@ -341,10 +469,16 @@ def cmd_train(argv) -> int:
     out.mkdir(parents=True, exist_ok=True)
 
     state = None
+    ckpt_meta = {}
     if args.pretrained_agents:
         src = Path(args.pretrained_agents)
         if not src.exists():
             raise SystemExit(f"--pretrained_agents: {src} does not exist")
+        if cfg.replicas and not src.is_file():
+            raise SystemExit(
+                "--replicas resume needs a checkpoint .npz (the "
+                "reference artifact layout has no replica axis)"
+            )
         if src.is_file():  # our checkpoint
             # Checksum-verified; a corrupted/truncated file falls back to
             # the rotated <src>.prev instead of crashing the resume.
@@ -354,7 +488,22 @@ def cmd_train(argv) -> int:
                     f"WARNING: {src} is corrupted; resumed the previous "
                     f"good checkpoint {loaded}"
                 )
-            print(f"resumed checkpoint {loaded} at block {int(state.block)}")
+            from rcmarl_tpu.utils.checkpoint import read_checkpoint_meta
+
+            ckpt_meta = read_checkpoint_meta(loaded)
+            ckpt_replicas = int(ckpt_meta.get("replicas", 0))
+            if ckpt_replicas != cfg.replicas:
+                # the loaded state's replica axis comes from the FILE's
+                # meta; running it under a different --replicas would
+                # mix/train a mismatched world (gather indices silently
+                # clamp inside jit) — fail loudly instead
+                raise SystemExit(
+                    f"--pretrained_agents: checkpoint {loaded} was saved "
+                    f"with replicas={ckpt_replicas}, this run requests "
+                    f"--replicas {cfg.replicas}; replica counts must match"
+                )
+            block_no = int(np.asarray(state.block).reshape(-1)[0])
+            print(f"resumed checkpoint {loaded} at block {block_no}")
             # Shapes were validated by load_checkpoint; non-structural
             # hyperparameters (H, lrs, gamma, schedule...) come from the
             # CLI and may silently differ from the stored run — surface it.
@@ -391,20 +540,58 @@ def cmd_train(argv) -> int:
         for name, secs in profile_phases(cfg).items():
             print(f"profile {name:18s} {secs * 1e3:9.2f} ms")
 
+    final_meta = None
     t0 = time.perf_counter()
     with contextlib.ExitStack() as stack:
         if args.trace_dir:
             from rcmarl_tpu.utils.profiling import trace as profiler_trace
 
             stack.enter_context(profiler_trace(args.trace_dir))
-        state, sim_data = train(
-            cfg,
-            state=state,
-            verbose=not args.quiet,
-            block_callback=checkpoint_cb,
-            guard={"auto": None, "on": True, "off": False}[args.guard],
-            max_retries=args.max_retries,
-        )
+        if cfg.replicas:
+            from rcmarl_tpu.parallel.gossip import train_gossip
+
+            def gossip_cb(s, b, meta):
+                # the callback fires once per SEGMENT (not per block):
+                # checkpoint when the segment crossed a multiple of
+                # checkpoint_every, so misaligned cadences still save
+                every = args.checkpoint_every
+                seg = meta.get("segment_blocks", 1)
+                if every and (b + 1) // every > (b + 1 - seg) // every:
+                    save_checkpoint(
+                        out / "checkpoint.npz",
+                        s,
+                        cfg,
+                        meta={k: meta[k] for k in
+                              ("replicas", "gossip_round", "excluded")},
+                    )
+
+            state, sim_data = train_gossip(
+                cfg,
+                states=state,
+                verbose=not args.quiet,
+                block_callback=gossip_cb,
+                guard={"auto": None, "on": True, "off": False}[args.guard],
+                start_round=int(ckpt_meta.get("gossip_round", 0)),
+                excluded=ckpt_meta.get("excluded"),
+            )
+            g = sim_data.attrs["gossip"]
+            final_meta = {
+                "replicas": g["replicas"],
+                "gossip_round": g["gossip_round"],
+                # the LIVE mask: a replica quarantined in a trailing
+                # unmixed segment must still sit out its next mix after
+                # a resume
+                "excluded": g["excluded_mask"],
+            }
+        else:
+            state, sim_data = train(
+                cfg,
+                state=state,
+                verbose=not args.quiet,
+                block_callback=checkpoint_cb,
+                guard={"auto": None, "on": True, "off": False}[args.guard],
+                max_retries=args.max_retries,
+            )
     dt = time.perf_counter() - t0
     if "guard" in sim_data.attrs:
         g = sim_data.attrs["guard"]
@@ -412,6 +599,17 @@ def cmd_train(argv) -> int:
             f"guard: {g['retries']} retries, {g['skipped']} skipped "
             f"blocks, {g['nonfinite']} non-finite payload entries, "
             f"{g['deficit']} degree-deficit fallbacks"
+        )
+    if "gossip" in sim_data.attrs:
+        g = sim_data.attrs["gossip"]
+        print(
+            f"gossip: {g['replicas']} replicas ({g['graph']}, "
+            f"{g['mix']} mix, H={g['H']}), {g['rounds']} rounds, "
+            f"{g['rollbacks']} rollbacks, {g['excluded']} exclusions, "
+            f"{g['nonfinite']} non-finite payload entries, "
+            f"{g['deficit']} degree-deficit fallbacks; healthy: "
+            f"{sum(g['replica_healthy'])}/{g['replicas']}"
+            + (f" (byzantine: {g['byzantine']})" if g["byzantine"] else "")
         )
 
     phase = args.phase
@@ -423,8 +621,10 @@ def cmd_train(argv) -> int:
         ]
         phase = max(existing, default=0) + 1
     sim_data.to_pickle(out / f"sim_data{phase}.pkl")
-    save_checkpoint(out / "checkpoint.npz", state, cfg)
-    save_reference_artifacts(out, state, cfg)
+    save_checkpoint(out / "checkpoint.npz", state, cfg, meta=final_meta)
+    if not cfg.replicas:
+        # reference interop expects the solo (unstacked) param layout
+        save_reference_artifacts(out, state, cfg)
     steps = cfg.n_episodes * cfg.max_ep_len
     print(
         f"done: {cfg.n_episodes} episodes in {dt:.1f}s "

@@ -17,7 +17,7 @@ import dataclasses
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
-from rcmarl_tpu.faults import FaultPlan
+from rcmarl_tpu.faults import FaultPlan, ReplicaFaultPlan
 
 
 #: Valid consensus aggregation backends (see ops/aggregation.py):
@@ -35,6 +35,20 @@ CONSENSUS_IMPLS = (
     "pallas_interpret",
     "auto",
 )
+
+
+#: Valid replica gossip graphs (parallel/gossip.py:replica_in_nodes):
+#: 'ring' = directed circulant of in-degree ``gossip_degree`` (incl.
+#: self), 'full' = fully connected, 'random_geometric' = deterministic
+#: unit-square positions from ``gossip_seed``, each replica wired to its
+#: ``gossip_degree - 1`` nearest others.
+GOSSIP_GRAPHS = ("ring", "full", "random_geometric")
+
+#: Valid gossip mixing operators: 'trimmed' = the repo's resilient
+#: clip-and-average (sanitized, H = gossip_H — the hardened default),
+#: 'mean' = plain arithmetic mean (the unhardened comparison arm a
+#: single NaN replica poisons).
+GOSSIP_MIXES = ("trimmed", "mean")
 
 
 class Roles:
@@ -190,6 +204,30 @@ class Config:
     # neighbors in clean runs.
     fault_plan: Optional[FaultPlan] = None
     consensus_sanitize: bool = False
+    # --- gossip-replicated learners (parallel/gossip.py) ---
+    # replicas: number of learner replicas trained as one vmapped
+    # seed-axis program (0, the default, disables the replica layer
+    # entirely — the solo trainer path is untouched). gossip_every:
+    # mix the replicas' parameter trees every K blocks through the
+    # trimmed-mean block (0 = never mix: independent replicas, bitwise
+    # the parallel/seeds.py behavior). gossip_graph/gossip_degree: the
+    # replica communication graph (GOSSIP_GRAPHS). gossip_H: the
+    # replica-level trim parameter — up to gossip_H Byzantine/corrupted
+    # replicas per gossip neighborhood are trimmed away exactly as H
+    # adversarial agents are trimmed in-graph. gossip_mix: 'trimmed'
+    # (hardened default) or 'mean' (unhardened comparison arm).
+    # gossip_seed namespaces the gossip streams (random-geometric
+    # positions, replica fault draws) independently of the training
+    # seeds. replica_fault_plan: the replica-level threat model
+    # (rcmarl_tpu.faults.ReplicaFaultPlan); None = clean gossip links.
+    replicas: int = 0
+    gossip_every: int = 1
+    gossip_graph: str = "ring"
+    gossip_degree: int = 3
+    gossip_H: int = 1
+    gossip_mix: str = "trimmed"
+    gossip_seed: int = 0
+    replica_fault_plan: Optional[ReplicaFaultPlan] = None
     # --- matmul compute precision ---
     # 'float32' (default): true-fp32 dots, the reference-parity path.
     # 'bfloat16': opt-in scale-out mode — matmul inputs in the MXU's
@@ -245,6 +283,59 @@ class Config:
                 f"(got {type(self.fault_plan).__name__}); dicts don't "
                 "hash and would break jit-staticness"
             )
+        if self.replicas < 0:
+            raise ValueError(f"replicas={self.replicas} must be >= 0")
+        if self.gossip_every < 0:
+            raise ValueError(
+                f"gossip_every={self.gossip_every} must be >= 0 "
+                "(0 = never mix)"
+            )
+        if self.gossip_graph not in GOSSIP_GRAPHS:
+            raise ValueError(
+                f"gossip_graph={self.gossip_graph!r}: expected one of "
+                f"{GOSSIP_GRAPHS}"
+            )
+        if self.gossip_mix not in GOSSIP_MIXES:
+            raise ValueError(
+                f"gossip_mix={self.gossip_mix!r}: expected one of "
+                f"{GOSSIP_MIXES}"
+            )
+        if self.replica_fault_plan is not None and not isinstance(
+            self.replica_fault_plan, ReplicaFaultPlan
+        ):
+            raise ValueError(
+                "replica_fault_plan must be a "
+                "rcmarl_tpu.faults.ReplicaFaultPlan "
+                f"(got {type(self.replica_fault_plan).__name__})"
+            )
+        if self.replicas:
+            if self.gossip_graph != "full" and not (
+                1 <= self.gossip_degree <= self.replicas
+            ):
+                raise ValueError(
+                    f"gossip_degree={self.gossip_degree} must be in "
+                    f"[1, replicas={self.replicas}] (degree counts the "
+                    "replica itself, like in_nodes; 'full' ignores it)"
+                )
+            # The trimmed mix needs 2*gossip_H <= n_in - 1 in every
+            # gossip neighborhood, exactly like the in-graph H check.
+            if not 0 <= 2 * self.gossip_H <= self.gossip_n_in - 1:
+                raise ValueError(
+                    f"gossip_H={self.gossip_H} too large for a "
+                    f"{self.gossip_graph!r} replica graph of in-degree "
+                    f"{self.gossip_n_in}: need 2*gossip_H <= degree-1"
+                )
+            if self.replica_fault_plan is not None:
+                bad = [
+                    b
+                    for b in self.replica_fault_plan.byzantine_replicas
+                    if b >= self.replicas
+                ]
+                if bad:
+                    raise ValueError(
+                        f"replica_fault_plan.byzantine_replicas={bad} "
+                        f"out of range for replicas={self.replicas}"
+                    )
 
     # ---- derived (static) quantities ----
 
@@ -257,6 +348,12 @@ class Config:
     @property
     def in_degrees(self) -> Tuple[int, ...]:
         return tuple(len(nbrs) for nbrs in self.in_nodes)
+
+    @property
+    def gossip_n_in(self) -> int:
+        """In-degree (incl. self) of the replica gossip graph — the
+        neighbor-axis size of the replica-level trimmed-mean mix."""
+        return self.replicas if self.gossip_graph == "full" else self.gossip_degree
 
     @property
     def regular_graph(self) -> bool:

@@ -58,7 +58,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass
-from typing import NamedTuple
+from typing import NamedTuple, Tuple
 
 
 @dataclass(frozen=True)
@@ -101,6 +101,146 @@ class FaultPlan:
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
+
+
+#: Valid always-adversarial payload modes of a :class:`ReplicaFaultPlan`.
+BYZANTINE_MODES = ("nan", "sign_flip", "inf")
+
+
+@dataclass(frozen=True)
+class ReplicaFaultPlan:
+    """Replica-level gossip-link fault plan (:mod:`rcmarl_tpu.parallel.gossip`).
+
+    The transport threat model of :class:`FaultPlan`, lifted one level
+    up the stack: the links here are the REPLICA gossip graph's directed
+    edges (receiving learner replica, sending learner replica), and the
+    payloads are whole parameter trees exchanged at a gossip round
+    instead of per-epoch consensus messages. The probabilistic fields
+    have exactly the :class:`FaultPlan` semantics (same composition
+    order, same per-link-per-round draws; ``stale_p`` replays the
+    sender's LAST-ROUND post-mix parameters), and the fault chain is the
+    same code (:func:`_fault_payload`), so the two threat models cannot
+    drift apart.
+
+    On top of the probabilistic links, ``byzantine_replicas`` names
+    ALWAYS-adversarial replicas deterministically: every payload they
+    send (never their own slot-0 row) is replaced according to
+    ``byzantine_mode`` — ``'nan'`` (all-NaN bomb), ``'sign_flip'`` (the
+    negation of their current parameters), or ``'inf'`` (+Inf bomb).
+    This is the infra-level twin of the paper's H scripted adversaries:
+    the trimmed-mean gossip mix must keep the healthy replicas training
+    for any ≤ ``Config.gossip_H`` Byzantine replicas per neighborhood.
+
+    Frozen + hashable (scalars and an int tuple), so it lives inside the
+    jit-static :class:`~rcmarl_tpu.config.Config`
+    (``cfg.replica_fault_plan``); ``None`` keeps the gossip exchange
+    bitwise the fault-free behavior.
+    """
+
+    drop_p: float = 0.0
+    stale_p: float = 0.0
+    corrupt_p: float = 0.0
+    corrupt_scale: float = 1.0
+    flip_p: float = 0.0
+    nan_p: float = 0.0
+    inf_p: float = 0.0
+    byzantine_replicas: Tuple[int, ...] = ()
+    byzantine_mode: str = "nan"
+    seed: int = 0
+
+    def __post_init__(self):
+        for name in ("drop_p", "stale_p", "corrupt_p", "flip_p", "nan_p", "inf_p"):
+            p = getattr(self, name)
+            if not 0.0 <= float(p) <= 1.0:
+                raise ValueError(f"ReplicaFaultPlan.{name}={p} must be in [0, 1]")
+        if not float(self.corrupt_scale) >= 0.0:
+            raise ValueError(
+                f"ReplicaFaultPlan.corrupt_scale={self.corrupt_scale} must be >= 0"
+            )
+        if self.byzantine_mode not in BYZANTINE_MODES:
+            raise ValueError(
+                f"ReplicaFaultPlan.byzantine_mode={self.byzantine_mode!r}: "
+                f"expected one of {BYZANTINE_MODES}"
+            )
+        byz = tuple(self.byzantine_replicas)
+        if any(int(b) < 0 for b in byz):
+            raise ValueError(
+                f"ReplicaFaultPlan.byzantine_replicas={byz} must be "
+                "non-negative replica indices"
+            )
+        if len(set(byz)) != len(byz):
+            raise ValueError(
+                f"ReplicaFaultPlan.byzantine_replicas={byz} carries "
+                "duplicate indices"
+            )
+        # normalize to a sorted tuple so plans that differ only in the
+        # listing order hash (and trace) identically
+        object.__setattr__(
+            self, "byzantine_replicas", tuple(sorted(int(b) for b in byz))
+        )
+
+    @property
+    def active(self) -> bool:
+        """True when any fault can fire: a probabilistic link fault or a
+        standing Byzantine replica."""
+        return bool(self.byzantine_replicas) or any(
+            float(getattr(self, n)) > 0.0
+            for n in ("drop_p", "stale_p", "corrupt_p", "flip_p", "nan_p", "inf_p")
+        )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def apply_replica_faults(key, fresh, stale, plan: ReplicaFaultPlan, in_nodes):
+    """Apply a :class:`ReplicaFaultPlan` to a gathered replica block.
+
+    Args:
+      key: PRNG key for this gossip round's fault draw. Derive it by
+        ``fold_in`` from a dedicated gossip stream so the training
+        replicas' RNG streams are untouched (the same discipline as
+        :func:`apply_link_faults`; ``plan.seed`` is folded in here).
+      fresh: the gathered parameter payloads, ``(R, n_in, P)`` — one
+        raveled parameter vector per directed gossip link, own payload
+        at slot 0.
+      stale: the same gather over the LAST round's post-mix parameters
+        (what a stale link replays); pass ``fresh`` again when
+        ``stale_p == 0``.
+      plan: the replica fault plan; an inactive plan returns ``fresh``
+        unchanged (bitwise).
+      in_nodes: the static replica gossip graph as nested tuples
+        (``rcmarl_tpu.parallel.gossip.replica_in_nodes``) — maps each
+        link back to its SENDER for the Byzantine mask.
+
+    The probabilistic chain is :func:`_fault_payload` — identical
+    composition order and key structure as the agent-level transform.
+    Byzantine senders are applied LAST and deterministically: whatever
+    the link drew, a payload from a ``byzantine_replicas`` member is the
+    adversarial one (slot 0 exempt — a replica never attacks its own
+    mix row).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if not plan.active:
+        return fresh
+    shape = fresh.shape[:2]
+    key = jax.random.fold_in(key, plan.seed)
+    masks = _link_masks(key, plan, shape)
+    v = _fault_payload(key, masks, 0, fresh, stale, plan)
+    if plan.byzantine_replicas:
+        in_arr = np.asarray(in_nodes)
+        byz = np.isin(in_arr, np.asarray(plan.byzantine_replicas))
+        byz[:, 0] = False  # own slot is never a transport hop
+        bmask = jnp.asarray(byz)[:, :, None]
+        if plan.byzantine_mode == "nan":
+            v = jnp.where(bmask, jnp.nan, v)
+        elif plan.byzantine_mode == "sign_flip":
+            v = jnp.where(bmask, -v, v)
+        else:  # 'inf'
+            v = jnp.where(bmask, jnp.inf, v)
+    return v
 
 
 class FaultDiag(NamedTuple):
@@ -347,3 +487,32 @@ def tree_all_finite(tree):
     if not leaves:
         return jnp.asarray(True)
     return jnp.stack(leaves).all()
+
+
+def tree_finite_per_replica(tree):
+    """(R,) numpy bool: :func:`tree_all_finite` factored per LEADING index.
+
+    Every floating leaf must carry a shared leading replica axis; entry
+    ``r`` is True iff replica ``r``'s slice of every leaf is fully
+    finite. This is the per-replica guard predicate of the gossip
+    trainer (:mod:`rcmarl_tpu.parallel.gossip`): one poisoned replica
+    rolls back alone instead of forcing a global rollback of the
+    healthy ones. Computed HOST-SIDE on fetched leaves — the verdict
+    feeds a host control decision anyway, and a plain device-to-host
+    copy stays collective-free however the replica axis is sharded.
+    """
+    import jax
+    import numpy as np
+
+    oks = None
+    for l in jax.tree.leaves(tree):
+        a = np.asarray(l)
+        if not np.issubdtype(a.dtype, np.floating):
+            continue
+        fin = np.isfinite(a.reshape(a.shape[0], -1)).all(axis=1)
+        oks = fin if oks is None else (oks & fin)
+    if oks is None:
+        raise ValueError(
+            "tree_finite_per_replica: no floating leaves to health-check"
+        )
+    return oks

@@ -43,6 +43,27 @@ def tiny_faulted_cfg(netstack, **overrides):
     )
 
 
+def tiny_gossip_cfg(**overrides):
+    """The gossip-replica audit variant: 4 replicas on a full graph
+    (n_in=4, so gossip_H=1 is legal), trimmed mix — the canonical shape
+    the gossip_mix_block cost row and the gossip retrace case compile.
+    A Byzantine NaN replica keeps the sanitize path live in the audited
+    program without touching the probabilistic fault streams."""
+    from rcmarl_tpu.faults import ReplicaFaultPlan
+
+    base = dict(
+        replicas=4,
+        gossip_every=1,
+        gossip_graph="full",
+        gossip_H=1,
+        replica_fault_plan=ReplicaFaultPlan(
+            byzantine_replicas=(3,), byzantine_mode="nan"
+        ),
+    )
+    base.update(overrides)
+    return tiny_cfg(**base)
+
+
 def census_cfg(**overrides):
     """The collective-census variant: 4 cooperative agents on a
     circulant degree-3 ring, so the agent axis tiles evenly over a

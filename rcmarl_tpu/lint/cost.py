@@ -62,6 +62,7 @@ GATED_METRICS = (
 _ANCHORS = {
     "update_block": "rcmarl_tpu/training/update.py",
     "train_block": "rcmarl_tpu/training/trainer.py",
+    "gossip_mix_block": "rcmarl_tpu/parallel/gossip.py",
     "aggregation": "rcmarl_tpu/ops/aggregation.py",
 }
 
@@ -161,10 +162,21 @@ def cost_arms() -> Dict[str, tuple]:
     """The entry-point compile matrix: arm name -> (config, with_diag,
     entry names). Dual covers the donated twins (the donation audit's
     exact programs, shared via the compile cache); guarded is the
-    undonated diag path the fault-plan trainer actually runs."""
-    from rcmarl_tpu.lint.configs import tiny_cfg, tiny_faulted_cfg
+    undonated diag path the fault-plan trainer actually runs; gossip is
+    the replica-level trimmed-mean mix launch
+    (rcmarl_tpu.parallel.gossip) at its canonical 4-replica shape."""
+    from rcmarl_tpu.lint.configs import (
+        tiny_cfg,
+        tiny_faulted_cfg,
+        tiny_gossip_cfg,
+    )
 
     return {
+        "gossip": (
+            tiny_gossip_cfg(),
+            False,
+            ("gossip_mix_block",),
+        ),
         "dual": (
             tiny_cfg(netstack=False),
             False,

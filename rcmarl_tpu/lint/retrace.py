@@ -156,15 +156,19 @@ def _tiny_cfg(netstack, faulted: bool):
 def audit_retrace(steady_blocks: int = 2) -> List[Finding]:
     """``lint --retrace``: prove exactly-once compilation on tiny runs.
 
-    Three cases cover the production paths: a guarded+faulted run on
-    each netstack arm (the undonated retry-capable entries, diag on)
-    and a clean run (the donated steady-state entries). Each trains ONE
-    warmup block outside the watchdog, then ``steady_blocks`` more
-    inside it — any further compile is a ``retrace`` finding naming the
-    entry point and jax's explanation of what changed.
+    Four cases cover the production paths: a guarded+faulted run on
+    each netstack arm (the undonated retry-capable entries, diag on),
+    a clean run (the donated steady-state entries), and a Byzantine
+    gossip-replica run (the gossip_mix_block entry must re-dispatch one
+    executable per round). Each trains ONE warmup block/round outside
+    the watchdog, then ``steady_blocks`` more inside it — any further
+    compile is a ``retrace`` finding naming the entry point and jax's
+    explanation of what changed.
     """
     import jax
 
+    from rcmarl_tpu.lint.configs import tiny_gossip_cfg
+    from rcmarl_tpu.parallel.gossip import train_gossip
     from rcmarl_tpu.training.trainer import train
 
     auditor = RetraceAuditor()
@@ -181,4 +185,13 @@ def audit_retrace(steady_blocks: int = 2) -> List[Finding]:
                 n_episodes=cfg.n_ep_fixed * steady_blocks,
                 state=state,
             )
+    gcfg = tiny_gossip_cfg()
+    states, df = train_gossip(gcfg, n_episodes=gcfg.n_ep_fixed)  # warmup round
+    with auditor.expect_no_compiles(context="byzantine gossip replicas"):
+        train_gossip(
+            gcfg,
+            n_episodes=gcfg.n_ep_fixed * steady_blocks,
+            states=states,
+            start_round=df.attrs["gossip"]["gossip_round"],
+        )
     return auditor.findings

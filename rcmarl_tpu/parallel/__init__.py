@@ -3,6 +3,12 @@ from rcmarl_tpu.parallel.distributed import (  # noqa: F401
     initialize,
     multihost_mesh,
 )
+from rcmarl_tpu.parallel.gossip import (  # noqa: F401
+    gossip_mix_block,
+    replica_in_nodes,
+    replica_seeds,
+    train_gossip,
+)
 from rcmarl_tpu.parallel.matrix import (  # noqa: F401
     matrix_specs,
     reset_matrix_for_phase,

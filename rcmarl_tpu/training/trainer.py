@@ -24,7 +24,7 @@ import numpy as np
 from rcmarl_tpu.agents.updates import AgentParams
 from rcmarl_tpu.config import Config
 from rcmarl_tpu.envs.grid_world import GridWorld, env_reset
-from rcmarl_tpu.faults import tree_all_finite
+from rcmarl_tpu.faults import tree_all_finite, tree_finite_per_replica
 from rcmarl_tpu.training.buffer import (
     ReplayBuffer,
     buffer_init,
@@ -179,9 +179,20 @@ def metrics_to_dataframe(metrics: EpisodeMetrics):
     )
 
 
+def _replica_block_healthy(states: TrainState, metrics):
+    """(R,) bool: the guard predicate factored PER REPLICA over a
+    leading replica axis — params and metric rows of replica ``r`` are
+    fully finite. The gossip trainer
+    (:mod:`rcmarl_tpu.parallel.gossip`) rolls back and excludes exactly
+    the poisoned replicas, so one NaN-bombed replica can never force a
+    global rollback/retry of the healthy ones."""
+    return tree_finite_per_replica((states.params, metrics))
+
+
 def _block_healthy(state: TrainState, metrics) -> bool:
     """Guard predicate: params AND the block's metric rows are fully
-    finite (one fused device reduction, one host bool)."""
+    finite (one fused device reduction, one host bool). The solo-state
+    scalar form of :func:`_replica_block_healthy`."""
     return bool(tree_all_finite((state.params, metrics)))
 
 

@@ -35,7 +35,7 @@ import numpy as np
 
 from rcmarl_tpu.agents.updates import AgentParams
 from rcmarl_tpu.config import Config
-from rcmarl_tpu.faults import FaultPlan
+from rcmarl_tpu.faults import FaultPlan, ReplicaFaultPlan
 from rcmarl_tpu.training.trainer import TrainState, init_train_state
 
 
@@ -65,6 +65,10 @@ def config_from_json(s: str) -> Config:
     # rebuild it (absent in pre-fault checkpoints: default None).
     if d.get("fault_plan") is not None:
         d["fault_plan"] = FaultPlan(**d["fault_plan"])
+    if d.get("replica_fault_plan") is not None:
+        rp = dict(d["replica_fault_plan"])
+        rp["byzantine_replicas"] = tuple(rp.get("byzantine_replicas", ()))
+        d["replica_fault_plan"] = ReplicaFaultPlan(**rp)
     return Config(**d)
 
 
@@ -83,16 +87,30 @@ def _payload_checksum(arrays: dict) -> np.uint32:
     return np.uint32(crc & 0xFFFFFFFF)
 
 
-def save_checkpoint(path, state: TrainState, cfg: Config) -> None:
+def save_checkpoint(
+    path, state: TrainState, cfg: Config, meta: Optional[dict] = None
+) -> None:
     """Write the full TrainState to ``path`` (.npz) with a Config header
     and a payload checksum (verified by :func:`load_checkpoint`). The
     previous checkpoint at ``path``, if any, is rotated to
-    ``<path>.prev`` so resume paths always have a fallback."""
+    ``<path>.prev`` so resume paths always have a fallback.
+
+    ``meta`` (optional, JSON-serializable) rides in a checksummed
+    ``__meta__`` header. The gossip trainer stores the REPLICA WORLD
+    there — ``{"replicas": R, "gossip_round": k, "excluded": [...]}`` —
+    and :func:`load_checkpoint` reads ``"replicas"`` to build the
+    replica-stacked template (every leaf with a leading R axis) instead
+    of the solo one, so ``cmd_train --replicas`` resume goes through the
+    SAME checksummed ``.prev``-rotated format as solo runs."""
     leaves = jax.tree.leaves(state)
     arrays = {f"leaf_{i:03d}": np.asarray(l) for i, l in enumerate(leaves)}
     arrays["__config__"] = np.frombuffer(
         _config_to_json(cfg).encode(), dtype=np.uint8
     )
+    if meta is not None:
+        arrays["__meta__"] = np.frombuffer(
+            json.dumps(meta, sort_keys=True).encode(), dtype=np.uint8
+        )
     arrays["__checksum__"] = np.asarray([_payload_checksum(arrays)])
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
@@ -170,12 +188,38 @@ def load_checkpoint(path, cfg: Optional[Config] = None) -> Tuple[TrainState, Con
             raise CheckpointError(
                 f"checkpoint {path} has no __config__ header"
             )
-        stored_cfg = config_from_json(bytes(arrays["__config__"]).decode())
+        try:
+            stored_cfg = config_from_json(bytes(arrays["__config__"]).decode())
+        except Exception as e:  # undecodable header = a bad FILE
+            raise CheckpointError(
+                f"checkpoint {path} has a corrupted __config__ header "
+                f"({type(e).__name__}: {e}); resume from <path>.prev"
+            ) from None
         if cfg is None:
             cfg = stored_cfg
-        template = jax.eval_shape(
-            lambda k: init_train_state(cfg, k), jax.random.PRNGKey(0)
-        )
+        meta = {}
+        if "__meta__" in arrays:
+            try:
+                meta = json.loads(bytes(arrays["__meta__"]).decode())
+            except Exception as e:
+                raise CheckpointError(
+                    f"checkpoint {path} has a corrupted __meta__ header "
+                    f"({type(e).__name__}: {e}); resume from <path>.prev"
+                ) from None
+        n_rep = int(meta.get("replicas", 0))
+        if n_rep:
+            # replica-stacked world: the template is the vmapped init
+            # (every leaf with a leading R axis), so a solo checkpoint
+            # loaded as a replica one — or vice versa — fails loudly on
+            # shape, never silently mis-assigns leaves
+            template = jax.eval_shape(
+                lambda ks: jax.vmap(lambda k: init_train_state(cfg, k))(ks),
+                jax.random.split(jax.random.PRNGKey(0), n_rep),
+            )
+        else:
+            template = jax.eval_shape(
+                lambda k: init_train_state(cfg, k), jax.random.PRNGKey(0)
+            )
         t_leaves, treedef = jax.tree.flatten(template)
         keys = [f"leaf_{i:03d}" for i in range(len(t_leaves))]
         missing = [k for k in keys if k not in arrays]
@@ -217,6 +261,23 @@ def load_checkpoint_with_fallback(
         except CheckpointError:
             raise primary_err from None
         return state, stored, prev
+
+
+def read_checkpoint_meta(path) -> dict:
+    """The ``__meta__`` header of a checkpoint (``{}`` when absent) —
+    how the gossip resume recovers its round counter and exclusion mask
+    after :func:`load_checkpoint_with_fallback` picked the file."""
+    try:
+        with np.load(path) as z:
+            if "__meta__" not in z.files:
+                return {}
+            return json.loads(bytes(z["__meta__"]).decode())
+    except FileNotFoundError:
+        raise
+    except Exception as e:  # truncated/corrupt: same class as a bad file
+        raise CheckpointError(
+            f"checkpoint {path} meta unreadable ({type(e).__name__}: {e})"
+        ) from None
 
 
 # --------------------------------------------------------------------------

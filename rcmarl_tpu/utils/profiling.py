@@ -46,6 +46,7 @@ def jit_entry_points() -> Dict[str, object]:
     package is a diagnostic/benchmark standalone. Imported lazily so
     ``utils`` stays cheap to import.
     """
+    from rcmarl_tpu.parallel.gossip import gossip_mix_block
     from rcmarl_tpu.training.trainer import train_block, train_block_donated
     from rcmarl_tpu.training.update import update_block, update_block_donated
 
@@ -54,6 +55,7 @@ def jit_entry_points() -> Dict[str, object]:
         "update_block_donated": update_block_donated,
         "train_block": train_block,
         "train_block_donated": train_block_donated,
+        "gossip_mix_block": gossip_mix_block,
     }
 
 
@@ -163,6 +165,28 @@ def entry_point_inputs(cfg):
     return _ENTRY_INPUT_CACHE[cfg]
 
 
+_GOSSIP_INPUT_CACHE: dict = {}
+
+
+def gossip_entry_inputs(cfg):
+    """(replica-stacked params, round, exclude): real tiny inputs for
+    lowering the gossip-mix entry point (``cfg.replicas`` must be set),
+    memoized per config like :func:`entry_point_inputs`."""
+    if cfg not in _GOSSIP_INPUT_CACHE:
+        import jax.numpy as jnp
+
+        from rcmarl_tpu.parallel.gossip import replica_seeds
+        from rcmarl_tpu.parallel.seeds import init_states
+
+        states = init_states(cfg, replica_seeds(cfg))
+        _GOSSIP_INPUT_CACHE[cfg] = (
+            states.params,
+            jnp.zeros((), jnp.int32),
+            jnp.zeros((cfg.replicas,), bool),
+        )
+    return _GOSSIP_INPUT_CACHE[cfg]
+
+
 def lowered_entry_points(
     cfg, with_diag: bool = False, names: Optional[Tuple[str, ...]] = None
 ) -> Dict[str, object]:
@@ -181,11 +205,15 @@ def lowered_entry_points(
     for name in names:
         cache_key = (cfg, with_diag, name)
         if cache_key not in _ENTRY_LOWERED_CACHE:
-            state, batch, fresh, key = entry_point_inputs(cfg)
             fn = entries[name]
+            if name != "gossip_mix_block":
+                state, batch, fresh, key = entry_point_inputs(cfg)
             with _warnings.catch_warnings(record=True) as caught:
                 _warnings.simplefilter("always")
-                if name.startswith("update_block"):
+                if name == "gossip_mix_block":
+                    params, rnd, excl = gossip_entry_inputs(cfg)
+                    lowered = fn.lower(cfg, params, params, rnd, excl)
+                elif name.startswith("update_block"):
                     lowered = fn.lower(
                         cfg,
                         state.params,
@@ -245,8 +273,15 @@ def _traced_entry(cfg, with_diag: bool, name: str):
     cache_key = (cfg, with_diag, name)
     if cache_key not in _ENTRY_JAXPR_CACHE:
         entries = jit_entry_points()
-        state, batch, fresh, key = entry_point_inputs(cfg)
         fn = getattr(entries[name], "__wrapped__", entries[name])
+        if name == "gossip_mix_block":
+            params, rnd, excl = gossip_entry_inputs(cfg)
+            closed, out_shape = jax.make_jaxpr(
+                lambda p, q, r, e: fn(cfg, p, q, r, e), return_shape=True
+            )(params, params, rnd, excl)
+            _ENTRY_JAXPR_CACHE[cache_key] = (closed, out_shape)
+            return _ENTRY_JAXPR_CACHE[cache_key]
+        state, batch, fresh, key = entry_point_inputs(cfg)
         if name.startswith("update_block"):
             closed, out_shape = jax.make_jaxpr(
                 lambda p, b, f, k: fn(cfg, p, b, f, k, with_diag=with_diag),

@@ -83,6 +83,26 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
     --sanitize --summary_dir "$smoke_dir" --quiet
 echo "netstack ragged smoke cell OK"
 
+# Gossip chaos cell: 4 learner replicas, one ALWAYS-NaN-bombing
+# Byzantine replica (replica 3) under trimmed-mean gossip (gossip_H=1)
+# with the per-replica guard — the replica-level resilience wire-up end
+# to end (CLI flags -> Config -> train_gossip -> gossip_mix_block ->
+# replica checkpoint with gossip meta), which the unit tests cover only
+# layer by layer. Must exit rc=0 with every replica's params finite
+# ("healthy: 4/4") and the degradation counters landing in
+# df.attrs['gossip'] (asserted via the printed summary line).
+gossip_log="$smoke_dir/gossip.log"
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
+    --n_agents 3 --in_degree 3 --nrow 3 --ncol 3 \
+    --n_episodes 4 --n_ep_fixed 2 --max_ep_len 4 --n_epochs 2 --H 1 \
+    --replicas 4 --gossip_graph full --gossip_H 1 --gossip_every 1 \
+    --replica_byzantine 3 --replica_byzantine_mode nan \
+    --summary_dir "$smoke_dir" --quiet | tee "$gossip_log"
+grep -q "gossip: 4 replicas" "$gossip_log"
+grep -q "healthy: 4/4" "$gossip_log"
+grep -q "non-finite payload entries" "$gossip_log"
+echo "gossip chaos cell OK"
+
 # graftlint cell: the AST passes over the installed package (zero
 # findings is the contract — rcmarl_tpu.lint) plus the retrace audit
 # (tiny guarded+faulted 2-block trains on both netstack arms + a clean

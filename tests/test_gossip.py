@@ -1,0 +1,317 @@
+"""Gossip-replicated learners (rcmarl_tpu.parallel.gossip).
+
+Four contracts:
+
+1. **Independence pin** — ``ReplicaFaultPlan=None`` + no mixing
+   (``gossip_every=0``) is leaf-for-leaf BITWISE the independent
+   seed-axis run (`parallel/seeds.py:train_parallel` over the same
+   seeds): the replica layer adds nothing until replicas actually talk.
+2. **Projection guarantee, lifted to replicas** — with ≤ ``gossip_H``
+   Byzantine replicas (NaN-bomb or sign-flip), every healthy replica's
+   post-mix parameters stay finite and inside the healthy replicas'
+   elementwise min/max envelope (the trimmed-mean clip bound, exactly
+   the paper's in-graph guarantee one level up).
+3. **Mean-mix poisoning regression** — the plain-mean comparison arm is
+   poisoned by ONE NaN-bombing replica (the motivation for trimming).
+4. **Replica checkpointing** — the replica-stacked TrainState + gossip
+   round counter round-trip through the checksummed ``.prev``-rotated
+   format, and a corrupted primary falls back via
+   ``load_checkpoint_with_fallback``.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from rcmarl_tpu.config import Config
+from rcmarl_tpu.faults import ReplicaFaultPlan
+from rcmarl_tpu.parallel.gossip import (
+    _mix_tree,
+    gossip_mix_block,
+    replica_in_nodes,
+    replica_seeds,
+    train_gossip,
+)
+from rcmarl_tpu.parallel.seeds import init_states, train_parallel
+from rcmarl_tpu.ops.aggregation import ravel_neighbor_tree
+
+#: 3-agent miniature (the lint-config scale) so every jitted program in
+#: this module compiles in O(seconds) — the tier-1 budget is tight.
+TINY3 = dict(
+    n_agents=3,
+    agent_roles=(0, 0, 0),
+    in_nodes=((0, 1, 2), (1, 2, 0), (2, 0, 1)),
+    nrow=3,
+    ncol=3,
+    n_episodes=2,
+    max_ep_len=4,
+    n_ep_fixed=2,
+    n_epochs=1,
+    buffer_size=16,
+    coop_fit_steps=2,
+    adv_fit_epochs=1,
+    adv_fit_batch=4,
+    batch_size=4,
+)
+
+
+def gossip_cfg(**kw):
+    base = dict(replicas=4, gossip_graph="full", gossip_H=1, gossip_every=1)
+    base.update(kw)
+    return Config(**TINY3, **base)
+
+
+_PARAMS_CACHE = {}
+
+
+def init_params(cfg):
+    """Replica-stacked init params, shared across the mix tests: the
+    gossip knobs don't touch the parameter shapes, so one vmapped init
+    (one compile) serves every mix variant."""
+    key = cfg.replicas
+    if key not in _PARAMS_CACHE:
+        _PARAMS_CACHE[key] = init_states(cfg, replica_seeds(cfg)).params
+    return _PARAMS_CACHE[key]
+
+
+def params_flat(params):
+    """(R, P_total) view of the mixable families."""
+    flat, _ = ravel_neighbor_tree(_mix_tree(params))
+    return np.asarray(flat)
+
+
+class TestReplicaGraphs:
+    def test_ring_and_full_shapes(self):
+        cfg = gossip_cfg(replicas=5, gossip_graph="ring", gossip_degree=3)
+        g = replica_in_nodes(cfg)
+        assert g == tuple(
+            tuple((i + k) % 5 for k in range(3)) for i in range(5)
+        )
+        full = replica_in_nodes(gossip_cfg(replicas=4))
+        assert all(len(row) == 4 and row[0] == i for i, row in enumerate(full))
+
+    def test_random_geometric_is_deterministic_and_self_first(self):
+        cfg = gossip_cfg(
+            replicas=6, gossip_graph="random_geometric", gossip_degree=3
+        )
+        g1, g2 = replica_in_nodes(cfg), replica_in_nodes(cfg)
+        assert g1 == g2
+        assert all(len(row) == 3 and row[0] == i for i, row in enumerate(g1))
+        assert all(len(set(row)) == 3 for row in g1)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="gossip_H"):
+            gossip_cfg(replicas=2, gossip_H=1)  # n_in=2: need 2H <= 1
+        with pytest.raises(ValueError, match="gossip_degree"):
+            gossip_cfg(replicas=2, gossip_graph="ring", gossip_degree=3)
+        with pytest.raises(ValueError, match="gossip_graph"):
+            gossip_cfg(gossip_graph="torus")
+        with pytest.raises(ValueError, match="gossip_mix"):
+            gossip_cfg(gossip_mix="median")
+        with pytest.raises(ValueError, match="out of range"):
+            gossip_cfg(
+                replica_fault_plan=ReplicaFaultPlan(byzantine_replicas=(9,))
+            )
+
+
+class TestReplicaFaultPlan:
+    def test_field_validation(self):
+        with pytest.raises(ValueError, match="drop_p"):
+            ReplicaFaultPlan(drop_p=1.5)
+        with pytest.raises(ValueError, match="byzantine_mode"):
+            ReplicaFaultPlan(byzantine_mode="gaussian")
+        with pytest.raises(ValueError, match="duplicate"):
+            ReplicaFaultPlan(byzantine_replicas=(1, 1))
+        with pytest.raises(ValueError, match="non-negative"):
+            ReplicaFaultPlan(byzantine_replicas=(-1,))
+
+    def test_active_and_normalization(self):
+        assert not ReplicaFaultPlan().active
+        assert ReplicaFaultPlan(nan_p=0.1).active
+        plan = ReplicaFaultPlan(byzantine_replicas=(2, 0))
+        assert plan.active
+        assert plan.byzantine_replicas == (0, 2)  # sorted: order-stable hash
+
+
+class TestGossipMix:
+    """Direct mix-block contracts on real (init-time) parameter trees."""
+
+    def mix(self, cfg, params, exclude=None, rnd=0):
+        import jax.numpy as jnp
+
+        R = cfg.replicas
+        excl = jnp.zeros(R, bool) if exclude is None else jnp.asarray(exclude)
+        return gossip_mix_block(
+            cfg, params, params, jnp.asarray(rnd, jnp.int32), excl
+        )
+
+    @pytest.mark.parametrize("mode", ["nan", "sign_flip"])
+    def test_healthy_replicas_stay_in_healthy_envelope(self, mode):
+        cfg = gossip_cfg(
+            replica_fault_plan=ReplicaFaultPlan(
+                byzantine_replicas=(3,), byzantine_mode=mode
+            )
+        )
+        params = init_params(cfg)
+        pre = params_flat(params)
+        mixed, diag = self.mix(cfg, params)
+        post = params_flat(mixed)
+        healthy = [0, 1, 2]
+        lo = pre[healthy].min(axis=0)
+        hi = pre[healthy].max(axis=0)
+        for r in healthy:
+            assert np.isfinite(post[r]).all()
+            tol = 1e-6 * np.maximum(1.0, np.abs(hi))
+            assert (post[r] >= lo - tol).all() and (post[r] <= hi + tol).all()
+        if mode == "nan":
+            assert int(diag.nonfinite) > 0
+
+    def test_mean_mix_poisoned_by_one_nan_replica(self):
+        """The comparison arm's regression: plain-mean gossip is
+        destroyed by a single NaN-bombing replica."""
+        cfg = gossip_cfg(
+            gossip_mix="mean",
+            replica_fault_plan=ReplicaFaultPlan(
+                byzantine_replicas=(3,), byzantine_mode="nan"
+            ),
+        )
+        params = init_params(cfg)
+        mixed, _ = self.mix(cfg, params)
+        post = params_flat(mixed)
+        for r in (0, 1, 2):  # every in-neighbor of the bomber is poisoned
+            assert np.isnan(post[r]).any()
+
+    def test_guard_exclusion_drops_replica_from_mix(self):
+        """An excluded replica's payload must not leak into the mix:
+        with gossip_H=0 (sanitized mean over survivors) the healthy
+        replicas' mix equals the mean over the non-excluded ones."""
+        cfg = gossip_cfg(gossip_H=0)
+        params = init_params(cfg)
+        # replica 3's params are absurd; exclusion must hide them
+        big = jax.tree.map(
+            lambda l: l.at[3].set(1e9)
+            if np.issubdtype(l.dtype, np.floating)
+            else l,
+            params,
+        )
+        mixed, _ = self.mix(cfg, big, exclude=[False, False, False, True])
+        post = params_flat(mixed)
+        pre = params_flat(big)
+        expected = pre[[0, 1, 2]].mean(axis=0)
+        for r in (0, 1, 2):
+            # per-receiver slot order permutes the accumulation, so the
+            # sum association differs from numpy's by last-ulp noise
+            np.testing.assert_allclose(post[r], expected, rtol=1e-5, atol=1e-7)
+        assert (np.abs(post[:3]) < 1e6).all()
+
+    def test_inactive_plan_is_bitwise_no_plan(self):
+        """The fault machinery must be invisible when no fault can fire
+        (the dedicated-stream discipline, replica level)."""
+        cfg_none = gossip_cfg(replica_fault_plan=None)
+        cfg_zero = gossip_cfg(replica_fault_plan=ReplicaFaultPlan())
+        params = init_states(cfg_none, replica_seeds(cfg_none)).params
+        a, _ = self.mix(cfg_none, params)
+        b, _ = self.mix(cfg_zero, params)
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestGossipTrain:
+    def test_no_mix_is_bitwise_independent_seed_axis(self):
+        """ReplicaFaultPlan=None + gossip_every=0 ≡ parallel/seeds.py,
+        leaf for leaf (params AND metrics)."""
+        cfg = gossip_cfg(replicas=2, gossip_every=0, gossip_H=0)
+        states, df = train_gossip(cfg, n_episodes=4)
+        ref_states, ref_m = train_parallel(
+            Config(**TINY3), seeds=list(replica_seeds(cfg)), n_blocks=2
+        )
+        for a, b in zip(
+            jax.tree.leaves(states), jax.tree.leaves(ref_states)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert df.attrs["gossip"]["rounds"] == 0
+
+    @pytest.mark.slow
+    def test_byzantine_chaos_keeps_healthy_replicas_training(self):
+        """R=4, H=1, one NaN-bombing replica, trimmed mix under guard:
+        rc-equivalent of the CI chaos cell — every replica's params stay
+        finite, blocks advance, counters land in df.attrs['gossip']."""
+        cfg = gossip_cfg(
+            replica_fault_plan=ReplicaFaultPlan(
+                byzantine_replicas=(3,), byzantine_mode="nan"
+            )
+        )
+        states, df = train_gossip(cfg, n_episodes=4)
+        g = df.attrs["gossip"]
+        assert g["rounds"] == 2 and g["byzantine"] == [3]
+        assert all(g["replica_healthy"])
+        assert g["nonfinite"] > 0
+        assert np.all(np.asarray(states.block) == 2)
+
+    @pytest.mark.slow
+    def test_mean_mix_training_run_is_poisoned(self):
+        """End-to-end regression: the same chaos under the mean arm
+        (guard off) leaves the healthy replicas non-finite."""
+        cfg = gossip_cfg(
+            gossip_mix="mean",
+            replica_fault_plan=ReplicaFaultPlan(
+                byzantine_replicas=(3,), byzantine_mode="nan"
+            ),
+        )
+        _, df = train_gossip(cfg, n_episodes=4, guard=False)
+        assert not any(df.attrs["gossip"]["replica_healthy"][:3])
+
+
+class TestReplicaCheckpoint:
+    def test_replica_world_roundtrip_and_fallback(self, tmp_path):
+        from rcmarl_tpu.utils.checkpoint import (
+            load_checkpoint,
+            load_checkpoint_with_fallback,
+            read_checkpoint_meta,
+            save_checkpoint,
+        )
+
+        cfg = gossip_cfg(
+            replicas=2,
+            gossip_H=0,
+            replica_fault_plan=ReplicaFaultPlan(
+                byzantine_replicas=(1,), stale_p=0.1
+            ),
+        )
+        states = init_states(cfg, replica_seeds(cfg))
+        meta = {"replicas": 2, "gossip_round": 3, "excluded": [0, 1]}
+        path = tmp_path / "ckpt.npz"
+        save_checkpoint(path, states, cfg, meta=meta)
+        loaded, stored_cfg = load_checkpoint(path, cfg)
+        assert stored_cfg == cfg  # incl. the nested ReplicaFaultPlan JSON
+        assert read_checkpoint_meta(path) == meta
+        for a, b in zip(jax.tree.leaves(loaded), jax.tree.leaves(states)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        # rotate a second save, corrupt the primary, resume via .prev
+        meta2 = dict(meta, gossip_round=4)
+        save_checkpoint(path, states, cfg, meta=meta2)
+        path.write_bytes(path.read_bytes()[: path.stat().st_size // 2])
+        state_fb, _, loaded_path = load_checkpoint_with_fallback(path, cfg)
+        assert str(loaded_path).endswith(".prev")
+        assert read_checkpoint_meta(loaded_path)["gossip_round"] == 3
+        for a, b in zip(jax.tree.leaves(state_fb), jax.tree.leaves(states)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_solo_checkpoint_still_loads_without_meta(self, tmp_path):
+        from rcmarl_tpu.training.trainer import init_train_state
+        from rcmarl_tpu.utils.checkpoint import (
+            load_checkpoint,
+            read_checkpoint_meta,
+            save_checkpoint,
+        )
+
+        cfg = Config(**TINY3)
+        state = init_train_state(cfg, jax.random.PRNGKey(0))
+        path = tmp_path / "solo.npz"
+        save_checkpoint(path, state, cfg)
+        assert read_checkpoint_meta(path) == {}
+        loaded, _ = load_checkpoint(path, cfg)
+        np.testing.assert_array_equal(
+            np.asarray(loaded.block), np.asarray(state.block)
+        )

@@ -1,0 +1,190 @@
+"""Property-based gossip-mix contracts (hypothesis).
+
+Randomized twin of tests/test_gossip.py's deterministic matrix, run
+EAGERLY through the unjitted mix body (no per-example compile churn)
+over a fixed tiny parameter template whose values hypothesis replaces:
+
+- **Envelope**: with any ≤ gossip_H Byzantine replicas (any mode), every
+  healthy replica's post-mix parameters are finite and inside the
+  healthy replicas' elementwise min/max envelope — the paper's
+  trimmed-mean projection guarantee, lifted to the replica level. The
+  guarantee survives NaN byzantine counts that trigger the
+  degree-deficit fallback (the receiver keeps its own value, which is
+  itself inside the envelope).
+- **Finiteness**: under ANY replica fault plan (arbitrary probabilistic
+  drop/stale/corrupt/flip/NaN/Inf rates plus Byzantine members), the
+  sanitized trimmed mix of finite own-parameters stays finite for every
+  replica — non-finite payloads can only be excluded, never averaged in.
+
+Guarded like the other property modules: a missing hypothesis (the
+`test` extra) is a skip, never a collection error.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+from hypothesis.extra.numpy import arrays
+
+from rcmarl_tpu.config import Config
+from rcmarl_tpu.faults import BYZANTINE_MODES, ReplicaFaultPlan
+from rcmarl_tpu.ops.aggregation import ravel_neighbor_tree
+from rcmarl_tpu.parallel.gossip import (
+    _gossip_mix_block,
+    _mix_tree,
+    replica_in_nodes,
+    replica_seeds,
+)
+from rcmarl_tpu.parallel.seeds import init_states
+
+R = 5
+
+#: head-only (hidden=()) nets keep P_total tiny so each hypothesis
+#: example moves a (5, P) block, not a model
+_BASE = dict(
+    n_agents=3,
+    agent_roles=(0, 0, 0),
+    in_nodes=((0, 1, 2), (1, 2, 0), (2, 0, 1)),
+    nrow=3,
+    ncol=3,
+    hidden=(),
+    replicas=R,
+    gossip_graph="full",
+    gossip_every=1,
+)
+
+
+def _cfg(**kw):
+    return Config(**{**_BASE, **kw})
+
+
+_TEMPLATE = init_states(_cfg(gossip_H=1), replica_seeds(_cfg(gossip_H=1)))
+_FLAT0, _UNRAVEL = ravel_neighbor_tree(_mix_tree(_TEMPLATE.params))
+P = int(_FLAT0.shape[1])
+
+
+def params_from(vals: np.ndarray):
+    """Replica-stacked AgentParams whose mixable families hold ``vals``
+    ((R, P) rows) — the template supplies structure and Adam state."""
+    trees = jax.vmap(_UNRAVEL)(jnp.asarray(vals))
+    actor, critic, tr, critic_local = trees
+    return _TEMPLATE.params._replace(
+        actor=actor, critic=critic, tr=tr, critic_local=critic_local
+    )
+
+
+def mix_flat(cfg, vals: np.ndarray, rnd: int = 0) -> np.ndarray:
+    """(R, P) post-mix values via the UNJITTED mix body (eager)."""
+    mixed, _ = _gossip_mix_block(
+        cfg,
+        params_from(vals),
+        params_from(vals),
+        jnp.asarray(rnd, jnp.int32),
+        jnp.zeros(R, bool),
+    )
+    flat, _ = ravel_neighbor_tree(_mix_tree(mixed))
+    return np.asarray(flat)
+
+
+finite_vals = arrays(
+    np.float32,
+    (R, P),
+    elements=st.floats(-1e4, 1e4, allow_nan=False, width=32),
+)
+
+
+@st.composite
+def byzantine_case(draw):
+    H = draw(st.integers(1, 2))  # full R=5 graph: 2H <= 4
+    n_byz = draw(st.integers(1, H))
+    byz = draw(
+        st.lists(
+            st.integers(0, R - 1), min_size=n_byz, max_size=n_byz, unique=True
+        )
+    )
+    mode = draw(st.sampled_from(BYZANTINE_MODES))
+    return H, tuple(sorted(byz)), mode
+
+
+@given(vals=finite_vals, case=byzantine_case())
+@settings(max_examples=25, deadline=None)
+def test_healthy_replicas_stay_in_healthy_envelope(vals, case):
+    H, byz, mode = case
+    cfg = _cfg(
+        gossip_H=H,
+        replica_fault_plan=ReplicaFaultPlan(
+            byzantine_replicas=byz, byzantine_mode=mode
+        ),
+    )
+    post = mix_flat(cfg, vals)
+    healthy = [r for r in range(R) if r not in byz]
+    lo = vals[healthy].min(axis=0)
+    hi = vals[healthy].max(axis=0)
+    tol = 1e-4 * np.maximum(1.0, np.abs(hi) + np.abs(lo))
+    for r in healthy:
+        assert np.isfinite(post[r]).all()
+        assert (post[r] >= lo - tol).all()
+        assert (post[r] <= hi + tol).all()
+
+
+@st.composite
+def arbitrary_plan(draw):
+    p = lambda: draw(st.floats(0.0, 1.0))
+    n_byz = draw(st.integers(0, R - 1))
+    byz = draw(
+        st.lists(
+            st.integers(0, R - 1), min_size=n_byz, max_size=n_byz, unique=True
+        )
+    )
+    return ReplicaFaultPlan(
+        drop_p=p(),
+        stale_p=p(),
+        corrupt_p=p(),
+        corrupt_scale=draw(st.floats(0.0, 10.0)),
+        flip_p=p(),
+        nan_p=p(),
+        inf_p=p(),
+        byzantine_replicas=tuple(sorted(byz)),
+        byzantine_mode=draw(st.sampled_from(BYZANTINE_MODES)),
+        seed=draw(st.integers(0, 7)),
+    )
+
+
+@given(vals=finite_vals, plan=arbitrary_plan(), rnd=st.integers(0, 3))
+@settings(max_examples=25, deadline=None)
+def test_trimmed_mix_never_goes_nonfinite(vals, plan, rnd):
+    """Whatever the links deliver, sanitized trimming of finite own
+    parameters yields finite mixes for EVERY replica (non-finite
+    payloads become exclusions; the deficit fallback keeps own)."""
+    post = mix_flat(_cfg(gossip_H=2, replica_fault_plan=plan), vals, rnd=rnd)
+    assert np.isfinite(post).all()
+
+
+def test_random_geometric_graph_feeds_the_same_guarantee():
+    """One deterministic spot-check off the full graph: the envelope
+    holds on a random-geometric topology when the Byzantine count per
+    neighborhood cannot exceed gossip_H (here: 1 bomber, H=1)."""
+    cfg = _cfg(
+        gossip_graph="random_geometric",
+        gossip_degree=3,
+        gossip_H=1,
+        replica_fault_plan=ReplicaFaultPlan(
+            byzantine_replicas=(4,), byzantine_mode="nan"
+        ),
+    )
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=(R, P)).astype(np.float32)
+    post = mix_flat(cfg, vals)
+    healthy = [0, 1, 2, 3]
+    lo, hi = vals[healthy].min(axis=0), vals[healthy].max(axis=0)
+    in_nodes = replica_in_nodes(cfg)
+    assert all(sum(j == 4 for j in row[1:]) <= 1 for row in in_nodes)
+    tol = 1e-5
+    for r in healthy:
+        assert np.isfinite(post[r]).all()
+        assert (post[r] >= lo - tol).all() and (post[r] <= hi + tol).all()
