@@ -53,7 +53,12 @@ BACKOFF_S = 30.0
 
 
 def _measure(
-    n_seeds: int, n_blocks: int, reps: int, netstack: str = "auto"
+    n_seeds: int,
+    n_blocks: int,
+    reps: int,
+    netstack: str = "auto",
+    fitstack: str = "auto",
+    compute_dtype: str = "float32",
 ) -> None:
     """Child: run ONE measurement on whatever backend JAX_PLATFORMS says.
 
@@ -86,9 +91,16 @@ def _measure(
     # forced with `python bench.py --netstack on|off` for an A/B
     # headline; the per-config arms live in
     # `python -m rcmarl_tpu bench --netstack on off`.
+    # fitstack (round 10: the cross-flavor fused fit scan, pinned bitwise
+    # to the per-flavor arms; default 'auto' = fused on TPU, per-flavor
+    # on CPU) and compute_dtype (round 10: bf16 matmul inputs + f32
+    # accumulation, QUALITY.md-gated) are A/B-able the same way:
+    # `python bench.py --fitstack on|off --compute_dtype bfloat16`.
     cfg = Config(
         slow_lr=0.002, fast_lr=0.01, seed=100,
         netstack={"on": True, "off": False}.get(netstack, "auto"),
+        fitstack={"on": True, "off": False}.get(fitstack, "auto"),
+        compute_dtype=compute_dtype,
     )
 
     def fetch(states, metrics):
@@ -136,6 +148,8 @@ def _measure(
                     "reps": reps,
                     "block_steps": cfg.block_steps,
                     "netstack": cfg.netstack,
+                    "fitstack": cfg.fitstack,
+                    "compute_dtype": cfg.compute_dtype,
                 },
             }
         )
@@ -152,14 +166,18 @@ def _probe() -> None:
     print(json.dumps({"probe": "ok", "platform": jax.devices()[0].platform}))
 
 
-def _netstack_arg(argv) -> str:
-    """The validated value of a --netstack flag in ``argv`` (a missing or
-    out-of-set value is a hard error, not a silent 'auto' fallback — a
+def _arm_arg(argv, flag: str, choices) -> str:
+    """The validated value of an A/B arm flag in ``argv`` (a missing or
+    out-of-set value is a hard error, not a silent default fallback — a
     TPU A/B round must not quietly measure the wrong arm)."""
-    i = argv.index("--netstack")
-    if i + 1 >= len(argv) or argv[i + 1] not in ("on", "off", "auto"):
-        sys.exit("--netstack requires one of: on, off, auto")
+    i = argv.index(flag)
+    if i + 1 >= len(argv) or argv[i + 1] not in choices:
+        sys.exit(f"{flag} requires one of: " + ", ".join(choices))
     return argv[i + 1]
+
+
+def _netstack_arg(argv) -> str:
+    return _arm_arg(argv, "--netstack", ("on", "off", "auto"))
 
 
 def _run_child(argv, env_overrides, timeout_s):
@@ -200,6 +218,17 @@ def main() -> int:
         if "--netstack" in sys.argv
         else []
     )
+    # round-10 A/B arms ride the same pass-through
+    if "--fitstack" in sys.argv:
+        netstack_argv += [
+            "--fitstack",
+            _arm_arg(sys.argv, "--fitstack", ("on", "off", "auto")),
+        ]
+    if "--compute_dtype" in sys.argv:
+        netstack_argv += [
+            "--compute_dtype",
+            _arm_arg(sys.argv, "--compute_dtype", ("float32", "bfloat16")),
+        ]
     attempts = []
     # 1-3: probe the TPU, with bounded retries + backoff on any failure
     # (covers both the fast RuntimeError and the silent-hang mode).
@@ -295,6 +324,16 @@ if __name__ == "__main__":
             n_blocks=int(args[args.index("--blocks") + 1]),
             reps=int(args[args.index("--reps") + 1]),
             netstack=_netstack_arg(args) if "--netstack" in args else "auto",
+            fitstack=(
+                _arm_arg(args, "--fitstack", ("on", "off", "auto"))
+                if "--fitstack" in args
+                else "auto"
+            ),
+            compute_dtype=(
+                _arm_arg(args, "--compute_dtype", ("float32", "bfloat16"))
+                if "--compute_dtype" in args
+                else "float32"
+            ),
         )
     else:
         sys.exit(main())

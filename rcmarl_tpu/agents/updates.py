@@ -45,6 +45,8 @@ from rcmarl_tpu.models.mlp import (
     flatten_input,
     head_forward,
     mlp_forward,
+    netstack_stack,
+    netstack_stack_rows,
     pad_features,
     pad_rows,
     trunk_apply,
@@ -55,9 +57,11 @@ from rcmarl_tpu.ops.aggregation import (
     resilient_aggregate_tree,
 )
 from rcmarl_tpu.ops.fit import (
+    FitSchedule,
     fit_minibatch,
     fit_mse_full_batch,
     fit_mse_minibatch,
+    fused_fit_scan,
 )
 from rcmarl_tpu.ops.losses import weighted_mse, weighted_sparse_ce
 from rcmarl_tpu.ops.optim import AdamState, adam_update
@@ -276,6 +280,142 @@ def adv_pair_fit(keys2, stack2, x2, targets2, mask, cfg: Config):
     per_agent = jax.vmap(fit_one, in_axes=(0, 0, None, 0))
     return jax.vmap(per_agent, in_axes=(0, 0, 0, 0))(
         keys2, stack2, x2, targets2
+    )
+
+
+# --------------------------------------------------------------------------
+# Fitstack: ALL fit flavors of one schedule shape as ONE stacked scan
+# --------------------------------------------------------------------------
+#
+# ``Config.fitstack`` goes one rung above the pair stacking: instead of
+# one (2, N) scan PER FLAVOR, every flavor sharing a schedule shape —
+# full-batch (cooperative critic+TR) vs minibatch (greedy critic+TR,
+# malicious compromised critic+TR, malicious private critic) — stacks
+# into one (flavor·net, N) row block and launches through the ONE
+# unified scan body of :func:`rcmarl_tpu.ops.fit.fused_fit_scan`. The
+# two shapes cannot share a launch without ruinous width padding (a
+# 32-row minibatch padded to the buffer capacity), so a mixed cast pays
+# exactly two fused launches; a homogeneous cast pays ONE.
+
+
+def coop_fit_schedule(cfg: Config, capacity: int) -> FitSchedule:
+    """The cooperative full-batch flavor's schedule shape: one
+    identity-plan batch covering the buffer, ``coop_fit_steps`` times —
+    bitwise :func:`fit_mse_full_batch` through the minibatch body."""
+    return FitSchedule(
+        epochs=cfg.coop_fit_steps, batch_size=capacity, shuffle=False
+    )
+
+
+def adv_fit_schedule(cfg: Config) -> FitSchedule:
+    """The adversary minibatch flavors' shared schedule shape."""
+    return FitSchedule(
+        epochs=cfg.adv_fit_epochs, batch_size=cfg.adv_fit_batch, shuffle=True
+    )
+
+
+def fused_fit_rows(keys_rows, params_rows, x_rows, targets_rows, mask,
+                   schedule: FitSchedule, cfg: Config):
+    """One fused (row, agent)-vmapped fit launch over stacked
+    (flavor·net) rows — the fitstack twin of :func:`coop_pair_fit` /
+    :func:`adv_pair_fit`, sharing their forward and learning rate.
+    Returns (fitted rows, (R, N) losses)."""
+    return fused_fit_scan(
+        keys_rows, params_rows, _fwd(cfg), x_rows, targets_rows, mask,
+        schedule, cfg.fast_lr,
+    )
+
+
+def coop_fused_fit(critic, tr, x2, targets2, mask, cfg: Config):
+    """The full-batch group (cooperative critic + TR) as ONE fused
+    launch. Keys are zeros: the identity-plan schedule never reads
+    them. Returns (stacked (2, N, ...) fitted rows, (2, N) losses)."""
+    N = jax.tree.leaves(critic)[0].shape[0]
+    return fused_fit_rows(
+        jnp.zeros((2, N, 2), jnp.uint32),
+        netstack_stack(critic, tr),
+        x2,
+        targets2,
+        mask,
+        coop_fit_schedule(cfg, x2.shape[1]),
+        cfg,
+    )
+
+
+def adv_fused_row_block(
+    cfg: Config,
+    critic,
+    tr,
+    critic_local,
+    x2,
+    ns,
+    r_agents,
+    r_coop,
+    keys5,
+    v_ns=None,
+    has_greedy: bool = True,
+    has_mal: bool = True,
+):
+    """Assemble the minibatch-group row block: every adversary fit
+    flavor present as stacked (flavor·net) rows with the dual arm's
+    exact per-flavor key streams.
+
+    THE single source of truth for the fused adversary rows — shared by
+    the epoch (``training/update.py:_phase1_fits_fused``) and the
+    consensus-micro profiler, so the arm the profiler measures can
+    never silently drift from the arm the epoch runs.
+
+    Args:
+      keys5: the ``(5, ...)`` key block ``split(ekey, 5)`` — rows
+        ``(k_gc, k_gt, k_ml, k_mc, k_mt)``, the dual arm's exact split.
+      v_ns: optional precomputed pre-fit critic bootstrap ``V(ns)``
+        (the netstack sharing recipe); None recomputes it inside the
+        pair targets, bitwise either way.
+
+    Returns ``(keys_rows, params_rows, x_rows, targets_rows, in_dims)``
+    ready for :func:`fused_fit_rows`, or None when neither adversary
+    flavor is live.
+    """
+    k_gc, k_gt, k_ml, k_mc, k_mt = keys5
+    N = jax.tree.leaves(critic)[0].shape[0]
+    in2 = (cfg.obs_dim, cfg.sa_dim)
+
+    def pair_targets(r):
+        return pair_bootstrap_targets(cfg, critic, ns, r, v=v_ns)
+
+    rows, keys, xs, tgts, in_dims = [], [], [], [], []
+    if has_greedy:
+        tg = pair_targets(r_agents)
+        rows += [critic, tr]
+        keys += [jax.random.split(k_gc, N), jax.random.split(k_gt, N)]
+        xs += [x2[0], x2[1]]
+        tgts += [tg[0], tg[1]]
+        in_dims += list(in2)
+    if has_mal:
+        neg = jnp.broadcast_to(-r_coop[None], (N, *r_coop.shape))
+        tgm = pair_targets(neg)
+        # private critic on own reward (adversarial_CAC_agents.py:137-152),
+        # bootstrapped with its OWN pre-fit weights
+        v_loc = jax.vmap(
+            lambda p: mlp_forward(p, ns, dtype=cfg.dot_dtype)
+        )(critic_local)
+        rows += [critic, tr, critic_local]
+        keys += [
+            jax.random.split(k_mc, N),
+            jax.random.split(k_mt, N),
+            jax.random.split(k_ml, N),
+        ]
+        xs += [x2[0], x2[1], x2[0]]
+        tgts += [tgm[0], tgm[1], r_agents + cfg.gamma * v_loc]
+        in_dims += [in2[0], in2[1], in2[0]]
+    if not rows:
+        return None
+    return (
+        jnp.stack(keys),
+        netstack_stack_rows(rows),
+        jnp.stack(xs),
+        jnp.stack(tgts),
+        tuple(in_dims),
     )
 
 
@@ -577,6 +717,10 @@ def adv_actor_update(
         batch_size=cfg.batch_size,
         opt_state=opt,
         opt_update=lambda p, g, s_: adam_update(p, g, s_, cfg.slow_lr),
+        # the on-policy window is always full: the shuffle can skip the
+        # valid-first penalty work (bitwise-identical plan — pinned in
+        # tests/test_fitstack_properties.py)
+        assume_valid=True,
     )
 
 

@@ -449,6 +449,61 @@ def gossip_evidence_section(artifact_path) -> list:
     return lines
 
 
+def bf16_parity_section(artifact_path) -> list:
+    """QUALITY.md lines for the bf16 compute-arm parity cell, rendered
+    from the committed ``scripts/bf16_parity.py`` artifact
+    (``simulation_results/bf16_parity.json``) — same byte-stable
+    render-from-evidence contract as the gossip section. Empty when the
+    artifact does not exist."""
+    p = Path(artifact_path)
+    if not p.exists():
+        return []
+    d = json.loads(p.read_text())
+    cfg = d["config"]
+    ep32 = d["ep_to_threshold_f32"]
+    ep16 = d["ep_to_threshold_bf16"]
+    verdict = (
+        "**within the f32 quality band**"
+        if d["bf16_within_band"]
+        else "**OUTSIDE the f32 quality band — do not enable bf16 for "
+        "this workload without re-measuring**"
+    )
+    return [
+        "",
+        "## Mixed precision (bfloat16) parity",
+        "",
+        "`Config(compute_dtype='bfloat16')` narrows ONLY the matmul "
+        "inputs (f32 accumulation; params/optimizer state stay f32 — "
+        "README \"Mixed precision\"), so its gate is behavioral: trained "
+        "on the same seed and schedule, the bf16 returns curve must land "
+        "inside the f32 reference arm's own converged quality band "
+        f"(final-{cfg['window']}-episode mean, relaxed by "
+        f"{cfg['tol']:.0%} of its magnitude — the PARITY.md tolerance). "
+        f"The committed cell (`{p.name}`, `scripts/bf16_parity.py`: "
+        f"{cfg['scenario']}, {cfg['episodes']} episodes, seed "
+        f"{cfg['seed']}, measured on {d['platform']}):",
+        "",
+        "| arm | final return | episodes to f32 threshold "
+        f"({d['threshold']}) | verdict |",
+        "|---|---|---|---|",
+        f"| float32 (reference) | {d['f32_final']} | "
+        f"{ep32 if ep32 is not None else 'not reached'} | — |",
+        f"| bfloat16 | {d['bf16_final']} | "
+        f"{ep16 if ep16 is not None else 'not reached'} | {verdict} |",
+        "",
+        "Reading: the two arms' trajectories diverge sample-by-sample "
+        "(a ~1e-2-relative matmul rounding flips individual softmax "
+        "action draws, and the rollout is chaotic), so pointwise curve "
+        "deltas are meaningless — the gate compares CONVERGED quality "
+        "and time-to-quality, exactly how QUALITY.md reads every other "
+        f"cell. Max smoothed-tail deviation {d['tail_max_abs_dev']} "
+        "return units. The f32 arm stays the bitwise-pinned parity "
+        "path; bf16 is the opt-in throughput arm whose win only "
+        "materializes on MXU-bearing hardware (PERF.md \"fitstack / "
+        "bf16\" — on CPU the casts are pure overhead).",
+    ]
+
+
 def write_quality_md(
     table: pd.DataFrame,
     out_path,
@@ -641,6 +696,10 @@ def write_quality_md(
         Path(out_path).parent / "simulation_results/gossip_byzantine.json"
     )
     lines += gossip_evidence_section(gossip_artifact)
+    bf16_artifact = (
+        Path(out_path).parent / "simulation_results/bf16_parity.json"
+    )
+    lines += bf16_parity_section(bf16_artifact)
     lines += [
         "",
         "## Related artifacts",
@@ -658,6 +717,12 @@ def write_quality_md(
             "- `simulation_results/gossip_byzantine.json` — the "
             "Byzantine gossip-replica experiment behind the replica-"
             "level degradation section (`scripts/gossip_experiment.py`)"
+        )
+    if bf16_artifact.exists():
+        lines.append(
+            "- `simulation_results/bf16_parity.json` — the measured "
+            "bf16-vs-f32 returns-curve agreement cell behind the mixed-"
+            "precision section (`scripts/bf16_parity.py`)"
         )
     # like cmd_parity's related-artifacts list: only link the robustness
     # companion when it exists, and never from itself

@@ -140,6 +140,19 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         "(PERF.md 'netstack'). Outputs are pinned equivalent either way",
     )
     p.add_argument(
+        "--fitstack",
+        type=str,
+        default="auto",
+        choices=["auto", "on", "off"],
+        help="cross-flavor fused fit scan: on = every phase-I fit flavor "
+        "sharing a schedule shape (coop full-batch pair vs the "
+        "greedy/malicious minibatch flavors) runs as ONE stacked "
+        "(flavor·net, agent) scan; off = the PR-4 per-flavor arms; auto "
+        "(default) = the measured backend policy — fused on TPU, "
+        "per-flavor elsewhere (PERF.md 'fitstack / bf16'). Outputs are "
+        "pinned bitwise either way",
+    )
+    p.add_argument(
         "--compute_dtype",
         type=str,
         default="float32",
@@ -320,7 +333,8 @@ def replica_fault_plan_from_args(args):
 
 
 def _netstack_value(arm: str):
-    """CLI arm string -> Config.netstack value."""
+    """CLI arm string -> Config.netstack / Config.fitstack value (the
+    two gates share the on/off/'auto' vocabulary)."""
     return {"on": True, "off": False}.get(arm, "auto")
 
 
@@ -375,6 +389,7 @@ def config_from_args(args) -> Config:
         consensus_impl=args.consensus_impl,
         consensus_layout=getattr(args, "consensus_layout", "flat"),
         netstack=_netstack_value(getattr(args, "netstack", "auto")),
+        fitstack=_netstack_value(getattr(args, "fitstack", "auto")),
         compute_dtype=args.compute_dtype,
         fault_plan=fault_plan_from_args(args),
         consensus_sanitize=args.sanitize,
@@ -854,6 +869,25 @@ def cmd_sweep(argv) -> int:
         "the measured backend policy — stacked on TPU, dual elsewhere)",
     )
     p.add_argument(
+        "--fitstack",
+        type=str,
+        default="auto",
+        choices=["auto", "on", "off"],
+        help="cross-flavor fused fit scan (on: every same-scheduled "
+        "phase-I flavor in one stacked scan; off: the PR-4 per-flavor "
+        "arms; auto, the default: fused on TPU, per-flavor elsewhere)",
+    )
+    p.add_argument(
+        "--compute_dtype",
+        type=str,
+        default="float32",
+        choices=["float32", "bfloat16"],
+        help="matmul compute precision for every cell: float32 = "
+        "reference-parity, bfloat16 = MXU-native inputs with f32 "
+        "accumulation (scale-out; gate quality against the f32 arm — "
+        "QUALITY.md 'Mixed precision')",
+    )
+    p.add_argument(
         "--skip_existing",
         action="store_true",
         help="skip cells whose sim_data files are all already on disk, so "
@@ -897,6 +931,8 @@ def cmd_sweep(argv) -> int:
             eps_explore=args.eps,
             consensus_impl=args.consensus_impl,
             netstack=_netstack_value(args.netstack),
+            fitstack=_netstack_value(args.fitstack),
+            compute_dtype=args.compute_dtype,
             fault_plan=fault_plan_from_args(args),
             consensus_sanitize=args.sanitize,
         )
@@ -1014,6 +1050,17 @@ BENCH_CONFIGS = {
     # one axis beyond BASELINE.json's matrix: does the batched consensus
     # sort keep scaling past N=64? (16x16 grid, deg-8 ring, H=2)
     "n256_ring": dict(n_agents=256, hidden=(20, 20), degree=8, H=2),
+    # a MIXED cast (12 coop + 2 greedy + 2 malicious): the cell where
+    # phase I runs every fit flavor, so the fitstack fused-scan A/B and
+    # the per-flavor fit_coop/fit_adv micro split have adversary work
+    # to attribute (all-coop cells never launch the minibatch flavors)
+    "n16_mixed": dict(
+        n_agents=16,
+        hidden=(20, 20),
+        degree=None,
+        H=1,
+        roles=("Cooperative",) * 12 + ("Greedy",) * 2 + ("Malicious",) * 2,
+    ),
 }
 
 
@@ -1032,6 +1079,7 @@ def _bench_config(
     compute_dtype: str = "float32",
     layout: str = "flat",
     netstack: "bool | str" = "auto",
+    fitstack: "bool | str" = "auto",
 ) -> Config:
     spec = BENCH_CONFIGS[name]
     n = spec["n_agents"]
@@ -1040,9 +1088,12 @@ def _bench_config(
         in_nodes = full_in_nodes(n)
     else:
         in_nodes = circulant_in_nodes(n, spec["degree"] + 1)
+    roles = tuple(
+        Roles.BY_NAME[l] for l in spec.get("roles", ("Cooperative",) * n)
+    )
     return Config(
         n_agents=n,
-        agent_roles=(Roles.COOPERATIVE,) * n,
+        agent_roles=roles,
         in_nodes=in_nodes,
         nrow=side,
         ncol=side,
@@ -1054,6 +1105,7 @@ def _bench_config(
         consensus_impl=impl,
         consensus_layout=layout,
         netstack=netstack,
+        fitstack=fitstack,
         compute_dtype=compute_dtype,
     )
 
@@ -1072,6 +1124,17 @@ def _netstack_arm_flag(p: argparse.ArgumentParser) -> None:
         "A per_leaf layout row only exists on the dual arm (netstack "
         "always uses the combined flat block), so stacked+per_leaf "
         "combinations are skipped.",
+    )
+    p.add_argument(
+        "--fitstack",
+        nargs="+",
+        default=["auto"],
+        choices=["auto", "on", "off"],
+        help="cross-flavor fused fit scan arm(s) to compare: on = every "
+        "same-scheduled phase-I flavor in ONE stacked (flavor·net, "
+        "agent) scan, off = the PR-4 per-flavor arms, auto (default) = "
+        "the measured backend policy (fused on TPU, per-flavor "
+        "elsewhere); pass 'on off' for the A/B",
     )
 
 
@@ -1141,19 +1204,20 @@ def cmd_bench(argv) -> int:
 
     from rcmarl_tpu.ops.aggregation import resolve_impl
     from rcmarl_tpu.parallel.seeds import make_mesh, train_parallel
-    from rcmarl_tpu.training.update import netstack_enabled
+    from rcmarl_tpu.training.update import fitstack_enabled, netstack_enabled
     from rcmarl_tpu.training.trainer import init_train_state, train_scanned
     from rcmarl_tpu.utils.profiling import Timer
 
     shard_modes = [None] if args.shard_agents is None else args.shard_agents
     n_failed = 0
-    for name, dtype, impl, layout, ns, shard in itertools.product(
+    for name, dtype, impl, layout, ns, fs, shard in itertools.product(
         args.configs, args.compute_dtype, args.impl, args.layout,
-        args.netstack, shard_modes,
+        args.netstack, args.fitstack, shard_modes,
     ):
         cfg = _bench_config(
             name, impl, args.n_ep_fixed, dtype, layout,
             netstack=_netstack_value(ns),
+            fitstack=_netstack_value(fs),
         )
         if netstack_enabled(cfg) and layout == "per_leaf":
             print(
@@ -1218,6 +1282,7 @@ def cmd_bench(argv) -> int:
                     "impl": impl,
                     "layout": layout,
                     "netstack": netstack_enabled(cfg),
+                    "fitstack": fitstack_enabled(cfg),
                     "compute_dtype": dtype,
                     **({} if shard is None else {"shard_agents": bool(shard)}),
                     "error": f"{type(e).__name__}: {e}"[:300],
@@ -1234,6 +1299,7 @@ def cmd_bench(argv) -> int:
                 "impl_resolved": resolve_impl(impl, cfg.n_in, n_agents=cfg.n_agents, H=cfg.H),
                 "layout": cfg.consensus_layout,
                 "netstack": netstack_enabled(cfg),
+                "fitstack": fitstack_enabled(cfg),
                 "compute_dtype": cfg.compute_dtype,
                 "n_agents": cfg.n_agents,
                 "n_in": cfg.n_in,
@@ -1332,7 +1398,7 @@ def cmd_profile(argv) -> int:
     import jax
 
     from rcmarl_tpu.ops.aggregation import resolve_impl
-    from rcmarl_tpu.training.update import netstack_enabled
+    from rcmarl_tpu.training.update import fitstack_enabled, netstack_enabled
     from rcmarl_tpu.utils.profiling import (
         consensus_tags,
         profile_consensus,
@@ -1341,12 +1407,14 @@ def cmd_profile(argv) -> int:
     )
 
     n_failed = 0
-    for name, dtype, impl, layout, ns in itertools.product(
-        args.configs, args.compute_dtype, args.impl, args.layout, args.netstack
+    for name, dtype, impl, layout, ns, fs in itertools.product(
+        args.configs, args.compute_dtype, args.impl, args.layout,
+        args.netstack, args.fitstack,
     ):
         cfg = _bench_config(
             name, impl, args.n_ep_fixed, dtype, layout,
             netstack=_netstack_value(ns),
+            fitstack=_netstack_value(fs),
         )
         if netstack_enabled(cfg) and layout == "per_leaf":
             print(
@@ -1370,6 +1438,7 @@ def cmd_profile(argv) -> int:
                     "impl": impl,
                     "layout": layout,
                     "netstack": netstack_enabled(cfg),
+                    "fitstack": fitstack_enabled(cfg),
                     "compute_dtype": dtype,
                     "error": f"{type(e).__name__}: {e}"[:300],
                 }
@@ -1393,6 +1462,7 @@ def cmd_profile(argv) -> int:
                 "impl_resolved": resolve_impl(impl, cfg.n_in, n_agents=cfg.n_agents, H=cfg.H),
                 "layout": cfg.consensus_layout,
                 "netstack": netstack_enabled(cfg),
+                "fitstack": fitstack_enabled(cfg),
                 "compute_dtype": cfg.compute_dtype,
                 "n_agents": cfg.n_agents,
                 "hidden": list(cfg.hidden),
@@ -1426,6 +1496,7 @@ def cmd_profile(argv) -> int:
                     ),
                     "layout": cfg.consensus_layout,
                     "netstack": netstack_enabled(cfg),
+                    "fitstack": fitstack_enabled(cfg),
                     "compute_dtype": cfg.compute_dtype,
                     "cost_fingerprint": fingerprint,
                     **consensus_tags(cfg),

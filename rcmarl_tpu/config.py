@@ -192,6 +192,31 @@ class Config:
     # leaf-for-leaf equivalent either way (tests/test_netstack.py), so
     # the policy is purely a speed choice.
     netstack: "bool | str" = "auto"
+    # --- fitstack: ALL phase-I fit flavors as one fused scan ---
+    # True: every fit flavor the scenario runs (cooperative critic+TR
+    # full-batch fits, greedy critic+TR minibatch fits, malicious
+    # compromised critic+TR minibatch fits, the malicious PRIVATE
+    # critic minibatch fit) is stacked along a leading (flavor·net) row
+    # axis and launched through ONE unified scan body per schedule
+    # shape (ops/fit.py:fused_fit_scan): the full-batch flavor is
+    # expressed as one identity-plan minibatch covering the whole
+    # batch, the minibatch flavors draw their valid-first shuffles with
+    # the dual arm's exact key structure, so the fused rows are pinned
+    # leaf-for-leaf BITWISE against the PR-4 pair-fit arm
+    # (tests/test_fitstack_properties.py). A mixed coop+adversary cast
+    # has two schedule shapes (full-batch vs minibatch) and therefore
+    # two fused launches — down from four; a homogeneous cast launches
+    # exactly ONE scan for all its flavors. False: the PR-4 phase-I
+    # arms (pair fits under netstack, per-tree fits on the dual arm).
+    # 'auto' (default): the measured backend policy, netstack-style —
+    # fused on TPU (batching the MXU-underfilling 20-wide gemms across
+    # flavor rows is the Podracer win), the PR-4 arms elsewhere (the
+    # serial-CPU measurement keeps the dual arm: padding the critic
+    # rows to sa_dim costs FLOPs a single core cannot hide — PERF.md
+    # "fitstack / bf16"). Orthogonal to `netstack`: fitstack owns
+    # phase I, netstack then only governs the phase-II consensus
+    # layout.
+    fitstack: "bool | str" = "auto"
     # --- transport faults / graceful degradation ---
     # fault_plan: per-link transport-fault injection on the consensus
     # exchange (drop / stale replay / corruption / NaN-Inf bombs —
@@ -268,6 +293,11 @@ class Config:
         if not (isinstance(self.netstack, bool) or self.netstack == "auto"):
             raise ValueError(
                 f"netstack={self.netstack!r}: expected True, False, or "
+                "'auto' (the measured backend policy)"
+            )
+        if not (isinstance(self.fitstack, bool) or self.fitstack == "auto"):
+            raise ValueError(
+                f"fitstack={self.fitstack!r}: expected True, False, or "
                 "'auto' (the measured backend policy)"
             )
         if self.compute_dtype not in ("float32", "bfloat16"):

@@ -141,6 +141,19 @@ def _census_programs():
             {"seed": 2, "agent": 2},
             True,
         ),
+        # the fused cross-flavor fit arm under the same sharded mesh:
+        # phase-I fits are agent-local, so the fitstack row block must
+        # add NO collectives beyond the consensus set — the ledger pins
+        # its counts exactly like the base sharded program
+        "seeds@sharded+fitstack": (
+            lambda: lower_parallel(
+                cfg.replace(fitstack=True),
+                [0, 1], 1, make_mesh(4, seed_axis=2), True,
+            ),
+            4,
+            {"seed": 2, "agent": 2},
+            True,
+        ),
         "matrix@sharded": (
             lambda: lower_matrix(
                 cfg, [cfg, mal], [0, 1], 1, make_mesh(4, seed_axis=2), True

@@ -31,6 +31,19 @@ def tiny_cfg(**overrides):
     return Config(**base)
 
 
+def tiny_mixed_cfg(**overrides):
+    """The MIXED-cast audit variant (1 coop + 1 greedy + 1 malicious):
+    every phase-I fit flavor is live, so a fitstack/fused-fit audit row
+    covers the whole (flavor·net) row block, not just the cooperative
+    pair an all-coop cast would leave."""
+    from rcmarl_tpu.config import Roles
+
+    return tiny_cfg(
+        agent_roles=(Roles.COOPERATIVE, Roles.GREEDY, Roles.MALICIOUS),
+        **overrides,
+    )
+
+
 def tiny_faulted_cfg(netstack, **overrides):
     """The guarded+faulted variant (drop+NaN+stale plan, sanitize on)."""
     from rcmarl_tpu.faults import FaultPlan

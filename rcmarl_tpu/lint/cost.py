@@ -63,6 +63,7 @@ _ANCHORS = {
     "update_block": "rcmarl_tpu/training/update.py",
     "train_block": "rcmarl_tpu/training/trainer.py",
     "gossip_mix_block": "rcmarl_tpu/parallel/gossip.py",
+    "fit_block": "rcmarl_tpu/training/update.py",
     "aggregation": "rcmarl_tpu/ops/aggregation.py",
 }
 
@@ -169,6 +170,7 @@ def cost_arms() -> Dict[str, tuple]:
         tiny_cfg,
         tiny_faulted_cfg,
         tiny_gossip_cfg,
+        tiny_mixed_cfg,
     )
 
     return {
@@ -195,6 +197,27 @@ def cost_arms() -> Dict[str, tuple]:
         "guarded": (
             tiny_faulted_cfg(False),
             True,
+            ("update_block", "train_block"),
+        ),
+        # the cross-flavor fused fit scan (Config.fitstack) and the
+        # bf16 compute arm: the fused standalone fit program plus the
+        # whole update/train block at each knob, so "the fused fit got
+        # cheaper/narrower" is a ledger fact at BOTH dtypes — a mixed
+        # cast (one greedy, one malicious) keeps every flavor row live
+        # in the audited fused program
+        "fitstack": (
+            tiny_mixed_cfg(fitstack=True),
+            False,
+            ("update_block", "train_block", "fit_block"),
+        ),
+        "fitstack_bf16": (
+            tiny_mixed_cfg(fitstack=True, compute_dtype="bfloat16"),
+            False,
+            ("update_block", "train_block", "fit_block"),
+        ),
+        "bf16": (
+            tiny_cfg(compute_dtype="bfloat16"),
+            False,
             ("update_block", "train_block"),
         ),
     }

@@ -153,15 +153,75 @@ def _tiny_cfg(netstack, faulted: bool):
     return tiny_cfg(netstack=netstack)
 
 
-def audit_retrace(steady_blocks: int = 2) -> List[Finding]:
+def _audit_fitstack_dtypes(
+    auditor: "RetraceAuditor", steady_blocks: int
+) -> List[Finding]:
+    """The alternating-dtype compile-once case: the fused fit entry
+    (``fit_block``) driven over a float32 and a bfloat16 config must
+    land in exactly TWO distinct jit-cache entries (compute_dtype is
+    jit-static, so the dtypes may never share — or leak into — a
+    program), and steady-state alternation between them must hit the
+    caches with zero recompiles."""
+    from rcmarl_tpu.lint.configs import tiny_cfg
+    from rcmarl_tpu.training.update import fit_block, team_average_reward
+    from rcmarl_tpu.utils.profiling import entry_point_inputs
+
+    findings: List[Finding] = []
+    calls = []
+    # the tiny all-coop config: dtype-cache separation is what this
+    # case proves (the mixed-cast fused program's coverage lives in the
+    # AUDIT.jsonl fitstack/fitstack_bf16 cost arms)
+    for cfg in (
+        tiny_cfg(fitstack=True),
+        tiny_cfg(fitstack=True, compute_dtype="bfloat16"),
+    ):
+        state, batch, _, key = entry_point_inputs(cfg)
+        p = state.params
+        calls.append((
+            cfg,
+            (p.critic, p.tr, p.critic_local),
+            batch,
+            team_average_reward(cfg, batch.r),
+            key,
+        ))
+    before = int(fit_block._cache_size())
+    for args in calls:  # warmup: one compile per compute_dtype
+        fit_block(*args)
+    grew = int(fit_block._cache_size()) - before
+    if grew != 2:
+        path, line = _anchor(fit_block)
+        findings.append(
+            Finding(
+                "retrace",
+                path,
+                line,
+                f"fit_block compiled {grew} program(s) for the "
+                "f32/bf16 config pair — expected exactly one per "
+                "compute_dtype (distinct jit caches, no dtype sharing)",
+            )
+        )
+    with auditor.expect_no_compiles(context="alternating f32/bf16 fused fits"):
+        for _ in range(steady_blocks):
+            for args in calls:
+                fit_block(*args)
+    return findings
+
+
+def audit_retrace(
+    steady_blocks: int = 2, fitstack_dtypes: bool = True
+) -> List[Finding]:
     """``lint --retrace``: prove exactly-once compilation on tiny runs.
 
-    Four cases cover the production paths: a guarded+faulted run on
-    each netstack arm (the undonated retry-capable entries, diag on),
-    a clean run (the donated steady-state entries), and a Byzantine
-    gossip-replica run (the gossip_mix_block entry must re-dispatch one
-    executable per round). Each trains ONE warmup block/round outside
-    the watchdog, then ``steady_blocks`` more inside it — any further
+    The cases cover the production paths: a guarded+faulted run on the
+    dual arm and on the stacked arms (netstack phase II fed by the
+    fused fitstack phase I, mixed cast — the undonated retry-capable
+    entries, diag on), a clean run (the donated steady-state entries),
+    the alternating f32/bf16 fused-fit case (exactly one compile per
+    compute_dtype, zero steady-state recompiles across alternation —
+    :func:`_audit_fitstack_dtypes`), and a Byzantine gossip-replica
+    run (the gossip_mix_block entry must re-dispatch one executable
+    per round). Each trains ONE warmup block/round outside the
+    watchdog, then ``steady_blocks`` more inside it — any further
     compile is a ``retrace`` finding naming the entry point and jax's
     explanation of what changed.
     """
@@ -174,7 +234,16 @@ def audit_retrace(steady_blocks: int = 2) -> List[Finding]:
     auditor = RetraceAuditor()
     cases = [
         ("faulted+guarded, netstack off", _tiny_cfg(False, True)),
-        ("faulted+guarded, netstack on", _tiny_cfg(True, True)),
+        # one stacked case covers BOTH stacked arms: fused cross-flavor
+        # phase-I fits (fitstack) feeding the combined netstack
+        # phase-II block. Compile-once discipline is role-independent
+        # (the mixed-cast fused program's cost/dtype coverage lives in
+        # the AUDIT.jsonl fitstack arms), so the case stays on the
+        # tiny all-coop config to keep the tier-1 wall budget.
+        (
+            "faulted+guarded, netstack+fitstack on",
+            _tiny_cfg(True, True).replace(fitstack=True),
+        ),
         ("clean donated, netstack off", _tiny_cfg(False, False)),
     ]
     for label, cfg in cases:
@@ -185,6 +254,13 @@ def audit_retrace(steady_blocks: int = 2) -> List[Finding]:
                 n_episodes=cfg.n_ep_fixed * steady_blocks,
                 state=state,
             )
+    if fitstack_dtypes:
+        # ``fitstack_dtypes=False`` lets the tier-1 pytest wrapper skip
+        # this (wall budget); the CI graftlint cell's `lint --retrace`
+        # always runs it
+        auditor.findings.extend(
+            _audit_fitstack_dtypes(auditor, steady_blocks)
+        )
     gcfg = tiny_gossip_cfg()
     states, df = train_gossip(gcfg, n_episodes=gcfg.n_ep_fixed)  # warmup round
     with auditor.expect_no_compiles(context="byzantine gossip replicas"):

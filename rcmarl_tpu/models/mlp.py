@@ -207,30 +207,36 @@ def pad_rows(W: jnp.ndarray, rows: int) -> jnp.ndarray:
     return jnp.pad(W, pad)
 
 
-def netstack_stack(a: MLPParams, b: MLPParams) -> MLPParams:
-    """Stack two MLP families along a NEW leading net axis.
+def netstack_stack_rows(nets: Sequence[MLPParams]) -> MLPParams:
+    """Stack ANY number of MLP families along a NEW leading row axis.
 
-    ``a`` and ``b`` must agree in depth and in every layer shape except
-    the first-layer input width, which is zero-padded up to the wider of
-    the two (both for kernels with and without a leading agent axis —
-    only the ``-2`` axis of the first kernel is padded). Leaves of the
-    result are ``(2, ...)``-leading; recover the originals with
-    :func:`netstack_split`.
+    All families must agree in depth and in every layer shape except the
+    first-layer input width, which is zero-padded up to the widest (both
+    for kernels with and without a leading agent axis — only the ``-2``
+    axis of the first kernel is padded). Leaves of the result are
+    ``(len(nets), ...)``-leading; recover the originals with
+    :func:`netstack_split_rows`. The fitstack fused scan stacks one row
+    per (flavor, net) here; :func:`netstack_stack` is the 2-row case.
     """
-    if len(a) != len(b):
+    nets = tuple(nets)
+    if not nets:
+        raise ValueError("netstack_stack_rows needs at least one net")
+    depths = {len(n) for n in nets}
+    if len(depths) != 1:
         raise ValueError(
-            f"netstack requires equal depth, got {len(a)} vs {len(b)} layers"
+            f"netstack requires equal depth, got {sorted(depths)} layers"
         )
-    width = max(a[0][0].shape[-2], b[0][0].shape[-2])
-    a = ((pad_rows(a[0][0], width), a[0][1]),) + tuple(a[1:])
-    b = ((pad_rows(b[0][0], width), b[0][1]),) + tuple(b[1:])
-    return jax.tree.map(lambda x, y: jnp.stack([x, y]), a, b)
+    width = max(n[0][0].shape[-2] for n in nets)
+    padded = tuple(
+        ((pad_rows(n[0][0], width), n[0][1]),) + tuple(n[1:]) for n in nets
+    )
+    return jax.tree.map(lambda *ls: jnp.stack(ls), *padded)
 
 
-def netstack_split(
-    stacked: MLPParams, in_dims: Tuple[int, int]
-) -> Tuple[MLPParams, MLPParams]:
-    """Inverse of :func:`netstack_stack`: slice the two families back
+def netstack_split_rows(
+    stacked: MLPParams, in_dims: Sequence[int]
+) -> Tuple[MLPParams, ...]:
+    """Inverse of :func:`netstack_stack_rows`: slice each family back
     out, trimming each first-layer kernel to its own input width (the
     padded rows carry exact zeros, so the trim is lossless)."""
 
@@ -240,4 +246,19 @@ def netstack_split(
         sl = (slice(None),) * (W1.ndim - 2) + (slice(0, rows), slice(None))
         return ((W1[sl], p[0][1]),) + tuple(p[1:])
 
-    return unstack(0, in_dims[0]), unstack(1, in_dims[1])
+    return tuple(unstack(i, rows) for i, rows in enumerate(in_dims))
+
+
+def netstack_stack(a: MLPParams, b: MLPParams) -> MLPParams:
+    """Stack two MLP families along a NEW leading net axis (the 2-row
+    case of :func:`netstack_stack_rows`; the critic+TR netstack pair)."""
+    return netstack_stack_rows((a, b))
+
+
+def netstack_split(
+    stacked: MLPParams, in_dims: Tuple[int, int]
+) -> Tuple[MLPParams, MLPParams]:
+    """Inverse of :func:`netstack_stack` (the 2-row case of
+    :func:`netstack_split_rows`)."""
+    a, b = netstack_split_rows(stacked, in_dims)
+    return a, b

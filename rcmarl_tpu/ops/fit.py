@@ -26,7 +26,7 @@ count is reproduced by dividing by the batch's valid count.
 from __future__ import annotations
 
 import math
-from typing import Callable, Optional, Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -61,7 +61,11 @@ def fit_full_batch(
 
 
 def valid_first_shuffle(
-    key: jax.Array, mask: jnp.ndarray, n_batches: int, batch_size: int
+    key: jax.Array,
+    mask: jnp.ndarray,
+    n_batches: int,
+    batch_size: int,
+    assume_valid: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Per-epoch shuffled batch index plan.
 
@@ -70,6 +74,12 @@ def valid_first_shuffle(
       mask: (capacity,) float/bool validity of each buffer row.
       n_batches/batch_size: static batch plan; n_batches * batch_size >=
         capacity (indices beyond capacity are padding).
+      assume_valid: static promise that ``mask`` is all-ones (rows with
+        no invalid tail, e.g. the always-full on-policy actor window).
+        Skips the valid-first penalty on the shuffle scores and derives
+        the slot validity statically — BITWISE the same plan (adding an
+        exact 0.0 penalty cannot reorder the argsort, and
+        ``sum(ones(cap)) == cap``), minus the permutation bookkeeping.
 
     Returns:
       (idx, batch_valid): idx (n_batches, batch_size) int32 row indices;
@@ -79,15 +89,42 @@ def valid_first_shuffle(
     """
     cap = mask.shape[0]
     pad = n_batches * batch_size - cap
-    scores = jax.random.uniform(key, (cap,)) + (1.0 - mask.astype(jnp.float32)) * 2.0
+    scores = jax.random.uniform(key, (cap,))
+    if not assume_valid:
+        scores = scores + (1.0 - mask.astype(jnp.float32)) * 2.0
     order = jnp.argsort(scores).astype(jnp.int32)  # valid rows first, shuffled
-    slot_valid = (jnp.arange(n_batches * batch_size) < jnp.sum(mask)).astype(
+    n_valid = cap if assume_valid else jnp.sum(mask)
+    slot_valid = (jnp.arange(n_batches * batch_size) < n_valid).astype(
         jnp.float32
     )
     order_padded = jnp.concatenate([order, jnp.zeros((pad,), jnp.int32)])
     return (
         order_padded.reshape(n_batches, batch_size),
         slot_valid.reshape(n_batches, batch_size),
+    )
+
+
+def identity_plan(
+    mask: jnp.ndarray, n_batches: int, batch_size: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The NO-shuffle epoch plan: row i stays in slot i, slot validity is
+    the row's own mask. With ``n_batches == 1`` and ``batch_size ==
+    capacity`` this makes one "minibatch" step visit the whole buffer in
+    storage order under the buffer mask — exactly the full-batch fit's
+    loss (gathering with an iota index is value-identical to no gather),
+    which is how :func:`fused_fit_scan` runs the full-batch flavor
+    through the shared minibatch step body bitwise."""
+    cap = mask.shape[0]
+    pad = n_batches * batch_size - cap
+    idx = jnp.concatenate(
+        [jnp.arange(cap, dtype=jnp.int32), jnp.zeros((pad,), jnp.int32)]
+    )
+    bvalid = jnp.concatenate(
+        [mask.astype(jnp.float32), jnp.zeros((pad,), jnp.float32)]
+    )
+    return (
+        idx.reshape(n_batches, batch_size),
+        bvalid.reshape(n_batches, batch_size),
     )
 
 
@@ -102,6 +139,8 @@ def fit_minibatch(
     lr: float = 0.0,
     opt_state=None,
     opt_update: Optional[Callable] = None,
+    shuffle: bool = True,
+    assume_valid: bool = False,
 ):
     """Shuffled mini-batch fit with Keras epoch/batch structure.
 
@@ -113,6 +152,14 @@ def fit_minibatch(
       lr: SGD learning rate, used when ``opt_update`` is None.
       opt_state/opt_update: optional stateful optimizer (e.g. TF-Adam);
         ``opt_update(params, grads, state) -> (params, state)``.
+      shuffle: static; True (default, the Keras semantics) draws a
+        fresh :func:`valid_first_shuffle` per epoch; False runs the
+        :func:`identity_plan` instead — with ``batch_size >= capacity``
+        that is a full-batch SGD fit expressed in this scan body,
+        bitwise :func:`fit_full_batch` (``key`` is then never consumed).
+      assume_valid: static promise that ``mask`` is all-ones; the
+        shuffle skips the valid-first penalty work (bitwise-identical
+        plan — see :func:`valid_first_shuffle`).
 
     Returns (final_params, final_opt_state, first_epoch_mean_loss) —
     Keras's ``history['loss'][0]`` is the mean of per-batch losses over the
@@ -120,11 +167,28 @@ def fit_minibatch(
     """
     n_batches = math.ceil(capacity / batch_size)
     grad_fn = jax.value_and_grad(batch_loss_fn)
-    ekeys = jax.random.split(key, epochs)
+    # shuffle=False consumes no randomness: scan over a dummy axis so
+    # the key is provably untouched (the fused coop rows pass a zero).
+    ekeys = (
+        jax.random.split(key, epochs)
+        if shuffle
+        else jnp.zeros((epochs,), jnp.int32)
+    )
 
     def epoch(carry, ekey):
         p, ostate = carry
-        idx, bvalid = valid_first_shuffle(ekey, mask, n_batches, batch_size)
+        if shuffle and assume_valid:
+            idx, bvalid = valid_first_shuffle(
+                ekey, mask, n_batches, batch_size, assume_valid=True
+            )
+        elif shuffle:
+            # positional call, no flag: tests monkeypatch this hook
+            # with 4-arg twins
+            idx, bvalid = valid_first_shuffle(
+                ekey, mask, n_batches, batch_size
+            )
+        else:
+            idx, bvalid = identity_plan(mask, n_batches, batch_size)
 
         def mb(carry, xs):
             p, ostate = carry
@@ -148,6 +212,11 @@ def fit_minibatch(
             return (p, ostate), (loss, jnp.sum(bval))
 
         (p, ostate), (losses, counts) = jax.lax.scan(mb, (p, ostate), (idx, bvalid))
+        if not shuffle and n_batches == 1:
+            # the full-batch flavor: "epoch loss" IS the one batch loss
+            # (the weighted-mean arithmetic below would round it —
+            # fit_full_batch's first-step loss must come back bitwise)
+            return (p, ostate), losses[0]
         # Keras's epoch loss is the sample-count-weighted mean of batch losses
         mean_loss = jnp.sum(losses * counts) / jnp.maximum(jnp.sum(counts), 1.0)
         return (p, ostate), mean_loss
@@ -218,3 +287,112 @@ def fit_mse_minibatch(
         lr=lr,
     )
     return out, loss
+
+
+# --------------------------------------------------------------------------
+# Fitstack: every same-scheduled fit flavor as ONE stacked scan
+# --------------------------------------------------------------------------
+#
+# The Podracer/Anakin recipe (arXiv:2104.06272): batch every SAME-SHAPED
+# program into one device-resident launch. The four critic/TR fit
+# flavors come in exactly two schedule shapes — the cooperative
+# full-batch fit (``coop_fit_steps`` whole-buffer SGD steps) and the
+# adversary minibatch fit (``adv_fit_epochs`` x shuffled
+# ``adv_fit_batch`` batches) — and :class:`FitSchedule` names a shape
+# statically. :func:`fused_fit_scan` then runs EVERY flavor of one
+# shape as a single (row, agent)-vmapped scan over a stacked parameter
+# block, through the ONE unified step body of :func:`fit_minibatch`:
+# full-batch rows use the identity plan (one "minibatch" covering the
+# buffer — value-identical to no gather), minibatch rows draw their
+# valid-first shuffles from the exact keys the dual-launch arm would
+# draw. Rows are pinned leaf-for-leaf bitwise against the PR-4 pair-fit
+# arm (tests/test_fitstack_properties.py). The stacked (rows, agent,
+# batch) layout is deliberately kernel-friendly: a follow-up Pallas fit
+# kernel can tile the row axis without re-plumbing the schedule.
+
+
+class FitSchedule(NamedTuple):
+    """One fit flavor's STATIC schedule shape (hashable, jit-static).
+
+    epochs/batch_size: the Keras fit arguments; ``n_batches`` is derived
+    (``ceil(capacity / batch_size)``). ``shuffle=False`` selects the
+    identity plan (the full-batch flavor: set ``batch_size`` to the
+    buffer capacity). ``assume_valid`` statically promises an all-ones
+    mask (skips the valid-first penalty work, bitwise-identical plan).
+    Flavors sharing a ``FitSchedule`` stack into one
+    :func:`fused_fit_scan` launch.
+    """
+
+    epochs: int
+    batch_size: int
+    shuffle: bool = True
+    assume_valid: bool = False
+
+
+def fit_mse_sched(
+    key: jax.Array,
+    params,
+    forward: Callable[[object, jnp.ndarray], jnp.ndarray],
+    x: jnp.ndarray,
+    target: jnp.ndarray,
+    mask: jnp.ndarray,
+    schedule: FitSchedule,
+    lr: float,
+):
+    """Masked-MSE regression of ``forward(params, x)`` onto a fixed
+    ``target`` under an arbitrary :class:`FitSchedule` — the ONE row
+    program of the fused scan. With ``schedule.shuffle`` this is exactly
+    :func:`fit_mse_minibatch` (same delegation, same op sequence);
+    without it, :func:`fit_mse_full_batch` expressed through the same
+    scan body (``key`` unread). Returns (params, first_epoch_loss)."""
+    target = jax.lax.stop_gradient(target)
+    out, _, loss = fit_minibatch(
+        key,
+        params,
+        lambda p, idx, bval: weighted_mse(
+            forward(p, x[idx]), target[idx], mask=bval
+        ),
+        capacity=x.shape[0],
+        mask=mask,
+        epochs=schedule.epochs,
+        batch_size=schedule.batch_size,
+        lr=lr,
+        shuffle=schedule.shuffle,
+        assume_valid=schedule.assume_valid,
+    )
+    return out, loss
+
+
+def fused_fit_scan(
+    keys,
+    params_rows,
+    forward: Callable[[object, jnp.ndarray], jnp.ndarray],
+    x_rows: jnp.ndarray,
+    targets_rows: jnp.ndarray,
+    mask: jnp.ndarray,
+    schedule: FitSchedule,
+    lr: float,
+):
+    """ALL fit flavors of one schedule shape as ONE stacked scan.
+
+    Args:
+      keys: (R, N) PRNG keys, row r agent i's minibatch shuffle stream
+        (pass zeros-shaped keys for ``shuffle=False`` schedules — never
+        consumed).
+      params_rows: stacked nets, leaves (R, N, ...) — first-layer rows
+        zero-padded to a common input width
+        (:func:`rcmarl_tpu.models.mlp.netstack_stack_rows`).
+      x_rows: (R, B, width) per-row fit inputs (padded to match).
+      targets_rows: (R, N, B, 1) per-row precomputed regression targets.
+      mask: (B,) shared buffer validity.
+      schedule: the rows' SHARED static schedule shape.
+
+    Returns (fitted rows, (R, N) first-epoch losses).
+    """
+    def fit_one(k, p, x, t):
+        return fit_mse_sched(k, p, forward, x, t, mask, schedule, lr)
+
+    per_agent = jax.vmap(fit_one, in_axes=(0, 0, None, 0))
+    return jax.vmap(per_agent, in_axes=(0, 0, 0, 0))(
+        keys, params_rows, x_rows, targets_rows
+    )

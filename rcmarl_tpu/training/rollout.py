@@ -103,9 +103,11 @@ def rollout_episode(
         coop = spec.coop
         n_coop = jnp.maximum(jnp.sum(coop), 1)
         n_adv = jnp.maximum(jnp.sum(~coop), 1)
-    v0 = jax.vmap(lambda p: mlp_forward(p, s0[None].reshape(1, -1))[0, 0])(
-        params.critic
-    )  # (N,)
+    v0 = jax.vmap(
+        lambda p: mlp_forward(p, s0[None].reshape(1, -1), dtype=cfg.dot_dtype)[
+            0, 0
+        ]
+    )(params.critic)  # (N,)
     est = jnp.sum(jnp.where(coop, v0, 0.0)) / n_coop
 
     def step(carry, k):

@@ -32,14 +32,18 @@ from rcmarl_tpu.agents.updates import (
     CellSpec,
     adv_actor_update,
     adv_critic_fit,
+    adv_fit_schedule,
+    adv_fused_row_block,
     adv_pair_fit,
     adv_tr_fit,
     consensus_update_one,
     consensus_update_pair,
     coop_actor_update,
+    coop_fused_fit,
     coop_local_critic_fit,
     coop_local_tr_fit,
     coop_pair_fit,
+    fused_fit_rows,
     netstack_pair_inputs,
     pair_bootstrap_targets,
     select_tree,
@@ -57,6 +61,7 @@ from rcmarl_tpu.models.mlp import (
     init_stacked_mlp,
     mlp_forward,
     netstack_split,
+    netstack_split_rows,
     netstack_stack,
 )
 from rcmarl_tpu.ops.aggregation import ravel_neighbor_tree
@@ -104,6 +109,23 @@ def netstack_enabled(cfg: Config) -> bool:
     if cfg.netstack == "auto":
         return jax.default_backend() == "tpu"
     return bool(cfg.netstack)
+
+
+def fitstack_enabled(cfg: Config) -> bool:
+    """Resolve ``Config.fitstack`` at trace time: explicit booleans
+    pass through; ``'auto'`` is the measured backend policy, exactly
+    the ``netstack='auto'`` precedent — the cross-flavor fused fit
+    scan on TPU (batching every same-scheduled flavor into one
+    device-resident launch is the Podracer win the MXU-underfilling
+    20-wide gemms are waiting for), the PR-4 per-flavor arms elsewhere
+    (measured on the 1-core CPU host: the critic rows' sa_dim padding
+    costs FLOPs a serial core cannot hide — PERF.md "fitstack /
+    bf16"). Outputs are pinned leaf-for-leaf bitwise either way
+    (tests/test_fitstack_properties.py), so the policy is purely a
+    speed choice."""
+    if cfg.fitstack == "auto":
+        return jax.default_backend() == "tpu"
+    return bool(cfg.fitstack)
 
 
 def spec_from_config(cfg: Config) -> CellSpec:
@@ -169,6 +191,137 @@ def team_average_reward(
     return jnp.sum(r * coop, axis=1) / jnp.maximum(jnp.sum(coop), 1.0)
 
 
+def _phase1_fits_fused(
+    cfg: Config,
+    critic,
+    tr,
+    critic_local,
+    batch: Batch,
+    r_coop: jnp.ndarray,
+    ekey: jax.Array,
+    spec: CellSpec | None = None,
+):
+    """Phase I for EVERY role as at most two cross-flavor fused scans
+    (``Config.fitstack``) — the rung above PR 4's per-flavor pair fits.
+
+    All flavors sharing a schedule shape stack into one
+    (flavor·net, agent) row block and launch as ONE
+    :func:`~rcmarl_tpu.agents.updates.fused_fit_rows` scan:
+
+    - full-batch group: cooperative critic + cooperative TR (2 rows),
+      run through the unified minibatch body on the identity plan;
+    - minibatch group: greedy critic/TR, malicious compromised
+      critic/TR, and the malicious PRIVATE critic (up to 5 rows), each
+      row drawing the valid-first shuffles from the dual arm's exact
+      per-flavor keys.
+
+    A homogeneous cast therefore launches exactly ONE scan for all its
+    flavors; a mixed cast launches two (the shapes cannot share a
+    launch without ruinous width padding). The critic's TD bootstrap
+    V(ns) is computed once at the unpadded width and shared across the
+    coop/greedy/malicious pair targets (the PR-4 netstack recipe);
+    the private critic's own bootstrap runs once more on
+    ``critic_local``. Returns ``(msg_critic, msg_tr, new_critic,
+    new_tr, new_critic_local)`` — plain per-tree results, pinned
+    leaf-for-leaf bitwise against both PR-4 phase-I arms
+    (tests/test_fitstack_properties.py).
+    """
+    s, ns, sa, mask = batch.s, batch.ns, batch.sa, batch.mask
+    r_agents = jnp.moveaxis(batch.r, 1, 0)  # (N, B, 1)
+    N = cfg.n_agents
+    traced = spec is not None
+    in2 = (cfg.obs_dim, cfg.sa_dim)
+    x2 = netstack_pair_inputs(cfg, s, sa)  # (2, B, sa_dim)
+
+    has_coop = traced or bool(cfg.n_coop)
+    has_greedy = traced or cfg.has_role(Roles.GREEDY)
+    has_mal = traced or cfg.has_role(Roles.MALICIOUS)
+
+    # the shared TD bootstrap with the PRE-FIT critic, once
+    v_ns = None
+    if has_coop or has_greedy or has_mal:
+        v_ns = jax.vmap(lambda p: mlp_forward(p, ns, dtype=cfg.dot_dtype))(
+            critic
+        )
+
+    def pair_targets(r):
+        return pair_bootstrap_targets(cfg, critic, ns, r, v=v_ns)
+
+    msg_critic, msg_tr = critic, tr  # Faulty default: transmit frozen nets
+    new_critic, new_tr, new_critic_local = critic, tr, critic_local
+
+    # ---- full-batch group: cooperative critic + TR
+    if has_coop:
+        r_team = jnp.broadcast_to(r_coop[None], (N, *r_coop.shape))
+        if traced:
+            r_applied = jnp.where(spec.common_reward, r_team, r_agents)
+        elif cfg.common_reward:
+            r_applied = r_team
+        else:
+            r_applied = r_agents
+        coop2, _ = coop_fused_fit(
+            critic, tr, x2, pair_targets(r_applied), mask, cfg
+        )
+        coop_c, coop_t = netstack_split(coop2, in2)
+        m = spec.coop if traced else _role_mask(cfg, Roles.COOPERATIVE)
+        msg_critic = select_tree(m, coop_c, msg_critic)
+        msg_tr = select_tree(m, coop_t, msg_tr)
+        # own nets restored (resilient_CAC_agents.py:120,138): new_* unchanged
+
+    # ---- minibatch group: every adversary flavor in one row block
+    # (adv_fused_row_block is the single source of truth for the rows,
+    # shared with the consensus-micro profiler)
+    block = adv_fused_row_block(
+        cfg, critic, tr, critic_local, x2, ns, r_agents, r_coop,
+        jax.random.split(ekey, 5), v_ns=v_ns,
+        has_greedy=has_greedy, has_mal=has_mal,
+    )
+    if block is not None:
+        keys_rows, params_rows, x_rows, targets_rows, in_dims = block
+        fitted, _ = fused_fit_rows(
+            keys_rows, params_rows, x_rows, targets_rows, mask,
+            adv_fit_schedule(cfg), cfg,
+        )
+        parts = netstack_split_rows(fitted, in_dims)
+        i = 0
+        if has_greedy:
+            g_c, g_t = parts[i], parts[i + 1]
+            i += 2
+            m = spec.greedy if traced else _role_mask(cfg, Roles.GREEDY)
+            msg_critic = select_tree(m, g_c, msg_critic)
+            msg_tr = select_tree(m, g_t, msg_tr)
+            new_critic = select_tree(m, g_c, new_critic)  # persists
+            new_tr = select_tree(m, g_t, new_tr)
+        if has_mal:
+            mal_c, mal_t, mal_local = parts[i], parts[i + 1], parts[i + 2]
+            m = spec.malicious if traced else _role_mask(cfg, Roles.MALICIOUS)
+            msg_critic = select_tree(m, mal_c, msg_critic)
+            msg_tr = select_tree(m, mal_t, msg_tr)
+            new_critic = select_tree(m, mal_c, new_critic)  # persists
+            new_tr = select_tree(m, mal_t, new_tr)
+            new_critic_local = select_tree(m, mal_local, new_critic_local)
+    return msg_critic, msg_tr, new_critic, new_tr, new_critic_local
+
+
+def _fit_block(cfg: Config, carry, batch: Batch, r_coop, ekey,
+               spec: CellSpec | None = None):
+    """The fused phase-I fit program over one carry
+    ``(critic, tr, critic_local)`` — the standalone jitted form of
+    :func:`_phase1_fits_fused` (registered in
+    ``utils/profiling.py:jit_entry_points`` so the retrace/cost audits
+    cover the fused arm at both compute dtypes)."""
+    critic, tr, critic_local = carry
+    return _phase1_fits_fused(
+        cfg, critic, tr, critic_local, batch, r_coop, ekey, spec
+    )
+
+
+#: The fused cross-flavor fit scan as its own jitted entry point (the
+#: consensus-micro profiler and the lint audits drive it standalone;
+#: inside ``update_block`` the same program is inlined into the epoch).
+fit_block = partial(jax.jit, static_argnums=0)(_fit_block)
+
+
 def critic_tr_epoch(
     cfg: Config,
     carry,
@@ -209,64 +362,75 @@ def critic_tr_epoch(
     traced = spec is not None
 
     # ---- Phase I: local fits -> messages (+ persisted adversary updates)
-    msg_critic, msg_tr = critic, tr  # Faulty default: transmit frozen nets
-    new_critic, new_tr, new_critic_local = critic, tr, critic_local
-
-    if traced or cfg.n_coop:
-        # common_reward applies to cooperative local fits ONLY
-        # (train_agents.py:106)
-        r_team = jnp.broadcast_to(r_coop[None], (N, *r_coop.shape))
-        if traced:
-            r_applied = jnp.where(spec.common_reward, r_team, r_agents)
-        elif cfg.common_reward:
-            r_applied = r_team
-        else:
-            r_applied = r_agents
-        coop_c, _ = jax.vmap(
-            lambda p, r: coop_local_critic_fit(p, s, ns, r, mask, cfg)
-        )(critic, r_applied)
-        coop_t, _ = jax.vmap(lambda p, r: coop_local_tr_fit(p, sa, r, mask, cfg))(
-            tr, r_applied
+    if fitstack_enabled(cfg):
+        # cross-flavor fused scans (Config.fitstack): phase I is
+        # orthogonal to the consensus layout, so the dual phase II
+        # below applies unchanged
+        (
+            msg_critic, msg_tr, new_critic, new_tr, new_critic_local,
+        ) = _phase1_fits_fused(
+            cfg, critic, tr, critic_local, batch, r_coop, ekey, spec
         )
-        m = spec.coop if traced else _role_mask(cfg, Roles.COOPERATIVE)
-        msg_critic = select_tree(m, coop_c, msg_critic)
-        msg_tr = select_tree(m, coop_t, msg_tr)
-        # own nets restored (resilient_CAC_agents.py:120,138): new_* unchanged
+    else:
+        msg_critic, msg_tr = critic, tr  # Faulty default: frozen nets
+        new_critic, new_tr, new_critic_local = critic, tr, critic_local
 
-    k_gc, k_gt, k_ml, k_mc, k_mt = jax.random.split(ekey, 5)
+        if traced or cfg.n_coop:
+            # common_reward applies to cooperative local fits ONLY
+            # (train_agents.py:106)
+            r_team = jnp.broadcast_to(r_coop[None], (N, *r_coop.shape))
+            if traced:
+                r_applied = jnp.where(spec.common_reward, r_team, r_agents)
+            elif cfg.common_reward:
+                r_applied = r_team
+            else:
+                r_applied = r_agents
+            coop_c, _ = jax.vmap(
+                lambda p, r: coop_local_critic_fit(p, s, ns, r, mask, cfg)
+            )(critic, r_applied)
+            coop_t, _ = jax.vmap(
+                lambda p, r: coop_local_tr_fit(p, sa, r, mask, cfg)
+            )(tr, r_applied)
+            m = spec.coop if traced else _role_mask(cfg, Roles.COOPERATIVE)
+            msg_critic = select_tree(m, coop_c, msg_critic)
+            msg_tr = select_tree(m, coop_t, msg_tr)
+            # own nets restored (resilient_CAC_agents.py:120,138):
+            # new_* unchanged
 
-    if traced or cfg.has_role(Roles.GREEDY):
-        greedy_c, _ = jax.vmap(
-            lambda k, p, r: adv_critic_fit(k, p, s, ns, r, mask, cfg)
-        )(jax.random.split(k_gc, N), critic, r_agents)
-        greedy_t, _ = jax.vmap(lambda k, p, r: adv_tr_fit(k, p, sa, r, mask, cfg))(
-            jax.random.split(k_gt, N), tr, r_agents
-        )
-        m = spec.greedy if traced else _role_mask(cfg, Roles.GREEDY)
-        msg_critic = select_tree(m, greedy_c, msg_critic)
-        msg_tr = select_tree(m, greedy_t, msg_tr)
-        new_critic = select_tree(m, greedy_c, new_critic)  # persists
-        new_tr = select_tree(m, greedy_t, new_tr)
+        k_gc, k_gt, k_ml, k_mc, k_mt = jax.random.split(ekey, 5)
 
-    if traced or cfg.has_role(Roles.MALICIOUS):
-        # private critic on own reward (adversarial_CAC_agents.py:137-152)
-        mal_local, _ = jax.vmap(
-            lambda k, p, r: adv_critic_fit(k, p, s, ns, r, mask, cfg)
-        )(jax.random.split(k_ml, N), critic_local, r_agents)
-        # compromised critic/TR toward -r_coop (adversarial:121-135,154-165)
-        neg = jnp.broadcast_to(-r_coop[None], (N, *r_coop.shape))
-        mal_c, _ = jax.vmap(
-            lambda k, p, r: adv_critic_fit(k, p, s, ns, r, mask, cfg)
-        )(jax.random.split(k_mc, N), critic, neg)
-        mal_t, _ = jax.vmap(lambda k, p, r: adv_tr_fit(k, p, sa, r, mask, cfg))(
-            jax.random.split(k_mt, N), tr, neg
-        )
-        m = spec.malicious if traced else _role_mask(cfg, Roles.MALICIOUS)
-        msg_critic = select_tree(m, mal_c, msg_critic)
-        msg_tr = select_tree(m, mal_t, msg_tr)
-        new_critic = select_tree(m, mal_c, new_critic)  # persists
-        new_tr = select_tree(m, mal_t, new_tr)
-        new_critic_local = select_tree(m, mal_local, new_critic_local)
+        if traced or cfg.has_role(Roles.GREEDY):
+            greedy_c, _ = jax.vmap(
+                lambda k, p, r: adv_critic_fit(k, p, s, ns, r, mask, cfg)
+            )(jax.random.split(k_gc, N), critic, r_agents)
+            greedy_t, _ = jax.vmap(
+                lambda k, p, r: adv_tr_fit(k, p, sa, r, mask, cfg)
+            )(jax.random.split(k_gt, N), tr, r_agents)
+            m = spec.greedy if traced else _role_mask(cfg, Roles.GREEDY)
+            msg_critic = select_tree(m, greedy_c, msg_critic)
+            msg_tr = select_tree(m, greedy_t, msg_tr)
+            new_critic = select_tree(m, greedy_c, new_critic)  # persists
+            new_tr = select_tree(m, greedy_t, new_tr)
+
+        if traced or cfg.has_role(Roles.MALICIOUS):
+            # private critic on own reward (adversarial_CAC_agents.py:137-152)
+            mal_local, _ = jax.vmap(
+                lambda k, p, r: adv_critic_fit(k, p, s, ns, r, mask, cfg)
+            )(jax.random.split(k_ml, N), critic_local, r_agents)
+            # compromised critic/TR toward -r_coop (adversarial:121-135,154-165)
+            neg = jnp.broadcast_to(-r_coop[None], (N, *r_coop.shape))
+            mal_c, _ = jax.vmap(
+                lambda k, p, r: adv_critic_fit(k, p, s, ns, r, mask, cfg)
+            )(jax.random.split(k_mc, N), critic, neg)
+            mal_t, _ = jax.vmap(
+                lambda k, p, r: adv_tr_fit(k, p, sa, r, mask, cfg)
+            )(jax.random.split(k_mt, N), tr, neg)
+            m = spec.malicious if traced else _role_mask(cfg, Roles.MALICIOUS)
+            msg_critic = select_tree(m, mal_c, msg_critic)
+            msg_tr = select_tree(m, mal_t, msg_tr)
+            new_critic = select_tree(m, mal_c, new_critic)  # persists
+            new_tr = select_tree(m, mal_t, new_tr)
+            new_critic_local = select_tree(m, mal_local, new_critic_local)
 
     # ---- Phase II: resilient consensus, cooperative agents only
     diag = zero_diag() if with_diag else None
@@ -415,68 +579,81 @@ def _critic_tr_epoch_netstack(
     in_dims = (cfg.obs_dim, cfg.sa_dim)
 
     x2 = netstack_pair_inputs(cfg, s, sa)
-    stack2 = netstack_stack(critic, tr)  # leaves (2, N, ...)
-    # The critic's TD bootstrap V(ns) with the pre-fit weights, computed
-    # ONCE at the unpadded width and reused by every fit pair below (the
-    # dual arm recomputes the identical forward inside each flavor).
-    v_ns = None
-    if traced or cfg.n_coop or cfg.has_role(Roles.GREEDY) or cfg.has_role(
-        Roles.MALICIOUS
-    ):
-        v_ns = jax.vmap(lambda p: mlp_forward(p, ns, dtype=cfg.dot_dtype))(
-            critic
-        )
-
-    def targets2(r):
-        return pair_bootstrap_targets(cfg, critic, ns, r, v=v_ns)
 
     # ---- Phase I: local fits -> messages (+ persisted adversary updates)
-    msg2 = stack2  # Faulty default: transmit frozen nets
-    new2, new_critic_local = stack2, critic_local
-
-    if traced or cfg.n_coop:
-        r_team = jnp.broadcast_to(r_coop[None], (N, *r_coop.shape))
-        if traced:
-            r_applied = jnp.where(spec.common_reward, r_team, r_agents)
-        elif cfg.common_reward:
-            r_applied = r_team
-        else:
-            r_applied = r_agents
-        coop2, _ = coop_pair_fit(stack2, x2, targets2(r_applied), mask, cfg)
-        m = spec.coop if traced else _role_mask(cfg, Roles.COOPERATIVE)
-        msg2 = select_tree(m, coop2, msg2, axis=1)
-        # own nets restored (resilient_CAC_agents.py:120,138): new2 unchanged
-
-    k_gc, k_gt, k_ml, k_mc, k_mt = jax.random.split(ekey, 5)
-
-    if traced or cfg.has_role(Roles.GREEDY):
-        keys2 = jnp.stack(
-            [jax.random.split(k_gc, N), jax.random.split(k_gt, N)]
+    if fitstack_enabled(cfg):
+        # cross-flavor fused scans (Config.fitstack): same fused phase I
+        # as the dual epoch; phase II below still runs on the combined
+        # netstack block
+        (
+            msg_c, msg_t, new_critic, new_tr, new_critic_local,
+        ) = _phase1_fits_fused(
+            cfg, critic, tr, critic_local, batch, r_coop, ekey, spec
         )
-        greedy2, _ = adv_pair_fit(
-            keys2, stack2, x2, targets2(r_agents), mask, cfg
-        )
-        m = spec.greedy if traced else _role_mask(cfg, Roles.GREEDY)
-        msg2 = select_tree(m, greedy2, msg2, axis=1)
-        new2 = select_tree(m, greedy2, new2, axis=1)  # persists
+    else:
+        stack2 = netstack_stack(critic, tr)  # leaves (2, N, ...)
+        # The critic's TD bootstrap V(ns) with the pre-fit weights,
+        # computed ONCE at the unpadded width and reused by every fit
+        # pair below (the dual arm recomputes the identical forward
+        # inside each flavor).
+        v_ns = None
+        if traced or cfg.n_coop or cfg.has_role(Roles.GREEDY) or cfg.has_role(
+            Roles.MALICIOUS
+        ):
+            v_ns = jax.vmap(lambda p: mlp_forward(p, ns, dtype=cfg.dot_dtype))(
+                critic
+            )
 
-    if traced or cfg.has_role(Roles.MALICIOUS):
-        # private critic on own reward (adversarial_CAC_agents.py:137-152)
-        mal_local, _ = jax.vmap(
-            lambda k, p, r: adv_critic_fit(k, p, s, ns, r, mask, cfg)
-        )(jax.random.split(k_ml, N), critic_local, r_agents)
-        # compromised critic/TR toward -r_coop (adversarial:121-135,154-165)
-        neg = jnp.broadcast_to(-r_coop[None], (N, *r_coop.shape))
-        keys2 = jnp.stack(
-            [jax.random.split(k_mc, N), jax.random.split(k_mt, N)]
-        )
-        mal2, _ = adv_pair_fit(keys2, stack2, x2, targets2(neg), mask, cfg)
-        m = spec.malicious if traced else _role_mask(cfg, Roles.MALICIOUS)
-        msg2 = select_tree(m, mal2, msg2, axis=1)
-        new2 = select_tree(m, mal2, new2, axis=1)  # persists
-        new_critic_local = select_tree(m, mal_local, new_critic_local)
+        def targets2(r):
+            return pair_bootstrap_targets(cfg, critic, ns, r, v=v_ns)
 
-    new_critic, new_tr = netstack_split(new2, in_dims)
+        msg2 = stack2  # Faulty default: transmit frozen nets
+        new2, new_critic_local = stack2, critic_local
+
+        if traced or cfg.n_coop:
+            r_team = jnp.broadcast_to(r_coop[None], (N, *r_coop.shape))
+            if traced:
+                r_applied = jnp.where(spec.common_reward, r_team, r_agents)
+            elif cfg.common_reward:
+                r_applied = r_team
+            else:
+                r_applied = r_agents
+            coop2, _ = coop_pair_fit(stack2, x2, targets2(r_applied), mask, cfg)
+            m = spec.coop if traced else _role_mask(cfg, Roles.COOPERATIVE)
+            msg2 = select_tree(m, coop2, msg2, axis=1)
+            # own nets restored (resilient_CAC_agents.py:120,138): new2 unchanged
+
+        k_gc, k_gt, k_ml, k_mc, k_mt = jax.random.split(ekey, 5)
+
+        if traced or cfg.has_role(Roles.GREEDY):
+            keys2 = jnp.stack(
+                [jax.random.split(k_gc, N), jax.random.split(k_gt, N)]
+            )
+            greedy2, _ = adv_pair_fit(
+                keys2, stack2, x2, targets2(r_agents), mask, cfg
+            )
+            m = spec.greedy if traced else _role_mask(cfg, Roles.GREEDY)
+            msg2 = select_tree(m, greedy2, msg2, axis=1)
+            new2 = select_tree(m, greedy2, new2, axis=1)  # persists
+
+        if traced or cfg.has_role(Roles.MALICIOUS):
+            # private critic on own reward (adversarial_CAC_agents.py:137-152)
+            mal_local, _ = jax.vmap(
+                lambda k, p, r: adv_critic_fit(k, p, s, ns, r, mask, cfg)
+            )(jax.random.split(k_ml, N), critic_local, r_agents)
+            # compromised critic/TR toward -r_coop (adversarial:121-135,154-165)
+            neg = jnp.broadcast_to(-r_coop[None], (N, *r_coop.shape))
+            keys2 = jnp.stack(
+                [jax.random.split(k_mc, N), jax.random.split(k_mt, N)]
+            )
+            mal2, _ = adv_pair_fit(keys2, stack2, x2, targets2(neg), mask, cfg)
+            m = spec.malicious if traced else _role_mask(cfg, Roles.MALICIOUS)
+            msg2 = select_tree(m, mal2, msg2, axis=1)
+            new2 = select_tree(m, mal2, new2, axis=1)  # persists
+            new_critic_local = select_tree(m, mal_local, new_critic_local)
+
+        new_critic, new_tr = netstack_split(new2, in_dims)
+        msg_c, msg_t = netstack_split(msg2, in_dims)
 
     # ---- Phase II: resilient consensus, cooperative agents only — on
     # ONE combined (N, n_in, P_critic + P_tr) gathered block
@@ -490,7 +667,6 @@ def _critic_tr_epoch_netstack(
                 "neighborhoods"
             )
         H = spec.H if traced else None
-        msg_c, msg_t = netstack_split(msg2, in_dims)
         nbr = gather_neighbor_messages(cfg, _pair_block(msg_c, msg_t))
         plan = cfg.fault_plan
         if plan is not None and plan.active:

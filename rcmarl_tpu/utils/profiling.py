@@ -48,7 +48,11 @@ def jit_entry_points() -> Dict[str, object]:
     """
     from rcmarl_tpu.parallel.gossip import gossip_mix_block
     from rcmarl_tpu.training.trainer import train_block, train_block_donated
-    from rcmarl_tpu.training.update import update_block, update_block_donated
+    from rcmarl_tpu.training.update import (
+        fit_block,
+        update_block,
+        update_block_donated,
+    )
 
     return {
         "update_block": update_block,
@@ -56,6 +60,7 @@ def jit_entry_points() -> Dict[str, object]:
         "train_block": train_block,
         "train_block_donated": train_block_donated,
         "gossip_mix_block": gossip_mix_block,
+        "fit_block": fit_block,
     }
 
 
@@ -213,6 +218,15 @@ def lowered_entry_points(
                 if name == "gossip_mix_block":
                     params, rnd, excl = gossip_entry_inputs(cfg)
                     lowered = fn.lower(cfg, params, params, rnd, excl)
+                elif name == "fit_block":
+                    p = state.params
+                    lowered = fn.lower(
+                        cfg,
+                        (p.critic, p.tr, p.critic_local),
+                        batch,
+                        team_average_reward(cfg, batch.r),
+                        key,
+                    )
                 elif name.startswith("update_block"):
                     lowered = fn.lower(
                         cfg,
@@ -282,7 +296,18 @@ def _traced_entry(cfg, with_diag: bool, name: str):
             _ENTRY_JAXPR_CACHE[cache_key] = (closed, out_shape)
             return _ENTRY_JAXPR_CACHE[cache_key]
         state, batch, fresh, key = entry_point_inputs(cfg)
-        if name.startswith("update_block"):
+        if name == "fit_block":
+            p = state.params
+            closed, out_shape = jax.make_jaxpr(
+                lambda c, b, rc, k: fn(cfg, c, b, rc, k),
+                return_shape=True,
+            )(
+                (p.critic, p.tr, p.critic_local),
+                batch,
+                team_average_reward(cfg, batch.r),
+                key,
+            )
+        elif name.startswith("update_block"):
             closed, out_shape = jax.make_jaxpr(
                 lambda p, b, f, k: fn(cfg, p, b, f, k, with_diag=with_diag),
                 return_shape=True,
@@ -465,30 +490,44 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
       epoch runs it: with ``cfg.netstack`` one fused
       critic+TR pair update on the combined block, otherwise the two
       per-tree vmapped updates back to back.
-    - ``phase1_fits`` — the cooperative local critic+TR fits that
-      produce the messages, as the epoch runs them: one
-      (net, agent)-vmapped netstack fit, or the two per-tree fits.
+    - ``fit_coop`` / ``fit_adv`` — the phase-I local fits that produce
+      the messages, PER FLAVOR FAMILY and as the active fit arm runs
+      them (``cfg.fitstack`` fused scans, the netstack pair fits, or
+      the dual per-tree fits): ``fit_coop`` is the cooperative
+      full-batch critic+TR family, ``fit_adv`` every adversary
+      minibatch flavor present (greedy pair, malicious compromised
+      pair, malicious private critic). Keys appear only for roles the
+      config actually casts, so a fused-scan win is attributable per
+      flavor. ``phase1_fits`` stays their sum (continuity with the
+      pre-split rows).
     - ``epoch`` — the whole ``critic_tr_epoch`` sub-program (same
       number as :func:`profile_phases`' ``critic_tr_epoch``).
-    - ``epoch_other`` — the residual ``epoch - consensus -
-      phase1_fits``: what the micro components do NOT cover (adversary
-      fits when present, select/mask plumbing, dispatch) so the
-      component shares of an epoch sum to ~100% in PERF.md. Can be
-      slightly negative on tiny configs (standalone timings amortize
-      dispatch differently than the fused epoch).
+    - ``epoch_other`` — the residual ``epoch - gather - consensus -
+      fit_coop - fit_adv``: a TRUE residual (select/mask plumbing,
+      dispatch) now that the gather and every fit flavor are measured
+      components. Can be slightly negative on tiny configs (standalone
+      timings amortize dispatch differently than the fused epoch).
 
     Each component is jitted standalone with host-fetch barriers, like
     the phase profiler. Use :func:`consensus_tags` for the row tags.
     """
     from rcmarl_tpu.agents.updates import (
+        adv_critic_fit,
+        adv_fit_schedule,
+        adv_fused_row_block,
+        adv_pair_fit,
+        adv_tr_fit,
         consensus_update_one,
         consensus_update_pair,
+        coop_fused_fit,
         coop_local_critic_fit,
         coop_local_tr_fit,
         coop_pair_fit,
+        fused_fit_rows,
         netstack_pair_inputs,
         pair_bootstrap_targets,
     )
+    from rcmarl_tpu.config import Roles
     from rcmarl_tpu.models.mlp import netstack_stack
     from rcmarl_tpu.ops.aggregation import _trim_bounds, resolve_impl
     from rcmarl_tpu.training.buffer import update_batch
@@ -497,6 +536,7 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
     from rcmarl_tpu.training.update import (
         _pair_block,
         critic_tr_epoch,
+        fitstack_enabled,
         gather_neighbor_messages,
         netstack_enabled,
         team_average_reward,
@@ -513,9 +553,27 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
     critic, tr = state.params.critic, state.params.tr
     out: Dict[str, float] = {}
 
+    stacked = netstack_enabled(cfg)
+    # the neighbor-message gather AS THE ARM PAYS IT: one combined
+    # (N, n_in, P_c + P_t) block gather on the netstack arm, the two
+    # per-tree gathers on the dual arm — so epoch_other below is a true
+    # residual rather than silently holding half the gather traffic
+    if stacked:
+        gather_arm = jax.jit(
+            lambda c, t: gather_neighbor_messages(cfg, _pair_block(c, t))
+        )
+    else:
+        gather_arm = jax.jit(
+            lambda c, t: (
+                gather_neighbor_messages(cfg, c),
+                gather_neighbor_messages(cfg, t),
+            )
+        )
+    out["gather"] = _timeit(gather_arm, critic, tr, reps=reps)
     gather = jax.jit(lambda t: gather_neighbor_messages(cfg, t))
-    out["gather"] = _timeit(gather, critic, reps=reps)
-    nbr = gather(critic)  # (N, n_in, ...) leaves
+    nbr = gather(
+        critic
+    )  # (N, n_in, ...) leaves — the trim-bound/clip diagnostics' input
 
     # the flattened one-launch layout: ONE (N, n_in, P_total) block
     N, n_in = cfg.n_agents, cfg.n_in
@@ -549,7 +607,6 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
 
     mask = batch.mask
     x2 = netstack_pair_inputs(cfg, batch.s, batch.sa)
-    stacked = netstack_enabled(cfg)
     if stacked:
         # phase II as the netstack epoch runs it: ONE fused pair update
         # over the combined (N, n_in, P_c + P_t) gathered block
@@ -583,39 +640,136 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
         )
 
     r_agents = jnp.moveaxis(batch.r, 1, 0)  # (N, B, 1)
-    if stacked:
-        stack2 = netstack_stack(critic, tr)
-        fits2 = jax.jit(
-            lambda p2, cp, r: coop_pair_fit(
-                p2, x2, pair_bootstrap_targets(cfg, cp, batch.ns, r),
-                mask, cfg,
+    r_coop = team_average_reward(cfg, batch.r)
+    fused = fitstack_enabled(cfg)
+    N = cfg.n_agents
+
+    # ---- fit_coop: the cooperative full-batch critic+TR family, as
+    # the active fit arm runs it (fitstack fused scan / netstack pair
+    # scan / dual per-tree scans)
+    if cfg.n_coop:
+        if fused:
+            fit_coop = jax.jit(
+                lambda c, t, cp, r: coop_fused_fit(
+                    c, t, x2,
+                    pair_bootstrap_targets(cfg, cp, batch.ns, r),
+                    mask, cfg,
+                )[0]
+            )
+            out["fit_coop"] = _timeit(
+                fit_coop, critic, tr, critic, r_agents, reps=reps
+            )
+        elif stacked:
+            fits2 = jax.jit(
+                lambda p2, cp, r: coop_pair_fit(
+                    p2, x2, pair_bootstrap_targets(cfg, cp, batch.ns, r),
+                    mask, cfg,
+                )[0]
+            )
+            out["fit_coop"] = _timeit(
+                fits2, netstack_stack(critic, tr), critic, r_agents,
+                reps=reps,
+            )
+        else:
+
+            def fits(critic_p, tr_p, r):
+                c, _ = jax.vmap(
+                    lambda p, rr: coop_local_critic_fit(
+                        p, batch.s, batch.ns, rr, mask, cfg
+                    )
+                )(critic_p, r)
+                t, _ = jax.vmap(
+                    lambda p, rr: coop_local_tr_fit(p, batch.sa, rr, mask, cfg)
+                )(tr_p, r)
+                return c, t
+
+            out["fit_coop"] = _timeit(
+                jax.jit(fits), critic, tr, r_agents, reps=reps
+            )
+
+    # ---- fit_adv: every adversary minibatch flavor present, as the
+    # active fit arm runs it (the fused arm batches them all into ONE
+    # (flavor·net, agent) scan; the PR-4 arms launch one scan per
+    # flavor pair plus the unpaired private critic)
+    has_greedy = cfg.has_role(Roles.GREEDY)
+    has_mal = cfg.has_role(Roles.MALICIOUS)
+    if has_greedy or has_mal:
+        critic_local = state.params.critic_local
+        neg = jnp.broadcast_to(-r_coop[None], (N, *r_coop.shape))
+
+        def adv_fused(c, t, loc, r, key):
+            # the SAME row assembly the epoch runs (agents.updates owns
+            # it), so the measured fused arm cannot drift from the real one
+            keys, rows, xs, tgts, _ = adv_fused_row_block(
+                cfg, c, t, loc, x2, batch.ns, r, r_coop,
+                jax.random.split(key, 5),
+                has_greedy=has_greedy, has_mal=has_mal,
+            )
+            return fused_fit_rows(
+                keys, rows, xs, tgts, mask, adv_fit_schedule(cfg), cfg
             )[0]
-        )
-        out["phase1_fits"] = _timeit(fits2, stack2, critic, r_agents, reps=reps)
-    else:
 
-        def fits(critic_p, tr_p, r):
-            c, _ = jax.vmap(
-                lambda p, rr: coop_local_critic_fit(
-                    p, batch.s, batch.ns, rr, mask, cfg
-                )
-            )(critic_p, r)
-            t, _ = jax.vmap(
-                lambda p, rr: coop_local_tr_fit(p, batch.sa, rr, mask, cfg)
-            )(tr_p, r)
-            return c, t
+        def adv_pair(c, t, loc, r, key):
+            k_gc, k_gt, k_ml, k_mc, k_mt = jax.random.split(key, 5)
+            stack2 = netstack_stack(c, t)
+            tgt = lambda rr: pair_bootstrap_targets(cfg, c, batch.ns, rr)
+            outs = []
+            if has_greedy:
+                outs.append(adv_pair_fit(
+                    jnp.stack([jax.random.split(k_gc, N),
+                               jax.random.split(k_gt, N)]),
+                    stack2, x2, tgt(r), mask, cfg,
+                )[0])
+            if has_mal:
+                outs.append(adv_pair_fit(
+                    jnp.stack([jax.random.split(k_mc, N),
+                               jax.random.split(k_mt, N)]),
+                    stack2, x2, tgt(neg), mask, cfg,
+                )[0])
+                outs.append(jax.vmap(
+                    lambda k, p, rr: adv_critic_fit(
+                        k, p, batch.s, batch.ns, rr, mask, cfg
+                    )[0]
+                )(jax.random.split(k_ml, N), loc, r))
+            return outs
 
-        out["phase1_fits"] = _timeit(
-            jax.jit(fits), critic, tr, r_agents, reps=reps
+        def adv_dual(c, t, loc, r, key):
+            k_gc, k_gt, k_ml, k_mc, k_mt = jax.random.split(key, 5)
+            fit_c = lambda k, p, rr: adv_critic_fit(
+                k, p, batch.s, batch.ns, rr, mask, cfg
+            )[0]
+            fit_t = lambda k, p, rr: adv_tr_fit(
+                k, p, batch.sa, rr, mask, cfg
+            )[0]
+            outs = []
+            if has_greedy:
+                outs.append(jax.vmap(fit_c)(jax.random.split(k_gc, N), c, r))
+                outs.append(jax.vmap(fit_t)(jax.random.split(k_gt, N), t, r))
+            if has_mal:
+                outs.append(jax.vmap(fit_c)(jax.random.split(k_mc, N), c, neg))
+                outs.append(jax.vmap(fit_t)(jax.random.split(k_mt, N), t, neg))
+                outs.append(jax.vmap(fit_c)(jax.random.split(k_ml, N), loc, r))
+            return outs
+
+        adv_fn = adv_fused if fused else (adv_pair if stacked else adv_dual)
+        out["fit_adv"] = _timeit(
+            jax.jit(adv_fn), critic, tr, critic_local, r_agents, key,
+            reps=reps,
         )
+
+    out["phase1_fits"] = out.get("fit_coop", 0.0) + out.get("fit_adv", 0.0)
 
     # the whole epoch + the residual the micro components don't cover
-    r_coop = team_average_reward(cfg, batch.r)
     epoch = jax.jit(
         lambda p, b, rc, k: critic_tr_epoch(
             cfg, (p.critic, p.tr, p.critic_local), b, rc, k
         )
     )
     out["epoch"] = _timeit(epoch, state.params, batch, r_coop, key, reps=reps)
-    out["epoch_other"] = out["epoch"] - out["consensus"] - out["phase1_fits"]
+    out["epoch_other"] = (
+        out["epoch"]
+        - out["gather"]
+        - out["consensus"]
+        - out["phase1_fits"]
+    )
     return out

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""The bf16 accuracy/parity cell: measured returns-curve agreement of
+``compute_dtype='bfloat16'`` against the bitwise-f32 reference arm.
+
+The bf16 compute arm narrows ONLY the matmul inputs (f32 accumulation,
+params/optimizer state stay f32 — ``models/mlp.py:dot``), so the gate
+it must pass is behavioral, not bitwise: trained on the same seed and
+schedule, the bf16 returns curve must reach the f32 arm's own converged
+quality band. This script runs the two arms, scores them with the SAME
+smoothing/threshold machinery QUALITY.md uses
+(:mod:`rcmarl_tpu.analysis.quality`), and commits the verdict to
+``simulation_results/bf16_parity.json`` — which
+``python -m rcmarl_tpu quality`` then renders into QUALITY.md's
+"Mixed precision (bfloat16)" section.
+
+    python scripts/bf16_parity.py [--episodes 2000] [--seed 300]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from datetime import datetime
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--episodes", type=int, default=2000)
+    p.add_argument("--seed", type=int, default=300)
+    p.add_argument("--rolling", type=int, default=200)
+    p.add_argument("--window", type=int, default=400,
+                   help="final-window size for the converged-return mean")
+    p.add_argument("--tol", type=float, default=0.05,
+                   help="quality-band tolerance (PARITY.md's 5%% default)")
+    p.add_argument(
+        "--out", type=str,
+        default=str(Path(__file__).resolve().parent.parent
+                    / "simulation_results/bf16_parity.json"),
+    )
+    args = p.parse_args()
+
+    import jax
+    import numpy as np
+    import pandas as pd
+
+    from rcmarl_tpu.analysis.quality import episodes_to_threshold
+    from rcmarl_tpu.config import Config
+    from rcmarl_tpu.training.trainer import train
+
+    base = Config(seed=args.seed)  # the reference 5-agent cooperative ring
+    arms = {}
+    for dtype in ("float32", "bfloat16"):
+        cfg = base.replace(compute_dtype=dtype)
+        t0 = time.perf_counter()
+        _, df = train(cfg, n_episodes=args.episodes)
+        arms[dtype] = {
+            "df": df,
+            "wall_s": round(time.perf_counter() - t0, 2),
+        }
+        print(f"{dtype}: {args.episodes} eps in {arms[dtype]['wall_s']}s")
+
+    def curve(df) -> pd.Series:
+        return (
+            df["True_team_returns"]
+            .rolling(args.rolling, min_periods=args.rolling)
+            .mean()
+        )
+
+    def final(df) -> float:
+        return float(df["True_team_returns"].iloc[-args.window:].mean())
+
+    f32, b16 = arms["float32"], arms["bfloat16"]
+    final32, final16 = final(f32["df"]), final(b16["df"])
+    # the quality bar is the f32 arm's OWN converged return, relaxed by
+    # tol of its magnitude — exactly the QUALITY.md threshold recipe,
+    # with the f32 arm standing in for the reference
+    threshold = final32 - args.tol * abs(final32)
+    ep32 = episodes_to_threshold(curve(f32["df"]), threshold)
+    ep16 = episodes_to_threshold(curve(b16["df"]), threshold)
+    tail32 = curve(f32["df"]).iloc[-args.window:]
+    tail16 = curve(b16["df"]).iloc[-args.window:]
+    tail_dev = float(np.nanmax(np.abs(tail32.values - tail16.values)))
+
+    result = {
+        "config": {
+            "scenario": "coop ref5_ring (Config defaults)",
+            "n_agents": base.n_agents,
+            "hidden": list(base.hidden),
+            "episodes": args.episodes,
+            "seed": args.seed,
+            "rolling": args.rolling,
+            "window": args.window,
+            "tol": args.tol,
+        },
+        "f32_final": round(final32, 4),
+        "bf16_final": round(final16, 4),
+        "threshold": round(threshold, 4),
+        "ep_to_threshold_f32": None if np.isnan(ep32) else int(ep32),
+        "ep_to_threshold_bf16": None if np.isnan(ep16) else int(ep16),
+        "tail_max_abs_dev": round(tail_dev, 4),
+        "bf16_within_band": bool(final16 >= threshold),
+        "wall_s": {k: v["wall_s"] for k, v in arms.items()},
+        "platform": jax.devices()[0].platform,
+        "timestamp": datetime.now().isoformat(timespec="seconds"),
+    }
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(result, indent=1) + "\n")
+    print(json.dumps(result, indent=1))
+    print(f"wrote {out}")
+    # the parity GATE: the bf16 arm must land inside the f32 arm's own
+    # quality band — a nonzero rc makes this scriptable in CI/sessions
+    return 0 if result["bf16_within_band"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -83,6 +83,53 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
     --sanitize --summary_dir "$smoke_dir" --quiet
 echo "netstack ragged smoke cell OK"
 
+# Fused-fit + bf16 smoke cell: the cross-flavor fused fit scan
+# (Config.fitstack) must stay BITWISE the PR-4 arm through the real
+# trainer on a mixed cast (every fit flavor live) — on the clean
+# regular graph AND on a ragged+faulted+sanitize cell (the acceptance
+# cells; the ragged twin of the pytest pin rides the slow marker to
+# keep the tier-1 wall budget, so it is CI-enforced here instead) —
+# and the bfloat16 compute arm must train end-to-end with finite
+# returns curves. The fitstack/compute_dtype wire-up (Config -> epoch
+# -> fused scans -> trainer) beyond what the unit pins cover layer by
+# layer.
+timeout -k 10 420 env JAX_PLATFORMS=cpu python - <<'PY'
+import numpy as np, jax
+from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+from rcmarl_tpu.faults import FaultPlan
+from rcmarl_tpu.training.trainer import train
+
+kw = dict(
+    n_agents=3,
+    agent_roles=(Roles.COOPERATIVE, Roles.GREEDY, Roles.MALICIOUS),
+    in_nodes=circulant_in_nodes(3, 3), nrow=3, ncol=3,
+    n_episodes=4, n_ep_fixed=2, max_ep_len=4, n_epochs=2, H=1,
+)
+ragged = dict(
+    kw,
+    n_agents=4,
+    agent_roles=(Roles.COOPERATIVE,) * 2 + (Roles.GREEDY, Roles.MALICIOUS),
+    in_nodes=((0, 1, 2, 3), (1, 2, 3, 0), (2, 3, 0), (3, 0, 1)),
+    consensus_sanitize=True,
+    fault_plan=FaultPlan(drop_p=0.2, nan_p=0.2, stale_p=0.1),
+)
+for cell, c in (("regular", kw), ("ragged+faulted", ragged)):
+    s_on, df_on = train(Config(**c, fitstack=True))
+    s_off, df_off = train(Config(**c, fitstack=False))
+    for a, b in zip(
+        jax.tree.leaves(s_on.params), jax.tree.leaves(s_off.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(
+        df_on["True_team_returns"].values, df_off["True_team_returns"].values
+    )
+    print(f"fitstack bitwise pin OK ({cell})")
+_, df16 = train(Config(**kw, fitstack=True, compute_dtype="bfloat16"))
+assert np.isfinite(df16["True_team_returns"].values).all()
+print("finite bf16 curves OK")
+PY
+echo "fused-fit + bf16 smoke cell OK"
+
 # Gossip chaos cell: 4 learner replicas, one ALWAYS-NaN-bombing
 # Byzantine replica (replica 3) under trimmed-mean gossip (gossip_H=1)
 # with the per-replica guard — the replica-level resilience wire-up end

@@ -19,8 +19,12 @@
 #      (PERF.jsonl; completes PERF.md's table), plus (2b) the netstack
 #      on/off A/B — the one-block critic+TR epoch vs the dual-launch
 #      arm, the on-chip confirmation of PERF.md's "netstack" CPU table
-#   3. a bfloat16 row for the 256-wide config (the MXU-native compute
-#      mode; its float32 comparator is step 1's n64_large_h2/xla row)
+#   2c. the fitstack x compute_dtype refit arms: the cross-flavor fused
+#      fit scan (fitstack on/off) x {f32, bf16} with the per-flavor
+#      fit_coop/fit_adv micro split — the on-chip measurement the
+#      fitstack='auto' backend policy and the bf16 arm are queued for
+#   3. bfloat16 + fused-fit rows for the 256-wide config (the MXU-native
+#      compute mode; float32/per-flavor comparator arms included)
 #   4. the fused experiment matrix at the published scale - 16 cells x
 #      3 seeds x 2x4000 episodes as ONE program per phase (writes a
 #      sweep tree under /tmp, we only need the printed wall-clock)
@@ -70,10 +74,17 @@ run_step "2b. netstack A/B rows (one-block epoch vs dual-launch arm)" \
     --netstack on off \
     --consensus_micro --out PERF.jsonl
 
-run_step "3. bfloat16 row (256-wide config)" \
+run_step "2c. fitstack x compute_dtype refit arms (fused fit scan A/B)" \
+    timeout 3600 python -m rcmarl_tpu profile \
+    --configs ref5_ring n16_mixed n64_full \
+    --fitstack on off --compute_dtype float32 bfloat16 \
+    --consensus_micro --out PERF.jsonl
+
+run_step "3. bfloat16 rows (256-wide config + fused-fit arm)" \
     timeout 1800 python -m rcmarl_tpu bench \
     --configs n64_large_h2 --impl xla \
-    --compute_dtype bfloat16 --out BENCH_SCALING.jsonl
+    --fitstack on off \
+    --compute_dtype float32 bfloat16 --out BENCH_SCALING.jsonl
 
 run_step "4. fused published matrix, one program per phase" \
     timeout 5400 python -m rcmarl_tpu sweep --fused \

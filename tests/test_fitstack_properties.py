@@ -1,0 +1,350 @@
+"""Fitstack contracts: the cross-flavor fused fit scan
+(``Config.fitstack``) pinned leaf-for-leaf BITWISE against the PR-4
+phase-I arms, and the bf16 compute arm's cache hygiene.
+
+Three layers:
+
+1. Primitive twins (hypothesis): the unified minibatch step body of
+   ``ops/fit.py`` reproduces ``fit_mse_full_batch`` bitwise under the
+   identity plan (a full batch IS one minibatch covering the buffer)
+   and ``fit_mse_minibatch`` bitwise under the shuffle plan, across
+   ragged masks and partial final batches; the stacked
+   ``fused_fit_scan`` reproduces its per-row fits bitwise (batching
+   rows is value-neutral); ``assume_valid`` never changes a plan.
+2. Block equivalence (deterministic): ``update_block`` with
+   ``fitstack=True`` equals ``fitstack=False`` leaf for leaf across
+   mixed adversary casts, ragged+faulted graphs, both netstack arms,
+   and the traced-spec (fused-matrix) path.
+3. The bf16 arm: compiling/running ``compute_dtype='bfloat16'``
+   programs in the same process leaves the f32 arm's outputs BITWISE
+   unchanged (compute_dtype is jit-static — distinct caches, no dtype
+   leakage), while the bf16 outputs themselves are finite and really
+   do come from a narrowed program (they differ from f32).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from rcmarl_tpu.agents.updates import Batch
+from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+from rcmarl_tpu.faults import FaultPlan
+from rcmarl_tpu.models.mlp import (
+    init_mlp,
+    mlp_forward,
+    netstack_split_rows,
+    netstack_stack_rows,
+)
+from rcmarl_tpu.ops.fit import (
+    FitSchedule,
+    fit_mse_full_batch,
+    fit_mse_minibatch,
+    fit_mse_sched,
+    fused_fit_scan,
+    valid_first_shuffle,
+)
+from rcmarl_tpu.training.update import (
+    fitstack_enabled,
+    init_agent_params,
+    spec_from_config,
+    update_block,
+)
+
+BASE = dict(
+    n_agents=5,
+    agent_roles=(Roles.COOPERATIVE,) * 3 + (Roles.GREEDY, Roles.MALICIOUS),
+    in_nodes=circulant_in_nodes(5, 4),
+    H=1,
+    n_epochs=2,
+    hidden=(8, 8),
+    coop_fit_steps=3,
+    adv_fit_epochs=2,
+    adv_fit_batch=8,
+    batch_size=8,
+)
+
+RAGGED = ((0, 1, 2, 3), (1, 2, 3), (2, 3, 4, 0), (3, 4, 0), (4, 0, 1))
+
+PLAN = FaultPlan(
+    drop_p=0.1, stale_p=0.2, corrupt_p=0.2, flip_p=0.1, nan_p=0.05, inf_p=0.05
+)
+
+
+def _mk_batch(key, cfg, B, full=False):
+    ks = jax.random.split(key, 4)
+    return Batch(
+        s=jax.random.normal(ks[0], (B, cfg.n_agents, cfg.n_states)),
+        ns=jax.random.normal(ks[1], (B, cfg.n_agents, cfg.n_states)),
+        a=jax.random.randint(
+            ks[2], (B, cfg.n_agents, 1), 0, cfg.n_actions
+        ).astype(jnp.float32),
+        r=jax.random.normal(ks[3], (B, cfg.n_agents, 1)),
+        mask=jnp.ones((B,), jnp.float32)
+        if full
+        else (jnp.arange(B) < B - 3).astype(jnp.float32),
+    )
+
+
+def _run_block(cfg, spec=None):
+    params = init_agent_params(jax.random.PRNGKey(0), cfg)
+    batch = _mk_batch(jax.random.PRNGKey(1), cfg, 40)
+    fresh = _mk_batch(jax.random.PRNGKey(2), cfg, 16, full=True)
+    return update_block(cfg, params, batch, fresh, jax.random.PRNGKey(3), spec)
+
+
+def _assert_tree_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# --------------------------------------------------------------------------
+# 1. Primitive twins
+# --------------------------------------------------------------------------
+
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+    from hypothesis.extra.numpy import arrays
+
+    HAVE_HYPOTHESIS = True
+except ImportError:  # pragma: no cover - exercised by bare environments
+    HAVE_HYPOTHESIS = False
+
+if HAVE_HYPOTHESIS:
+    finite = st.floats(-10.0, 10.0, allow_nan=False, width=32)
+
+    @st.composite
+    def fit_case(draw):
+        """(in_dim, hidden, B, x, target, mask, seed) with a RAGGED
+        validity tail (0..B-1 invalid trailing rows; at least one row
+        valid)."""
+        in_dim = draw(st.integers(1, 5))
+        hidden = tuple(
+            draw(st.lists(st.integers(1, 5), min_size=0, max_size=2))
+        )
+        B = draw(st.integers(2, 12))
+        x = draw(arrays(np.float32, (B, in_dim), elements=finite))
+        target = draw(arrays(np.float32, (B, 1), elements=finite))
+        n_valid = draw(st.integers(1, B))
+        mask = (np.arange(B) < n_valid).astype(np.float32)
+        seed = draw(st.integers(0, 2**16))
+        return in_dim, hidden, B, x, target, mask, seed
+
+    @settings(deadline=None, max_examples=10)
+    @given(fit_case(), st.integers(1, 3))
+    def test_identity_plan_fit_is_bitwise_full_batch(case, n_steps):
+        """The unified minibatch body under the identity plan (one
+        batch covering the buffer) == fit_mse_full_batch, params AND
+        loss bitwise — the contract that lets the fused scan run the
+        cooperative flavor through the shared step body."""
+        in_dim, hidden, B, x, target, mask, seed = case
+        params = init_mlp(jax.random.PRNGKey(seed), in_dim, hidden, 1)
+        x, target, mask = jnp.asarray(x), jnp.asarray(target), jnp.asarray(mask)
+        fwd = lambda p, xx: mlp_forward(p, xx)
+        ref_p, ref_loss = fit_mse_full_batch(
+            params, fwd, x, target, mask, n_steps, 0.05
+        )
+        sched = FitSchedule(epochs=n_steps, batch_size=B, shuffle=False)
+        got_p, got_loss = fit_mse_sched(
+            jnp.zeros((2,), jnp.uint32),  # never consumed
+            params, fwd, x, target, mask, sched, 0.05,
+        )
+        _assert_tree_equal(ref_p, got_p)
+        np.testing.assert_array_equal(np.asarray(ref_loss), np.asarray(got_loss))
+
+    @settings(deadline=None, max_examples=10)
+    @given(fit_case(), st.integers(1, 3), st.integers(1, 7))
+    def test_sched_fit_is_bitwise_minibatch(case, epochs, batch_size):
+        """The schedule form of the minibatch fit == fit_mse_minibatch
+        for arbitrary ragged masks and partial final batches."""
+        in_dim, hidden, B, x, target, mask, seed = case
+        params = init_mlp(jax.random.PRNGKey(seed), in_dim, hidden, 1)
+        x, target, mask = jnp.asarray(x), jnp.asarray(target), jnp.asarray(mask)
+        key = jax.random.PRNGKey(seed + 1)
+        fwd = lambda p, xx: mlp_forward(p, xx)
+        ref_p, ref_loss = fit_mse_minibatch(
+            key, params, fwd, x, target, mask, epochs, batch_size, 0.05
+        )
+        got_p, got_loss = fit_mse_sched(
+            key, params, fwd, x, target, mask,
+            FitSchedule(epochs=epochs, batch_size=batch_size, shuffle=True),
+            0.05,
+        )
+        _assert_tree_equal(ref_p, got_p)
+        np.testing.assert_array_equal(np.asarray(ref_loss), np.asarray(got_loss))
+
+    @settings(deadline=None, max_examples=6)
+    @given(fit_case(), st.integers(2, 4), st.booleans())
+    def test_fused_rows_match_per_row_fits(case, n_rows, shuffle):
+        """Stacking R rows into one fused scan is value-neutral: every
+        row's fitted params == the same fit run alone (mixed input
+        widths exercise the first-layer zero-padding)."""
+        in_dim, hidden, B, x, target, mask, seed = case
+        wide = in_dim + 2
+        keys = jax.random.split(jax.random.PRNGKey(seed), n_rows + 1)
+        # alternate narrow (padded) and wide rows — the critic/TR mix
+        dims = [in_dim if r % 2 == 0 else wide for r in range(n_rows)]
+        nets = [
+            jax.vmap(lambda k: init_mlp(k, d, hidden, 1))(
+                jax.random.split(keys[r], 2)  # N=2 agents
+            )
+            for r, d in enumerate(dims)
+        ]
+        x = jnp.asarray(x)
+        xw = jnp.pad(x, ((0, 0), (0, 2)), constant_values=0.5)
+        xs = jnp.stack([
+            jnp.pad(x, ((0, 0), (0, 2))) if d == in_dim else xw for d in dims
+        ])
+        tgt = jnp.broadcast_to(jnp.asarray(target), (n_rows, 2, B, 1))
+        mask = jnp.asarray(mask)
+        rkeys = jax.vmap(lambda k: jax.random.split(k, 2))(
+            jax.random.split(keys[-1], n_rows)
+        )
+        sched = FitSchedule(
+            epochs=2, batch_size=(5 if shuffle else B), shuffle=shuffle
+        )
+        fwd = lambda p, xx: mlp_forward(p, xx)
+        fused, losses = fused_fit_scan(
+            rkeys, netstack_stack_rows(nets), fwd, xs, tgt, mask, sched, 0.05
+        )
+        parts = netstack_split_rows(fused, dims)
+        for r, d in enumerate(dims):
+            ref, ref_loss = jax.vmap(
+                lambda k, p, t: fit_mse_sched(
+                    k, p, fwd, xs[r][:, :d] if d == in_dim else xs[r],
+                    t, mask, sched, 0.05,
+                )
+            )(rkeys[r], nets[r], tgt[r])
+            # the narrow rows ran PADDED inside the fused scan; trim is
+            # the lossless inverse (pad rows carry exact zeros)
+            _assert_tree_equal(parts[r], ref)
+            np.testing.assert_array_equal(
+                np.asarray(losses[r]), np.asarray(ref_loss)
+            )
+
+
+def test_assume_valid_shuffle_is_bitwise():
+    """The assume_valid fast path (rows with no invalid tail skip the
+    valid-first penalty work) returns the IDENTICAL plan."""
+    for cap, n_b, bs in ((13, 4, 4), (8, 1, 8), (20, 3, 7)):
+        key = jax.random.PRNGKey(cap)
+        mask = jnp.ones((cap,), jnp.float32)
+        idx_a, val_a = valid_first_shuffle(key, mask, n_b, bs)
+        idx_b, val_b = valid_first_shuffle(
+            key, mask, n_b, bs, assume_valid=True
+        )
+        np.testing.assert_array_equal(np.asarray(idx_a), np.asarray(idx_b))
+        np.testing.assert_array_equal(np.asarray(val_a), np.asarray(val_b))
+
+
+# --------------------------------------------------------------------------
+# 2. Block equivalence
+# --------------------------------------------------------------------------
+
+
+class TestBlockEquivalence:
+    """update_block(fitstack=True) == update_block(fitstack=False),
+    leaf for leaf — the PR-4 arms stay the bitwise reference."""
+
+    #: the full matrix (5-agent mixed cast, ragged+faulted, netstack-on,
+    #: H=0, sort arm) rides the slow marker to keep the 870s tier-1
+    #: wall budget; tier-1 keeps a TINY all-flavor pin below, and the
+    #: 3-agent mixed + ragged+faulted fused pins ALSO run end-to-end in
+    #: ci_tier1.sh's fused-fit smoke cell, so they stay CI-enforced
+    SLOW_MODES = {
+        "mixed_cast": {},
+        "ragged_sanitize_faults": dict(
+            in_nodes=RAGGED, consensus_sanitize=True, fault_plan=PLAN
+        ),
+        "netstack_on": dict(netstack=True),
+        "h0": dict(H=0),
+        "xla_sort": dict(consensus_impl="xla_sort"),
+    }
+
+    @pytest.mark.slow
+    def test_pinned_leaf_for_leaf_tiny_all_flavors(self):
+        """The fused-vs-PR-4 block pin on a 3-agent cast with one agent
+        of EVERY adversarial role, so both fused groups (full-batch
+        coop pair + all 5 minibatch flavor rows) are live. Slow-marked
+        for the tier-1 wall budget; the SAME pin runs end-to-end in
+        ci_tier1.sh's fused-fit smoke cell on every CI run."""
+        kw = dict(
+            BASE,
+            n_agents=3,
+            agent_roles=(Roles.COOPERATIVE, Roles.GREEDY, Roles.MALICIOUS),
+            in_nodes=circulant_in_nodes(3, 3),
+            hidden=(4,),
+        )
+        on = _run_block(Config(**kw, fitstack=True))
+        off = _run_block(Config(**kw, fitstack=False))
+        _assert_tree_equal(on, off)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("mode", sorted(SLOW_MODES))
+    def test_pinned_leaf_for_leaf_extended(self, mode):
+        kw = dict(BASE)
+        kw.update(self.SLOW_MODES[mode])
+        on = _run_block(Config(**kw, fitstack=True))
+        off = _run_block(Config(**kw, fitstack=False))
+        _assert_tree_equal(on, off)
+
+    @pytest.mark.slow
+    def test_traced_spec(self):
+        """The fused-matrix path: fused fits under a traced CellSpec ==
+        the PR-4 arm under the same spec."""
+        cfg_on = Config(**BASE, fitstack=True)
+        cfg_off = Config(**BASE, fitstack=False)
+        on = _run_block(cfg_on, spec_from_config(cfg_on))
+        off = _run_block(cfg_off, spec_from_config(cfg_off))
+        _assert_tree_equal(on, off)
+
+    def test_auto_policy_resolves_by_backend(self):
+        """fitstack='auto' (the Config default) mirrors the
+        netstack='auto' measured backend policy."""
+        cfg = Config(**BASE)
+        assert cfg.fitstack == "auto"
+        expected = jax.default_backend() == "tpu"
+        assert fitstack_enabled(cfg) == expected
+        assert fitstack_enabled(cfg.replace(fitstack=True)) is True
+        assert fitstack_enabled(cfg.replace(fitstack=False)) is False
+        with pytest.raises(ValueError, match="fitstack"):
+            Config(**BASE, fitstack="sideways")
+
+
+# --------------------------------------------------------------------------
+# 3. The bf16 arm: no dtype leakage across jit caches
+# --------------------------------------------------------------------------
+
+
+def test_bf16_rows_do_not_perturb_f32_outputs():
+    """f32 reference outputs are BITWISE unchanged when bfloat16
+    programs compile and run in the same process (compute_dtype is
+    jit-static: distinct caches, zero cross-contamination), and the
+    bf16 arm itself is live (finite outputs that differ from f32)."""
+    kw = dict(
+        BASE,
+        n_agents=3,
+        agent_roles=(Roles.COOPERATIVE,) * 3,
+        in_nodes=circulant_in_nodes(3, 3),
+        hidden=(4,),
+    )
+    cfg32 = Config(**kw, fitstack=True)
+    cfg16 = Config(**kw, fitstack=True, compute_dtype="bfloat16")
+    first = _run_block(cfg32)
+    bf16 = _run_block(cfg16)
+    again = _run_block(cfg32)
+    _assert_tree_equal(first, again)
+    leaves16 = jax.tree.leaves(bf16)
+    assert all(bool(jnp.all(jnp.isfinite(l))) for l in leaves16)
+    # params/optimizer state stay f32 — only the matmul INPUTS narrow
+    # (integer leaves, e.g. Adam's step counter, are exempt)
+    assert all(
+        l.dtype == jnp.float32
+        for l in leaves16
+        if jnp.issubdtype(l.dtype, jnp.floating)
+    )
+    assert any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(first), leaves16)
+    ), "bfloat16 arm produced bitwise-f32 results: the dtype is not threaded"

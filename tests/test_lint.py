@@ -120,9 +120,21 @@ class TestPackageClean:
 
 class TestRetraceAuditor:
     def test_exactly_once_compilation_both_arms(self):
-        """The `lint --retrace` mode: guarded+faulted tiny runs on both
-        netstack arms plus a clean donated run compile nothing after
-        their warmup block."""
+        """The `lint --retrace` mode: guarded+faulted tiny runs on the
+        dual and stacked (netstack+fitstack) arms plus a clean donated
+        run compile nothing after their warmup block. The alternating
+        f32/bf16 fused-fit case rides the slow twin below and the CI
+        graftlint cell (tier-1 wall budget)."""
+        from rcmarl_tpu.lint.retrace import audit_retrace
+
+        findings = audit_retrace(fitstack_dtypes=False)
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+    @pytest.mark.slow
+    def test_exactly_once_compilation_alternating_dtypes(self):
+        """The full audit incl. the alternating f32/bf16 fused-fit
+        case: exactly one compile per compute_dtype, zero steady-state
+        recompiles across alternation."""
         from rcmarl_tpu.lint.retrace import audit_retrace
 
         findings = audit_retrace()
@@ -309,10 +321,13 @@ class TestCollectiveCensus:
 
         if len(jax.devices()) < 4:
             pytest.skip("census needs >= 4 (virtual) devices")
-        # the seeds programs; the matrix program rides the slow
-        # committed-ledger test and the CI graftlint cell
+        # the base seeds programs; the matrix program AND the
+        # seeds@sharded+fitstack variant ride the slow committed-ledger
+        # test and the CI graftlint cell (tier-1 wall budget)
         programs = {
-            k: v for k, v in _census_programs().items() if k.startswith("seeds")
+            k: v
+            for k, v in _census_programs().items()
+            if k in ("seeds@unsharded", "seeds@sharded")
         }
         rows, findings, notes, skipped = census_rows(programs)
         assert findings == [] and notes == [] and skipped == set()

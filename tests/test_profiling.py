@@ -58,27 +58,53 @@ def test_profile_phases_covers_training_subprograms():
 def test_profile_consensus_covers_components_and_tags():
     """The consensus micro-breakdown: one timing per component the
     crossover policies tune, plus the (n_in, H, volume) tags refits key
-    on — for both trim strategies and both netstack arms. epoch_other is
-    a signed residual (epoch - consensus - phase1_fits) and may be
-    slightly negative on tiny configs, so only the true timings are
+    on — for both trim strategies, both netstack arms, and the fused
+    fitstack arm. Phase-I fits are split per flavor family (fit_coop /
+    fit_adv, the keys the fused-scan A/B attributes wins by;
+    phase1_fits stays their sum), and epoch_other is a signed TRUE
+    residual (epoch - gather - consensus - fit_coop - fit_adv) that may
+    be slightly negative on tiny configs, so only the true timings are
     required positive."""
-    for impl, netstack in (
-        ("xla", True),
-        ("xla", False),
-        ("xla_sort", True),
+    coop_only = (Roles.COOPERATIVE,) * 3
+    for impl, netstack, roles in (
+        # the production dual arm with a greedy cast: full key set,
+        # fit_adv measured through the per-flavor scans
+        ("xla", False, None),
+        # the netstack-pair and sort-strategy micro paths on the
+        # cheaper all-coop cast (fit_adv keyed out)
+        ("xla", True, coop_only),
+        ("xla_sort", True, coop_only),
     ):
         cfg = tiny_cfg().replace(consensus_impl=impl, netstack=netstack)
-        times = profile_consensus(cfg, reps=1)
-        assert set(times) == {
-            "gather",
-            "trim_bounds",
-            "clip_mean",
-            "consensus",
-            "phase1_fits",
-            "epoch",
-            "epoch_other",
-        }
-        assert all(v > 0 for k, v in times.items() if k != "epoch_other")
+        if roles is not None:
+            cfg = cfg.replace(agent_roles=roles)
+        _check_micro_keys(profile_consensus(cfg, reps=1), adv=roles is None)
+
+
+@pytest.mark.slow
+def test_profile_consensus_fitstack_arm():
+    """The same micro-breakdown on the fused cross-flavor fit arm
+    (fit_coop/fit_adv measured through the fused scans)."""
+    cfg = tiny_cfg().replace(fitstack=True)
+    _check_micro_keys(profile_consensus(cfg, reps=1), adv=True)
+
+
+def _check_micro_keys(times, adv):
+    # fit_adv appears exactly when the config casts adversary roles
+    assert set(times) == {
+        "gather",
+        "trim_bounds",
+        "clip_mean",
+        "consensus",
+        "fit_coop",
+        "phase1_fits",
+        "epoch",
+        "epoch_other",
+    } | ({"fit_adv"} if adv else set())
+    assert times["phase1_fits"] == times["fit_coop"] + times.get(
+        "fit_adv", 0.0
+    )
+    assert all(v > 0 for k, v in times.items() if k != "epoch_other")
     tags = consensus_tags(tiny_cfg())
     assert tags["n_in"] == 2 and tags["H"] == 0 and tags["n_agents"] == 3
     assert tags["volume"] == 6
