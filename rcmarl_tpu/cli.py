@@ -102,6 +102,14 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         "nrow-1, reference grid_world.py:55); only matters when nrow != ncol",
     )
     p.add_argument(
+        "--hidden",
+        nargs="+",
+        type=int,
+        default=[20, 20],
+        help="hidden layer widths of every net (reference default: 20 20; "
+        "the BASELINE scale-out configs widen this)",
+    )
+    p.add_argument(
         "--scenario",
         type=str,
         default=None,
@@ -385,6 +393,7 @@ def config_from_args(args) -> Config:
         nrow=args.nrow,
         ncol=args.ncol,
         reference_clip=args.reference_clip,
+        hidden=tuple(getattr(args, "hidden", None) or (20, 20)),
         seed=getattr(args, "random_seed", 300),
         consensus_impl=args.consensus_impl,
         consensus_layout=getattr(args, "consensus_layout", "flat"),
@@ -1206,7 +1215,7 @@ def cmd_bench(argv) -> int:
     from rcmarl_tpu.parallel.seeds import make_mesh, train_parallel
     from rcmarl_tpu.training.update import fitstack_enabled, netstack_enabled
     from rcmarl_tpu.training.trainer import init_train_state, train_scanned
-    from rcmarl_tpu.utils.profiling import Timer
+    from rcmarl_tpu.utils.profiling import Timer, mesh_fingerprint
 
     shard_modes = [None] if args.shard_agents is None else args.shard_agents
     n_failed = 0
@@ -1311,6 +1320,11 @@ def cmd_bench(argv) -> int:
                     else {
                         "shard_agents": bool(shard),
                         "mesh_devices": len(jax.devices()),
+                        # ties the row to the mesh it actually executed
+                        # on (device count + axis sizes), next to the
+                        # program hash — MULTICHIP evidence without it
+                        # can't distinguish a 2-chip from a pod mesh
+                        "mesh_fingerprint": mesh_fingerprint(mesh),
                     }
                 ),
                 "cost_fingerprint": fingerprint,
@@ -1571,11 +1585,35 @@ def cmd_lint(argv) -> int:
         "(rcmarl_tpu.lint.collectives)",
     )
     p.add_argument(
+        "--sharding",
+        action="store_true",
+        help="also run the sharding arm over the seed×agent programs "
+        "and the sharded gossip mix at mesh sizes {1,2,8}: big-operand "
+        "sharding annotations audited off the compiled SPMD modules "
+        "(sharding-replicated / sharding-reshard-chain), per-device "
+        "memory_analysis() gated vs the AUDIT.jsonl device_memory rows "
+        "and required to SHRINK with mesh size "
+        "(device-memory-regression), and the determinism census over "
+        "entry-point lowerings + all six aggregation backends + the "
+        "compiled sharded modules (nondeterminism) "
+        "(rcmarl_tpu.lint.sharding)",
+    )
+    p.add_argument(
+        "--contract",
+        action="store_true",
+        help="also run the Config⇄CLI⇄docs contract pass: every Config "
+        "field reachable from a CLI flag (or exempted with a reason), "
+        "surviving the checkpoint-header JSON round-trip, and present "
+        "in the docs/api.md table — contract-drift with the field's "
+        "config.py line (rcmarl_tpu.lint.contract)",
+    )
+    p.add_argument(
         "--baseline",
         type=str,
         default="AUDIT.jsonl",
-        help="the committed cost/collective ledger the --cost and "
-        "--collectives gates compare against (default: ./AUDIT.jsonl); "
+        help="the committed cost/collective/device-memory ledger the "
+        "--cost/--collectives/--sharding gates compare against "
+        "(default: ./AUDIT.jsonl); "
         "on gate failure the fresh ledger is written to <baseline>.new "
         "so the diff is one click away",
     )
@@ -1599,7 +1637,7 @@ def cmd_lint(argv) -> int:
         "--all",
         action="store_true",
         help="shorthand for --retrace --donation --backends --cost "
-        "--collectives",
+        "--collectives --sharding --contract",
     )
     p.add_argument(
         "--rules",
@@ -1627,9 +1665,9 @@ def cmd_lint(argv) -> int:
 
     any_audit = (
         args.retrace or args.donation or args.backends or args.cost
-        or args.collectives or args.all
+        or args.collectives or args.sharding or args.contract or args.all
     )
-    if args.collectives or args.all:
+    if args.collectives or args.sharding or args.all:
         # The collective census needs a multi-device mesh. Mirror
         # tests/conftest.py: force a virtual 8-device host platform.
         # XLA reads this at BACKEND INIT, not jax import, so setting it
@@ -1707,6 +1745,36 @@ def cmd_lint(argv) -> int:
             findings += f
             gate_findings += len(f)
             fresh_rows += rows
+        notes += nts
+        n_sections += 1
+    if args.sharding or args.all:
+        from rcmarl_tpu.lint.sharding import (
+            audit_determinism,
+            audit_sharding,
+            sharding_rows,
+        )
+
+        if args.write_baseline:
+            # the shrink/replication/reshard invariants still enforced
+            rows, f, nts, skipped = sharding_rows()
+            findings += f
+            fresh_rows += rows
+            skipped_entries |= skipped
+        else:
+            f, nts, rows = audit_sharding(args.baseline, args.cost_tol)
+            findings += f
+            gate_findings += len(f)
+            fresh_rows += rows
+        notes += nts
+        df, dnts = audit_determinism()
+        findings += df
+        notes += dnts
+        n_sections += 1
+    if args.contract or args.all:
+        from rcmarl_tpu.lint.contract import audit_contract
+
+        f, nts = audit_contract()
+        findings += f
         notes += nts
         n_sections += 1
     if args.write_baseline and fresh_rows:

@@ -23,7 +23,8 @@ static-unhashable  jit-static configs stay hashable (frozen-dataclass
 ================== ====================================================
 
 **Layer 2 — compiled-artifact audits** (import jax, run real tiny
-programs; ``lint --retrace/--donation/--backends/--cost/--collectives``):
+programs; ``lint --retrace/--donation/--backends/--cost/--collectives/
+--sharding/--contract``):
 
 ================== ====================================================
 retrace            each jitted entry point compiles exactly once after
@@ -50,6 +51,25 @@ collective-census  the sharded seed×agent programs' collective set /
 host-transfer      a device->host transfer (infeed/outfeed/host memory
                    space/host callback) inside a compiled train block
                    (:mod:`.collectives`)
+sharding-replicated a parameter/optimizer/rollout-buffer-sized operand
+                   of a compiled sharded program carries a replicated/
+                   maximal sharding instead of a mesh-axis one
+                   (:mod:`.sharding`)
+sharding-reshard-chain back-to-back resharding: one collective feeds
+                   another, moving the same buffer twice per block
+                   (:mod:`.sharding`)
+device-memory-regression per-device peak/argument bytes fail to shrink
+                   with mesh size {1,2,8}, or grew past --cost_tol vs
+                   the AUDIT.jsonl device-memory rows (:mod:`.sharding`)
+nondeterminism     nondeterministic HLO in a walked module: a float-
+                   accumulating scatter with unique_indices=false, a
+                   non-threefry rng-bit-generator / legacy rng op, or a
+                   cross-replica op outside the certified collective
+                   allowlist (:mod:`.sharding`)
+contract-drift     a Config field unreachable from any CLI flag (and
+                   not exempted), failing the checkpoint-header JSON
+                   round-trip, or missing from the docs/api.md table
+                   (:mod:`.contract`)
 ================== ====================================================
 
 Escape hatch for Layer 1: ``# lint: disable=<rule>`` on the flagged
@@ -103,6 +123,11 @@ AUDIT_RULES = (
     "cost-unbaselined",
     "collective-census",
     "host-transfer",
+    "sharding-replicated",
+    "sharding-reshard-chain",
+    "device-memory-regression",
+    "nondeterminism",
+    "contract-drift",
 )
 
 _PASSES = (prng.run, hostsync.run, staticargs.run)

@@ -1,0 +1,830 @@
+"""Sharding / per-device memory / determinism audits — pod-scale proof.
+
+ROADMAP item 2 promotes the ``mesh={'seed', 'agent'}`` programs from
+dryrun to a real multi-chip pod. On this 1-core host those programs
+have only ever EXECUTED unsharded (MULTICHIP_r05), so three claims the
+promotion rests on have never been machine-checked:
+
+1. **The big buffers actually shard.** A silently replicated parameter
+   / optimizer / replay-ring operand costs a whole TPU session to
+   discover at pod scale. This arm parses the sharding annotations off
+   the compiled SPMD modules (entry operands carry their per-shard
+   shape + ``sharding={...}`` in the partitioned HLO) and fires
+   ``sharding-replicated`` when any operand above
+   :data:`SHARDING_MIN_BYTES` carries a ``replicated`` or ``maximal``
+   sharding under a >1-device mesh, and ``sharding-reshard-chain`` when
+   one collective feeds another (through ``-done``/copy/reshape
+   pass-throughs) — the same buffer moved twice per block.
+
+2. **Per-device memory shrinks with the mesh.** The machine-checked
+   form of "pod-ready": XLA's ``memory_analysis()`` of the partitioned
+   module is PER-DEVICE, so compiling the same program at mesh sizes
+   :data:`MESH_POINTS` = {1, 2, 8} and extracting
+   argument/output/temp/peak bytes into canonical ``AUDIT.jsonl`` rows
+   (kind ``device_memory``, same fingerprint/byte-stability discipline
+   as the cost arm) turns scaling into a CI invariant:
+   ``device-memory-regression`` fires when per-device peak or argument
+   bytes fail to shrink from the 1-device mesh to the largest, grow
+   along the mesh ladder, or grow past ``--cost_tol`` vs the ledger.
+
+3. **The compiled programs are deterministic.** Every prior PR's
+   equivalence evidence is leaf-for-leaf BITWISE; one
+   implementation-defined op breaks it silently. The determinism
+   census walks the entry points' StableHLO lowerings, all six
+   aggregation backends, and the compiled sharded modules for
+   nondeterministic HLO — float-accumulating scatters with
+   ``unique_indices=false`` (duplicate-index ordering is
+   implementation-defined), non-threefry ``rng_bit_generator`` /
+   legacy ``rng`` ops, and cross-replica ops outside the enumerated
+   collective allowlist — and fires ``nondeterminism``.
+
+All three join ``lint --all`` / ``--write_baseline``; hosts without
+enough (virtual) devices for a mesh point note-and-skip, never pass.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from rcmarl_tpu.lint.findings import Finding
+
+#: Operand-size floor (bytes, PER SHARD) for the replication audit: big
+#: enough to skip the legitimately replicated scalars (ring pointers,
+#: block counters, PRNG keys), small enough that every parameter /
+#: optimizer-moment / replay-ring leaf of the canonical audit configs is
+#: covered. A replicated buffer's per-shard bytes are its FULL bytes —
+#: exactly the per-device cost the rule polices.
+SHARDING_MIN_BYTES = 4096
+
+#: The mesh ladder the device-memory ledger measures: per-device peak
+#: must shrink monotonically 1 -> 2 -> 8 (the 8-device point is the
+#: virtual-host stand-in for a pod slice).
+MESH_POINTS = (1, 2, 8)
+
+#: Minimum shrink of per-device peak/argument bytes from the 1-device
+#: mesh to the largest: strictly below 1.0x (any real sharding shrinks
+#: the dominant buffers by the axis extent; a flat curve means the big
+#: operands replicated).
+SHRINK_BELOW = 1.0
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+_ANCHORS = {
+    "seeds": "rcmarl_tpu/parallel/seeds.py",
+    "matrix": "rcmarl_tpu/parallel/matrix.py",
+    "gossip": "rcmarl_tpu/parallel/gossip.py",
+}
+
+
+def _anchor_for(entry: str) -> str:
+    return _ANCHORS.get(
+        entry.split("@", 1)[0], "rcmarl_tpu/lint/sharding.py"
+    )
+
+
+# --------------------------------------------------------------------------
+# HLO sharding-annotation parsing
+# --------------------------------------------------------------------------
+
+#: Entry-computation operands of a partitioned module:
+#: ``%p = f32[2,2000,2,2]{3,2,1,0} parameter(37), sharding={devices=
+#: [1,1,2,1]<=[2]}, metadata={op_name="s.buffer.s"}`` — the shape is
+#: the PER-SHARD shape, the annotation the global sharding, op_name the
+#: pytree path. Only annotated parameters match (sub-computation
+#: parameters carry neither sharding nor metadata).
+_PARAM_RE = re.compile(
+    r"%\S+ = (\w+)\[([\d,]*)\]\S* parameter\(\d+\)"
+    r", sharding=\{([^}]*)\}"
+    r"(?:, metadata=\{[^}]*op_name=\"([^\"]*)\"[^}]*\})?"
+)
+
+
+def sharded_parameters(hlo_text: str) -> List[dict]:
+    """Every sharding-annotated entry operand of a compiled module:
+    ``{path, dtype, bytes (per shard), sharding, kind}`` with ``kind``
+    in ``'replicated'`` / ``'maximal'`` / ``'sharded'``."""
+    out: List[dict] = []
+    for m in _PARAM_RE.finditer(hlo_text):
+        dtype, dims, sharding, path = m.groups()
+        if dtype not in _DTYPE_BYTES:
+            continue  # token / opaque types carry no audit-relevant bytes
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        kind = (
+            "replicated"
+            if sharding.strip() == "replicated"
+            else "maximal"
+            if sharding.strip().startswith("maximal")
+            else "sharded"
+        )
+        out.append(
+            {
+                "path": path or "<unnamed>",
+                "dtype": dtype,
+                "bytes": n * _DTYPE_BYTES[dtype],
+                "sharding": sharding.strip(),
+                "kind": kind,
+            }
+        )
+    return out
+
+
+def replicated_big_operands(
+    hlo_text: str, min_bytes: int = SHARDING_MIN_BYTES
+) -> List[dict]:
+    """The operands the sharding audit flags: parameter/optimizer/
+    rollout-buffer-sized (>= ``min_bytes`` per shard) yet carrying a
+    replicated or maximal sharding instead of a mesh-axis one."""
+    return [
+        p
+        for p in sharded_parameters(hlo_text)
+        if p["kind"] in ("replicated", "maximal") and p["bytes"] >= min_bytes
+    ]
+
+
+# --------------------------------------------------------------------------
+# Reshard-chain detection
+# --------------------------------------------------------------------------
+
+#: Every cross-replica HLO op kind the walkers know about — the ONE
+#: name list the chain detector, its ``-done`` pass-through set, and
+#: the determinism census's broad scan all derive from, so a newly
+#: taught kind is visible to all three at once.
+_COLLECTIVE_KINDS = (
+    "all-gather",
+    "all-reduce",
+    "collective-permute",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-broadcast",
+    "ragged-all-to-all",
+)
+
+_KINDS_ALT = "|".join(_COLLECTIVE_KINDS)
+
+_COLL_DEF_RE = re.compile(
+    r"%([\w\.\-]+)\s*=\s*.*?\s(" + _KINDS_ALT + r")(?:-start)?\("
+)
+
+#: Ops a buffer flows through unchanged between two collectives —
+#: following these keeps a ``collective -> copy -> collective`` chain
+#: visible while an intervening compute op (a real consumer) breaks it.
+_PASSTHROUGH_RE = re.compile(
+    r"%([\w\.\-]+)\s*=\s*.*?\s(?:copy|bitcast|bitcast-convert|"
+    r"reshape|transpose|convert|get-tuple-element|"
+    r"(?:" + _KINDS_ALT + r")-done)\("
+)
+
+_NAME_RE = re.compile(r"%([\w\.\-]+)")
+_ATTR_NAME_RE = re.compile(r"\w+=\s*%([\w\.\-]+)")
+
+
+def _operand_names(line: str) -> List[str]:
+    """The %names a line's op consumes (result and attr references —
+    ``to_apply=%add`` etc. — excluded)."""
+    head, _, rest = line.partition("(")
+    attr_refs = set(_ATTR_NAME_RE.findall(line))
+    result = _NAME_RE.findall(head)[:1]
+    return [
+        n
+        for n in _NAME_RE.findall(rest)
+        if n not in attr_refs and n not in result
+    ]
+
+
+def reshard_chains(hlo_text: str) -> List[str]:
+    """Collective ops fed (through ``-done``/copy/reshape pass-throughs)
+    by another collective's result — the same buffer resharded more
+    than once per block. Returns the offending HLO lines, trimmed."""
+    coll: Dict[str, str] = {}
+    alias: Dict[str, str] = {}
+    lines = hlo_text.splitlines()
+    for line in lines:
+        m = _COLL_DEF_RE.search(line)
+        if m:
+            coll[m.group(1)] = m.group(2)
+        m = _PASSTHROUGH_RE.search(line)
+        if m:
+            ops = _operand_names(line)
+            if ops:
+                alias[m.group(1)] = ops[0]
+
+    def resolve(name: str) -> str:
+        for _ in range(16):
+            if name in coll or name not in alias:
+                return name
+            name = alias[name]
+        return name
+
+    hits: List[str] = []
+    for line in lines:
+        m = _COLL_DEF_RE.search(line)
+        if not m:
+            continue
+        for op in _operand_names(line):
+            src = resolve(op)
+            if src in coll and src != m.group(1):
+                hits.append(line.strip()[:160])
+                break
+    return hits
+
+
+# --------------------------------------------------------------------------
+# Program table + compile memo
+# --------------------------------------------------------------------------
+
+
+def _seeds_mesh(n: int):
+    from rcmarl_tpu.parallel.seeds import make_mesh
+
+    return make_mesh(n, seed_axis=1 if n < 8 else 2)
+
+
+def _gossip_cfg():
+    """The gossip sharding variant: 8 replicas (so every mesh point in
+    :data:`MESH_POINTS` tiles the replica axis evenly) on the canonical
+    full graph with the Byzantine NaN replica keeping sanitize live."""
+    from rcmarl_tpu.lint.configs import tiny_gossip_cfg
+
+    return tiny_gossip_cfg(replicas=8)
+
+
+def _sharding_programs() -> Dict[str, tuple]:
+    """entry -> (config, mesh_factory(n) -> Mesh, build(mesh) ->
+    Lowered).
+
+    The Mesh is built ONCE per rung and handed to the builder, and the
+    ledger row's ``mesh``/``mesh_fingerprint`` are derived from that
+    same Mesh object — the row can never describe a mesh the program
+    did not compile on. Builders are thunks so a too-small host can
+    note-and-skip a single rung without paying any tracing. The
+    canonical configs are the census/gossip audit shapes, so the
+    sharded programs audited here are the ones the collective census
+    already pins.
+    """
+    from rcmarl_tpu.config import Roles
+    from rcmarl_tpu.lint.configs import census_cfg
+    from rcmarl_tpu.parallel.gossip import lower_gossip_mix
+    from rcmarl_tpu.parallel.matrix import lower_matrix
+    from rcmarl_tpu.parallel.seeds import lower_parallel, make_mesh
+
+    cfg = census_cfg()
+    mal = cfg.replace(
+        agent_roles=(Roles.COOPERATIVE,) * 3 + (Roles.MALICIOUS,)
+    )
+    gcfg = _gossip_cfg()
+    return {
+        "seeds@sharded": (
+            cfg,
+            _seeds_mesh,
+            lambda mesh: lower_parallel(cfg, [0, 1], 1, mesh, True),
+        ),
+        "matrix@sharded": (
+            cfg,
+            _seeds_mesh,
+            lambda mesh: lower_matrix(
+                cfg, [cfg, mal], [0, 1], 1, mesh, True
+            ),
+        ),
+        "gossip@sharded": (
+            gcfg,
+            lambda n: make_mesh(n, seed_axis=n),
+            lambda mesh: lower_gossip_mix(gcfg, mesh),
+        ),
+    }
+
+
+#: (entry, config fingerprint, mesh fingerprint) -> (compiled text,
+#: metric dict, program fp, mesh fp, mesh dict) — one compile per rung
+#: per process, shared by the ledger rows, the replication/chain audit,
+#: and the determinism census's compiled walk. The config and mesh
+#: fingerprints in the key mean an overriding ``programs=`` table that
+#: reuses an entry name with a different config/mesh (the planted
+#: regression tests) can never be served another program's cache line.
+_COMPILE_MEMO: dict = {}
+
+
+def _compiled_at(entry: str, cfg_fp: str, build, mesh):
+    from rcmarl_tpu.utils.profiling import (
+        mesh_fingerprint,
+        program_fingerprint,
+    )
+
+    mesh_fp = mesh_fingerprint(mesh)
+    key = (entry, cfg_fp, mesh_fp)
+    if key not in _COMPILE_MEMO:
+        lowered = build(mesh)
+        compiled = lowered.compile()
+        mem = compiled.memory_analysis()
+        metrics = None
+        if mem is not None:
+            arg = float(getattr(mem, "argument_size_in_bytes", 0))
+            out = float(getattr(mem, "output_size_in_bytes", 0))
+            tmp = float(getattr(mem, "temp_size_in_bytes", 0))
+            alias = float(getattr(mem, "alias_size_in_bytes", 0))
+            metrics = {
+                "argument_bytes": arg,
+                "output_bytes": out,
+                "temp_bytes": tmp,
+                "alias_bytes": alias,
+                "peak_bytes": arg + out + tmp - alias,
+            }
+        _COMPILE_MEMO[key] = (
+            compiled.as_text(),
+            metrics,
+            program_fingerprint(lowered),
+            mesh_fp,
+            {k: int(v) for k, v in dict(mesh.shape).items()},
+        )
+    return _COMPILE_MEMO[key]
+
+
+# --------------------------------------------------------------------------
+# Rows + unconditional findings
+# --------------------------------------------------------------------------
+
+
+def sharding_rows(
+    programs=None, mesh_points: Sequence[int] = MESH_POINTS
+) -> Tuple[List[dict], List[Finding], List[str], set]:
+    """Compile the sharded programs at every mesh rung; extract ledger
+    rows and the baseline-free invariant findings.
+
+    Returns ``(rows, findings, notes, skipped entry names)``. Findings
+    hold with or without a ledger: ``sharding-replicated`` /
+    ``sharding-reshard-chain`` on any >1-device rung, and the
+    per-device shrink invariant (:func:`shrink_findings`) over the
+    rungs this host could measure. ``programs`` overrides the default
+    table (the planted-regression tests feed deliberately bad programs
+    through the same pipeline).
+    """
+    import jax
+
+    from rcmarl_tpu.utils.profiling import config_fingerprint
+
+    rows: List[dict] = []
+    findings: List[Finding] = []
+    notes: List[str] = []
+    skipped: set = set()
+    n_dev_host = len(jax.devices())
+    if programs is None:
+        programs = _sharding_programs()
+    for entry, (cfg, mesh_factory, build) in programs.items():
+        anchor = _anchor_for(entry)
+        fp = config_fingerprint(cfg)
+        for n in mesh_points:
+            row_entry = f"{entry}@mesh{n}"
+            if n > n_dev_host:
+                notes.append(
+                    f"{row_entry}: needs {n} devices, host has "
+                    f"{n_dev_host}; per-device memory unverifiable here"
+                )
+                skipped.add(row_entry)
+                continue
+            text, metrics, program_fp, mesh_fp, mesh_dict = _compiled_at(
+                entry, fp, build, mesh_factory(n)
+            )
+            if n > 1:
+                for p in replicated_big_operands(text):
+                    findings.append(
+                        Finding(
+                            "sharding-replicated",
+                            anchor,
+                            1,
+                            f"{row_entry}: operand {p['path']} "
+                            f"({p['bytes']} bytes/shard, {p['dtype']}) "
+                            f"carries {p['kind']} sharding "
+                            f"'{p['sharding']}' instead of a mesh-axis "
+                            "sharding — at pod scale every device pays "
+                            "its full bytes",
+                        )
+                    )
+                for line in reshard_chains(text)[:5]:
+                    findings.append(
+                        Finding(
+                            "sharding-reshard-chain",
+                            anchor,
+                            1,
+                            f"{row_entry}: a collective feeds another "
+                            f"collective (the same buffer resharded "
+                            f"twice per block): {line}",
+                        )
+                    )
+            if metrics is None:
+                notes.append(
+                    f"{row_entry}: platform exposes no memory analysis; "
+                    "per-device memory unverifiable here"
+                )
+                skipped.add(row_entry)
+                continue
+            rows.append(
+                {
+                    "v": 1,
+                    "kind": "device_memory",
+                    "entry": row_entry,
+                    "fingerprint": fp,
+                    "program": program_fp,
+                    "mesh_fingerprint": mesh_fp,
+                    "mesh": mesh_dict,
+                    "platform": jax.devices()[0].platform,
+                    "jax": jax.__version__,
+                    "metrics": metrics,
+                }
+            )
+    findings += shrink_findings(rows, mesh_points)
+    return rows, findings, notes, skipped
+
+
+def shrink_findings(
+    rows: Sequence[dict], mesh_points: Sequence[int] = MESH_POINTS
+) -> List[Finding]:
+    """The pod-readiness invariant over fresh rows (no baseline needed):
+    along the measured mesh ladder, per-device peak bytes must never
+    grow from one rung to the next, and both peak and argument bytes at
+    the largest measured rung must be strictly below the 1-device
+    point. A flat or rising curve means the big operands replicate and
+    a pod would pay single-host memory on every chip."""
+    from rcmarl_tpu.lint.cost import COST_TOLERANCE
+
+    findings: List[Finding] = []
+    by_base: Dict[str, Dict[int, dict]] = {}
+    for r in rows:
+        if r.get("kind") != "device_memory":
+            continue
+        base, _, mesh = r["entry"].rpartition("@mesh")
+        by_base.setdefault(base, {})[int(mesh)] = r
+    for base, ladder in by_base.items():
+        anchor = _anchor_for(base)
+        measured = sorted(n for n in ladder if n in mesh_points)
+        for a, b in zip(measured, measured[1:]):
+            pa = ladder[a]["metrics"]["peak_bytes"]
+            pb = ladder[b]["metrics"]["peak_bytes"]
+            if pb > pa * (1.0 + COST_TOLERANCE):
+                findings.append(
+                    Finding(
+                        "device-memory-regression",
+                        anchor,
+                        1,
+                        f"{base}: per-device peak GREW along the mesh "
+                        f"ladder ({a} -> {b} devices: {pa:.0f} -> "
+                        f"{pb:.0f} bytes) — sharding is losing, not "
+                        "winning, memory",
+                    )
+                )
+        if len(measured) >= 2 and measured[0] == 1:
+            lo, hi = measured[0], measured[-1]
+            for metric in ("peak_bytes", "argument_bytes"):
+                v1 = ladder[lo]["metrics"][metric]
+                vh = ladder[hi]["metrics"][metric]
+                if vh >= v1 * SHRINK_BELOW:
+                    findings.append(
+                        Finding(
+                            "device-memory-regression",
+                            anchor,
+                            1,
+                            f"{base}: per-device {metric} fails to "
+                            f"shrink with mesh size ({v1:.0f} bytes on "
+                            f"1 device vs {vh:.0f} on {hi}) — the big "
+                            "buffers are replicated, not sharded",
+                        )
+                    )
+    return findings
+
+
+# --------------------------------------------------------------------------
+# Ledger gate
+# --------------------------------------------------------------------------
+
+_GATED = ("argument_bytes", "output_bytes", "temp_bytes", "peak_bytes")
+
+
+def compare_device_memory(
+    baseline: Sequence[dict],
+    fresh: Sequence[dict],
+    tol: Optional[float] = None,
+    skipped=frozenset(),
+) -> Tuple[List[Finding], List[str]]:
+    """Diff fresh device-memory rows against the committed ledger —
+    the cost arm's discipline (growth past ``tol`` is
+    ``device-memory-regression``; missing/fingerprint-mismatched/stale
+    rows are ``cost-unbaselined``; platform mismatches and shrinks are
+    notes; ``skipped`` entries are exempt from the stale-row check)."""
+    from rcmarl_tpu.lint.cost import COST_TOLERANCE, _grew
+
+    tol = COST_TOLERANCE if tol is None else tol
+    findings: List[Finding] = []
+    notes: List[str] = []
+    base_by_entry = {
+        r["entry"]: r for r in baseline if r.get("kind") == "device_memory"
+    }
+    fresh_entries = set()
+    for row in fresh:
+        entry = row["entry"]
+        fresh_entries.add(entry)
+        anchor = _anchor_for(entry)
+        base = base_by_entry.get(entry)
+        if base is None:
+            findings.append(
+                Finding(
+                    "cost-unbaselined",
+                    anchor,
+                    1,
+                    f"{entry}: no device-memory row in the baseline "
+                    "ledger — regenerate and commit AUDIT.jsonl in this "
+                    "PR (lint --sharding --write_baseline)",
+                )
+            )
+            continue
+        if base.get("fingerprint") != row.get("fingerprint") or base.get(
+            "mesh_fingerprint"
+        ) != row.get("mesh_fingerprint"):
+            findings.append(
+                Finding(
+                    "cost-unbaselined",
+                    anchor,
+                    1,
+                    f"{entry}: canonical audit config or mesh changed "
+                    f"(ledger {base.get('fingerprint')}/"
+                    f"{base.get('mesh_fingerprint')} != "
+                    f"{row.get('fingerprint')}/"
+                    f"{row.get('mesh_fingerprint')}); regenerate "
+                    "AUDIT.jsonl",
+                )
+            )
+            continue
+        if base.get("platform") != row.get("platform"):
+            notes.append(
+                f"{entry}: ledger measured on {base.get('platform')!r}, "
+                f"running on {row.get('platform')!r}; per-device memory "
+                "not comparable here"
+            )
+            continue
+        for metric in _GATED:
+            old = float(base["metrics"].get(metric, 0.0))
+            new = float(row["metrics"].get(metric, 0.0))
+            if _grew(old, new, tol):
+                ratio = new / old if old else float("inf")
+                findings.append(
+                    Finding(
+                        "device-memory-regression",
+                        anchor,
+                        1,
+                        f"{entry}: per-device {metric} grew {old:.0f} "
+                        f"-> {new:.0f} ({ratio:.3f}x > 1+{tol:g} "
+                        "tolerance) without a ledger update",
+                    )
+                )
+            elif _grew(new, old, tol):
+                notes.append(
+                    f"{entry}: per-device {metric} shrank {old:.0f} -> "
+                    f"{new:.0f}; refresh AUDIT.jsonl to lock the "
+                    "improvement in"
+                )
+    for entry in sorted(set(base_by_entry) - fresh_entries - set(skipped)):
+        findings.append(
+            Finding(
+                "cost-unbaselined",
+                _anchor_for(entry),
+                1,
+                f"{entry}: device-memory ledger row has no current "
+                "counterpart (entry removed or renamed); regenerate "
+                "AUDIT.jsonl",
+            )
+        )
+    return findings, notes
+
+
+def audit_sharding(
+    baseline_path="AUDIT.jsonl", tol: Optional[float] = None
+) -> Tuple[List[Finding], List[str], List[dict]]:
+    """``lint --sharding`` (ledger half): (findings, notes, fresh rows).
+    Invariant findings (replication, reshard chains, failure to shrink)
+    plus the per-device memory gate against the committed ledger."""
+    from rcmarl_tpu.lint.cost import read_ledger
+
+    fresh, findings, notes, skipped = sharding_rows()
+    baseline = read_ledger(baseline_path)
+    if not baseline:
+        notes.append(
+            f"baseline ledger {baseline_path} missing or empty; every "
+            "device-memory row below reports unbaselined"
+        )
+    cmp_findings, cmp_notes = compare_device_memory(
+        baseline, fresh, tol, skipped
+    )
+    return findings + cmp_findings, notes + cmp_notes, fresh
+
+
+# --------------------------------------------------------------------------
+# Determinism census
+# --------------------------------------------------------------------------
+
+#: Cross-replica ops certified deterministic for these programs: the
+#: collective census's enumerated pod-readiness set plus the matrix
+#: program's ledger-pinned all-to-all reshards. Anything else found in
+#: a walked module is an uncertified communication op — a
+#: ``nondeterminism`` finding, not a count to baseline.
+DETERMINISM_COLLECTIVE_ALLOWLIST = frozenset(
+    {
+        "all-gather",
+        "all-reduce",
+        "collective-permute",
+        "reduce-scatter",
+        "all-to-all",
+    }
+)
+
+_BROAD_COLLECTIVE_RE = re.compile(
+    r"\s(" + _KINDS_ALT + r")(?:-start)?\("
+)
+
+#: StableHLO float-arithmetic combiner ops whose accumulation order is
+#: observable in the result bits (min/max/overwrite are order-safe).
+_SCATTER_ARITH_RE = re.compile(
+    r"stablehlo\.(add|subtract|multiply|divide)\s.*tensor<(f16|bf16|"
+    r"f32|f64)>"
+)
+
+_RNG_BIT_RE = re.compile(r"rng[-_]bit[-_]generator")
+_LEGACY_RNG_RE = re.compile(r"(stablehlo\.rng\s)|(\srng\()")
+
+
+def nondeterministic_ops(
+    text: str, compiled: bool = False
+) -> List[str]:
+    """The nondeterminism hazards in one module's text.
+
+    ``compiled=False`` walks a StableHLO lowering (scatters keep their
+    ``unique_indices`` attribute and combiner regions there — the
+    partitioned/optimized module may have expanded them); ``True``
+    walks compiled HLO (where uncertified collectives appear).
+    Returns human-readable hazard descriptions, empty = clean.
+    """
+    hits: List[str] = []
+    lines = text.splitlines()
+    for i, line in enumerate(lines):
+        if _RNG_BIT_RE.search(line) and not re.search(
+            r"three[-_ ]?fry", line, re.IGNORECASE
+        ):
+            hits.append(
+                "non-threefry rng_bit_generator (run-to-run/"
+                f"cross-backend bits not pinned): {line.strip()[:140]}"
+            )
+        if _LEGACY_RNG_RE.search(line):
+            hits.append(
+                f"legacy stateful rng op: {line.strip()[:140]}"
+            )
+        if not compiled and "stablehlo.scatter" in line:
+            if "unique_indices = false" in line:
+                # the combiner region follows on the next few lines;
+                # float accumulation there is order-dependent exactly
+                # when indices may repeat
+                for j in range(i + 1, min(i + 8, len(lines))):
+                    if _SCATTER_ARITH_RE.search(lines[j]):
+                        hits.append(
+                            "float-accumulating scatter with "
+                            "unique_indices=false (duplicate-index "
+                            "order is implementation-defined): "
+                            f"{line.strip()[:140]}"
+                        )
+                        break
+                    if "stablehlo.return" in lines[j]:
+                        break
+        if compiled:
+            m = _BROAD_COLLECTIVE_RE.search(line)
+            if m and m.group(1) not in DETERMINISM_COLLECTIVE_ALLOWLIST:
+                hits.append(
+                    f"cross-replica op {m.group(1)!r} outside the "
+                    f"certified collective allowlist: {line.strip()[:140]}"
+                )
+    return hits
+
+
+def _determinism_lowering_walk() -> Tuple[List[Finding], List[str]]:
+    """StableHLO walk of the jitted entry points (every cost-arm
+    config, via the shared memoized lowering caches — free inside
+    ``lint --all``) and all six aggregation backends."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rcmarl_tpu.lint.configs import (
+        tiny_cfg,
+        tiny_faulted_cfg,
+        tiny_gossip_cfg,
+        tiny_mixed_cfg,
+    )
+    from rcmarl_tpu.lint.cost import _anchor_for as cost_anchor
+    from rcmarl_tpu.ops.aggregation import (
+        AUDIT_BACKEND_MODES,
+        resilient_aggregate_tree,
+    )
+    from rcmarl_tpu.utils.profiling import lowered_entry_points
+
+    findings: List[Finding] = []
+    notes: List[str] = []
+    arms = {
+        "dual": (tiny_cfg(netstack=False), False,
+                 ("update_block", "train_block")),
+        "stacked": (tiny_cfg(netstack=True), False,
+                    ("update_block", "train_block")),
+        "guarded": (tiny_faulted_cfg(False), True,
+                    ("update_block", "train_block")),
+        "fitstack": (tiny_mixed_cfg(fitstack=True), False,
+                     ("update_block", "train_block", "fit_block")),
+        "gossip": (tiny_gossip_cfg(), False, ("gossip_mix_block",)),
+    }
+    for arm, (cfg, with_diag, names) in arms.items():
+        for name, low in lowered_entry_points(cfg, with_diag, names).items():
+            for hit in nondeterministic_ops(low.as_text(), compiled=False):
+                findings.append(
+                    Finding(
+                        "nondeterminism",
+                        cost_anchor(name),
+                        1,
+                        f"{name}@{arm}: {hit}",
+                    )
+                )
+    tree = {
+        "w": jnp.ones((5, 3, 4), jnp.float32),
+        "b": jnp.ones((5, 7), jnp.float32),
+    }
+    valid = jnp.asarray(np.array([1.0, 1.0, 1.0, 1.0, 0.0]), jnp.float32)
+    for name, recipe in AUDIT_BACKEND_MODES:
+        kwargs = {"impl": recipe["impl"], "sanitize": True}
+        H = jnp.asarray(1, jnp.int32) if recipe.get("traced_h") else 1
+        if recipe.get("masked"):
+            kwargs["valid"] = valid
+        try:
+            low = jax.jit(
+                lambda t, kw=kwargs, h=H: resilient_aggregate_tree(
+                    t, h, **kw
+                )
+            ).lower(tree)
+        except Exception as e:  # noqa: BLE001 — e.g. real Pallas on CPU
+            notes.append(
+                f"aggregation[{name}]: not lowerable on this platform "
+                f"({type(e).__name__}); determinism unverifiable here"
+            )
+            continue
+        for hit in nondeterministic_ops(low.as_text(), compiled=False):
+            findings.append(
+                Finding(
+                    "nondeterminism",
+                    "rcmarl_tpu/ops/aggregation.py",
+                    1,
+                    f"aggregation[{name}]: {hit}",
+                )
+            )
+    return findings, notes
+
+
+def _determinism_compiled_walk() -> Tuple[List[Finding], List[str]]:
+    """Compiled-HLO walk of the sharded programs (via the sharding
+    arm's compile memo — free when the ledger half already ran) at the
+    largest mesh rung this host can build."""
+    import jax
+
+    findings: List[Finding] = []
+    notes: List[str] = []
+    n_dev = len(jax.devices())
+    measurable = [n for n in MESH_POINTS if n <= n_dev]
+    if not measurable:
+        notes.append(
+            "no mesh point measurable on this host; compiled "
+            "determinism walk skipped"
+        )
+        return findings, notes
+    from rcmarl_tpu.utils.profiling import config_fingerprint
+
+    n = measurable[-1]
+    for entry, (cfg, mesh_factory, build) in _sharding_programs().items():
+        text, _, _, _, _ = _compiled_at(
+            entry, config_fingerprint(cfg), build, mesh_factory(n)
+        )
+        for hit in nondeterministic_ops(text, compiled=True):
+            findings.append(
+                Finding(
+                    "nondeterminism",
+                    _anchor_for(entry),
+                    1,
+                    f"{entry}@mesh{n}: {hit}",
+                )
+            )
+    return findings, notes
+
+
+def audit_determinism() -> Tuple[List[Finding], List[str]]:
+    """``lint --sharding`` (determinism half): the full census —
+    entry-point lowerings, aggregation backends, compiled sharded
+    modules."""
+    f1, n1 = _determinism_lowering_walk()
+    f2, n2 = _determinism_compiled_walk()
+    return f1 + f2, n1 + n2

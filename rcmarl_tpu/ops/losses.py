@@ -14,13 +14,50 @@ on the valid prefix.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 # Keras clips probabilities to [eps, 1-eps] before log in categorical
 # cross-entropy (keras.backend.epsilon() == 1e-7).
 KERAS_EPSILON = 1e-7
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _pick_label_probs(p, labels, n_classes):
+    """``p[i, labels[i]]`` with a deterministic backward pass.
+
+    Forward is the UNMODIFIED historical ``take_along_axis`` gather —
+    bitwise the old path for every label value, including the loud
+    non-finite result an out-of-range garbage label produces (the
+    repo's non-finite guard rails key on that signal). The default VJP
+    of that gather is a float scatter-add with
+    ``unique_indices=false`` — an HLO whose duplicate-index
+    accumulation order is implementation-defined, which the graftlint
+    determinism census (`nondeterminism`) forbids in the hot path. One
+    label per row means the indices ARE unique, so the cotangent is an
+    exact one-hot product instead: ``g * 1.0`` at the label, ``g *
+    0.0`` elsewhere — bitwise the scatter's result for every in-range
+    label (the only kind the env can produce: actions are sampled from
+    ``0..n_actions-1``). For garbage labels the two backwards differ
+    (one-hot zeroes the row where the scatter transpose would wrap a
+    negative index), but the forward is already non-finite there and
+    the guards own that case.
+    """
+    return jnp.take_along_axis(p, labels[:, None], axis=-1)[:, 0]
+
+
+def _pick_fwd(p, labels, n_classes):
+    return _pick_label_probs(p, labels, n_classes), labels
+
+
+def _pick_bwd(n_classes, labels, g):
+    return (g[:, None] * jax.nn.one_hot(labels, n_classes, dtype=g.dtype), None)
+
+
+_pick_label_probs.defvjp(_pick_fwd, _pick_bwd)
 
 
 def _masked_mean(per_sample: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndarray:
@@ -72,9 +109,9 @@ def weighted_sparse_ce(
     # tf.keras normalizes to a distribution, then clips to [eps, 1-eps]
     p = probs / jnp.sum(probs, axis=-1, keepdims=True)
     p = jnp.clip(p, KERAS_EPSILON, 1.0 - KERAS_EPSILON)
-    per = -jnp.log(jnp.take_along_axis(p, labels[:, None].astype(jnp.int32), axis=-1))[
-        :, 0
-    ]
+    per = -jnp.log(
+        _pick_label_probs(p, labels.astype(jnp.int32), p.shape[-1])
+    )
     if sample_weight is not None:
         per = per * sample_weight
     return _masked_mean(per, mask)

@@ -199,6 +199,45 @@ def _gossip_mix_block(cfg: Config, params, prev_params, round_idx, exclude):
 gossip_mix_block = partial(jax.jit, static_argnums=0)(_gossip_mix_block)
 
 
+def lower_gossip_mix(cfg: Config, mesh=None):
+    """Lower (without executing) the gossip mix with the REPLICA axis
+    sharded over the mesh 'seed' axis — the pod-scale form of the mix,
+    where each learner replica's parameter block lives on its own
+    device and the graph gather crosses chips as ICI collectives.
+
+    :func:`train_gossip` deliberately runs the mix on one device on
+    this host (single-core-safe dispatch); this lowering is what the
+    graftlint sharding arm audits instead — proving, before any chip
+    time is spent, that the sharded mix keeps its big ``(R, ...)``
+    parameter operands mesh-sharded and that its per-device argument
+    bytes shrink with the mesh (``lint --sharding``). Compile/inspect
+    only, like :func:`rcmarl_tpu.parallel.seeds.lower_parallel`.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from rcmarl_tpu.parallel.seeds import init_states, make_mesh
+
+    if mesh is None:
+        mesh = make_mesh()
+    states = init_states(cfg, replica_seeds(cfg))
+    params_shard = jax.tree.map(
+        lambda _: NamedSharding(mesh, P("seed")), states.params
+    )
+    scalar = NamedSharding(mesh, P())
+    fn = jax.jit(
+        _gossip_mix_block,
+        static_argnums=0,
+        in_shardings=(params_shard, params_shard, scalar, scalar),
+    )
+    return fn.lower(
+        cfg,
+        states.params,
+        states.params,
+        jnp.zeros((), jnp.int32),
+        jnp.zeros((cfg.replicas,), bool),
+    )
+
+
 def _select_replicas(mask, a, b):
     """Per-replica select over replica-stacked pytrees: leaves carry the
     replica axis at 0; ``mask`` is (R,) bool (True -> ``a``)."""

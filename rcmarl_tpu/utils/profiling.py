@@ -115,6 +115,21 @@ def program_fingerprint(lowered_or_text) -> str:
     return hashlib.sha256(text.encode()).hexdigest()[:16]
 
 
+def mesh_fingerprint(mesh) -> str:
+    """Canonical id of the device mesh a sharded row executed on —
+    device count plus the named axis sizes, e.g. ``'8d:seed=2,agent=4'``.
+    `bench`/PERF.jsonl sharded rows and the AUDIT.jsonl device-memory
+    rows carry this next to ``cost_fingerprint``, so a MULTICHIP number
+    is tied to the exact mesh that produced it (catches "measured on a
+    2-chip mesh, claimed for the pod" drift the program hash alone
+    cannot see)."""
+    shape = dict(mesh.shape)
+    n_dev = 1
+    for extent in shape.values():
+        n_dev *= int(extent)
+    return f"{n_dev}d:" + ",".join(f"{k}={int(v)}" for k, v in shape.items())
+
+
 def config_fingerprint(cfg) -> str:
     """sha256[:12] of the Config's canonical field repr — the ledger key
     component that invalidates every AUDIT.jsonl row when the canonical

@@ -23,6 +23,16 @@ Three pins:
    collective counts gate exactly and stay inside the enumerated
    pod-readiness set; and (slow) the full audits report zero findings
    against the COMMITTED ledger.
+5. **Sharding arm + determinism census + contract** — compiled-SPMD
+   sharding annotations parse per operand (planted replicated big
+   operand → `sharding-replicated`; collective-feeds-collective →
+   `sharding-reshard-chain`); the per-device memory ladder gates
+   growth and failure-to-shrink (`device-memory-regression`); the
+   nondeterministic-HLO walker fires on a planted float scatter-add
+   and non-threefry RNG and stays silent on the committed programs
+   (`nondeterminism`); and the Config⇄CLI⇄docs contract pass fires
+   `contract-drift` at the exact config.py field line when a flag is
+   removed, a field goes undocumented, or the JSON round-trip breaks.
 """
 
 from __future__ import annotations
@@ -416,11 +426,309 @@ class TestCollectiveCensus:
         assert rows[0]["host_transfers"] >= 1
 
 
+class TestShardingAudit:
+    """lint --sharding (ledger half): big-operand sharding annotations
+    parse off compiled SPMD text, planted replication/reshard-chain/
+    memory regressions each trip their rule, and the real sharded
+    compiles ride the slow committed-ledger test + the CI graftlint
+    cell (tier-1 wall budget)."""
+
+    HLO = "\n".join([
+        '  %p0 = f32[2,2000,2,2]{3,2,1,0} parameter(0), '
+        'sharding={replicated}, metadata={op_name="s.buffer.s"}',
+        '  %p1 = f32[2,2,20,20]{3,2,1,0} parameter(1), '
+        'sharding={devices=[1,2,1,1]<=[2]}, '
+        'metadata={op_name="s.params.critic[1][0]"}',
+        '  %p2 = s32[2]{0} parameter(2), sharding={replicated}, '
+        'metadata={op_name="s.buffer.ptr"}',
+        '  %p3 = f32[8,1024]{1,0} parameter(3), '
+        'sharding={maximal device=0}, metadata={op_name="s.desired"}',
+    ])
+
+    def test_sharded_parameter_parsing(self):
+        from rcmarl_tpu.lint.sharding import sharded_parameters
+
+        params = {p["path"]: p for p in sharded_parameters(self.HLO)}
+        assert params["s.buffer.s"]["kind"] == "replicated"
+        assert params["s.buffer.s"]["bytes"] == 2 * 2000 * 2 * 2 * 4
+        assert params["s.params.critic[1][0]"]["kind"] == "sharded"
+        assert params["s.desired"]["kind"] == "maximal"
+
+    def test_replicated_big_operands_respect_threshold(self):
+        """Big replicated + big maximal flagged; the small replicated
+        ring pointer and the properly sharded leaf are not."""
+        from rcmarl_tpu.lint.sharding import replicated_big_operands
+
+        flagged = {p["path"] for p in replicated_big_operands(self.HLO)}
+        assert flagged == {"s.buffer.s", "s.desired"}
+
+    def test_reshard_chain_detector(self):
+        """A collective fed (through a -done alias and a copy) by
+        another collective's result is a chain; independent collectives
+        and plain -start/-done pairs are not."""
+        from rcmarl_tpu.lint.sharding import reshard_chains
+
+        clean = "\n".join([
+            "  %ags = (f32[2]{0}, f32[8]{0}) all-gather-start(f32[2]{0}"
+            " %p), dimensions={0}",
+            "  %agd = f32[8]{0} all-gather-done((f32[2]{0}, f32[8]{0})"
+            " %ags)",
+            "  %ar = f32[4]{0} all-reduce(f32[4]{0} %q), to_apply=%add",
+        ])
+        assert reshard_chains(clean) == []
+        chained = clean + "\n" + "\n".join([
+            "  %cp = f32[8]{0} copy(f32[8]{0} %agd)",
+            "  %ar2 = f32[8]{0} all-reduce(f32[8]{0} %cp), to_apply=%add",
+        ])
+        hits = reshard_chains(chained)
+        assert len(hits) == 1 and "all-reduce" in hits[0]
+
+    def test_planted_replicated_program_fires(self):
+        """A big operand deliberately lowered with a fully-replicated
+        in_sharding under a 2-device mesh must trip sharding-replicated
+        at exactly the planted entry."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from rcmarl_tpu.lint.configs import tiny_cfg
+        from rcmarl_tpu.lint.sharding import sharding_rows
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 (virtual) devices")
+        from rcmarl_tpu.parallel.seeds import make_mesh
+
+        build = lambda mesh: jax.jit(
+            lambda x: x * 2.0,
+            in_shardings=(NamedSharding(mesh, P()),),
+        ).lower(jnp.ones((64, 64), jnp.float32))
+        programs = {
+            "seeds@planted": (
+                tiny_cfg(), lambda n: make_mesh(n, seed_axis=1), build,
+            )
+        }
+        rows, findings, notes, _skipped = sharding_rows(
+            programs, mesh_points=(2,)
+        )
+        assert notes == []
+        assert {f.rule for f in findings} == {"sharding-replicated"}
+        assert all("seeds@planted" in f.message for f in findings)
+        assert rows[0]["mesh"] == {"seed": 1, "agent": 2}
+        assert rows[0]["mesh_fingerprint"] == "2d:seed=1,agent=2"
+
+    @staticmethod
+    def _row(entry, mesh, peak, arg=1000.0):
+        return {
+            "v": 1, "kind": "device_memory", "entry": f"{entry}@mesh{mesh}",
+            "fingerprint": "f", "program": "p",
+            "mesh_fingerprint": f"{mesh}d:seed=1,agent={mesh}",
+            "mesh": {"seed": 1, "agent": mesh}, "platform": "cpu",
+            "jax": "x",
+            "metrics": {
+                "argument_bytes": arg / mesh, "output_bytes": 10.0,
+                "temp_bytes": 10.0, "alias_bytes": 0.0,
+                "peak_bytes": peak,
+            },
+        }
+
+    def test_planted_per_device_growth_fires_shrink_invariant(self):
+        """Per-device peak that FAILS to shrink across the mesh ladder
+        (the replication signature) trips device-memory-regression with
+        no baseline involved; a shrinking ladder stays clean."""
+        from rcmarl_tpu.lint.sharding import shrink_findings
+
+        good = [
+            self._row("seeds@sharded", 1, 8000.0),
+            self._row("seeds@sharded", 2, 4600.0),
+            self._row("seeds@sharded", 8, 1500.0),
+        ]
+        assert shrink_findings(good) == []
+        flat = [
+            self._row("seeds@sharded", 1, 8000.0),
+            self._row("seeds@sharded", 2, 8000.0),
+            self._row("seeds@sharded", 8, 8100.0),
+        ]
+        findings = shrink_findings(flat)
+        assert findings and {f.rule for f in findings} == {
+            "device-memory-regression"
+        }
+        assert any("fails to shrink" in f.message for f in findings)
+
+    def test_compare_device_memory_gate(self):
+        """The ledger gate: self-comparison clean; planted per-device
+        peak growth trips device-memory-regression at exactly the
+        entry; a missing row is cost-unbaselined; a row this host
+        skipped is exempt from the stale check."""
+        import copy
+
+        from rcmarl_tpu.lint.sharding import compare_device_memory
+
+        base = [self._row("seeds@sharded", 8, 1500.0)]
+        findings, notes = compare_device_memory(base, base)
+        assert findings == [] and notes == []
+        fresh = copy.deepcopy(base)
+        fresh[0]["metrics"]["peak_bytes"] *= 1.10
+        findings, _ = compare_device_memory(base, fresh)
+        assert {f.rule for f in findings} == {"device-memory-regression"}
+        assert len(findings) == 1
+        assert "seeds@sharded@mesh8" in findings[0].message
+        findings, _ = compare_device_memory([], fresh)
+        assert {f.rule for f in findings} == {"cost-unbaselined"}
+        findings, _ = compare_device_memory(base, [])
+        assert {f.rule for f in findings} == {"cost-unbaselined"}
+        findings, _ = compare_device_memory(
+            base, [], skipped={base[0]["entry"]}
+        )
+        assert findings == []
+
+
+class TestDeterminismCensus:
+    """lint --sharding (census half): the nondeterministic-HLO walker
+    fires on planted hazards and stays silent on the deterministic
+    committed programs."""
+
+    def test_planted_nondeterministic_scatter_fires(self):
+        """A float scatter-add with duplicate-capable indices
+        (unique_indices=false) — the accumulation-order hazard the
+        bitwise pinning discipline cannot survive — must fire."""
+        import jax
+        import jax.numpy as jnp
+
+        from rcmarl_tpu.lint.sharding import nondeterministic_ops
+
+        low = jax.jit(lambda x, i, v: x.at[i].add(v)).lower(
+            jnp.ones(8, jnp.float32),
+            jnp.array([1, 1, 2]),
+            jnp.ones(3, jnp.float32),
+        )
+        hits = nondeterministic_ops(low.as_text(), compiled=False)
+        assert hits and all("scatter" in h for h in hits)
+
+    def test_overwrite_scatter_is_clean(self):
+        """The replay-ring writes (.at[idx].set) carry no float
+        accumulation — order-safe, must NOT fire."""
+        import jax
+        import jax.numpy as jnp
+
+        from rcmarl_tpu.lint.sharding import nondeterministic_ops
+
+        low = jax.jit(lambda x, v: x.at[jnp.arange(3)].set(v)).lower(
+            jnp.ones((8, 4), jnp.float32), jnp.ones((3, 4), jnp.float32)
+        )
+        assert nondeterministic_ops(low.as_text(), compiled=False) == []
+
+    def test_rng_and_collective_text_rules(self):
+        from rcmarl_tpu.lint.sharding import nondeterministic_ops
+
+        fires = (
+            "%o, %s = stablehlo.rng_bit_generator %k, algorithm = "
+            " DEFAULT : (tensor<2xui64>) -> (tensor<2xui64>, "
+            "tensor<4xui32>)"
+        )
+        assert nondeterministic_ops(fires, compiled=False)
+        threefry = fires.replace("DEFAULT", "THREE_FRY")
+        assert nondeterministic_ops(threefry, compiled=False) == []
+        legacy = "  %r = f32[4]{0} rng(f32[] %a, f32[] %b), distribution=rng_uniform"
+        assert nondeterministic_ops(legacy, compiled=True)
+        bad_coll = (
+            "  %cb = f32[4]{0} collective-broadcast(f32[4]{0} %x), "
+            "replica_groups={}"
+        )
+        assert nondeterministic_ops(bad_coll, compiled=True)
+        ok_coll = (
+            "  %ar = f32[4]{0} all-reduce(f32[4]{0} %x), to_apply=%add"
+        )
+        assert nondeterministic_ops(ok_coll, compiled=True) == []
+
+    def test_update_block_lowering_is_clean(self):
+        """The actor phase's label gather keeps a deterministic
+        backward (ops/losses.py one-hot custom_vjp): the dual
+        update-block lowering carries zero hazards. The full walk
+        (every arm + aggregation backends + compiled sharded modules)
+        rides the slow committed-ledger test and the CI cell."""
+        from rcmarl_tpu.lint.configs import tiny_cfg
+        from rcmarl_tpu.lint.sharding import nondeterministic_ops
+        from rcmarl_tpu.utils.profiling import lowered_entry_points
+
+        low = lowered_entry_points(
+            tiny_cfg(netstack=False), False, ("update_block",)
+        )["update_block"]
+        assert nondeterministic_ops(low.as_text(), compiled=False) == []
+
+
+class TestContract:
+    """lint --contract: the Config⇄CLI⇄docs regression net."""
+
+    def test_committed_tree_is_clean(self):
+        from rcmarl_tpu.lint.contract import audit_contract
+
+        findings, notes = audit_contract()
+        assert findings == [], "\n".join(str(f) for f in findings)
+        assert notes == []
+
+    def test_roundtrip_is_clean(self):
+        from rcmarl_tpu.lint.contract import roundtrip_drift
+
+        assert roundtrip_drift() == []
+
+    def test_removed_cli_flag_fires_at_the_field_line(self):
+        """Hard-coding a Config keyword (the residue of a deleted flag)
+        must fire contract-drift anchored at that field's config.py
+        declaration line."""
+        from pathlib import Path
+
+        import rcmarl_tpu.cli as cli_mod
+        from rcmarl_tpu.lint.contract import (
+            audit_contract,
+            config_field_lines,
+        )
+
+        source = Path(cli_mod.__file__).read_text()
+        assert "gamma=args.gamma," in source, "fixture went stale"
+        doctored = source.replace("gamma=args.gamma,", "gamma=0.9,")
+        findings, _ = audit_contract(cli_source=doctored)
+        hits = [f for f in findings if "Config.gamma" in f.message]
+        assert len(hits) == 1 and hits[0].rule == "contract-drift"
+        assert hits[0].line == config_field_lines()["gamma"]
+        assert hits[0].path == "rcmarl_tpu/config.py"
+
+    def test_undocumented_field_fires(self):
+        """A docs table naming only one field flags every other field
+        (an EMPTY/missing docs file is a note, not a finding storm)."""
+        from rcmarl_tpu.lint.contract import audit_contract
+
+        findings, _ = audit_contract(
+            api_md_text="only `n_agents` is documented here"
+        )
+        assert findings and {f.rule for f in findings} == {"contract-drift"}
+        assert any(
+            "Config.H does not appear" in f.message for f in findings
+        )
+        assert not any("Config.n_agents" in f.message for f in findings)
+        _, notes = audit_contract(api_md_text="no backticks at all")
+        assert any("unverifiable" in n for n in notes)
+
+    def test_stale_exemption_fires(self):
+        """An exemption naming no current field is itself drift."""
+        from unittest import mock
+
+        import rcmarl_tpu.lint.contract as contract
+
+        with mock.patch.dict(
+            contract.CLI_EXEMPT, {"no_such_field": "ghost"}
+        ):
+            findings, _ = contract.audit_contract()
+        assert any(
+            f.rule == "contract-drift" and "no_such_field" in f.message
+            for f in findings
+        )
+
+
 @pytest.mark.slow
 class TestCommittedLedger:
-    """The acceptance bar: the full cost + collective audits report
-    zero findings against the COMMITTED AUDIT.jsonl on this host (the
-    same gate ci_tier1.sh runs through the real CLI)."""
+    """The acceptance bar: the full cost + collective + sharding audits
+    report zero findings against the COMMITTED AUDIT.jsonl on this host
+    (the same gate ci_tier1.sh runs through the real CLI)."""
 
     BASELINE = Path(__file__).parent.parent / "AUDIT.jsonl"
 
@@ -434,6 +742,21 @@ class TestCommittedLedger:
         from rcmarl_tpu.lint.collectives import audit_collectives
 
         findings, _notes, _rows = audit_collectives(self.BASELINE)
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+    def test_sharding_gate_is_clean(self):
+        """Sharding annotations, reshard chains, the per-device shrink
+        invariant, and the device_memory ledger rows — all green on the
+        committed tree at every mesh rung this host can build."""
+        from rcmarl_tpu.lint.sharding import audit_sharding
+
+        findings, _notes, _rows = audit_sharding(self.BASELINE)
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+    def test_determinism_census_is_clean(self):
+        from rcmarl_tpu.lint.sharding import audit_determinism
+
+        findings, _notes = audit_determinism()
         assert findings == [], "\n".join(str(f) for f in findings)
 
 

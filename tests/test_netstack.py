@@ -100,27 +100,47 @@ def _assert_tree_equal(a, b, exact=True):
             )
 
 
+_EQUIVALENCE_MODES = {
+    "static_h1": {},
+    "h0": dict(H=0),
+    "sanitize": dict(consensus_sanitize=True),
+    "faults": dict(fault_plan=PLAN, consensus_sanitize=True),
+    "ragged_masked": dict(in_nodes=RAGGED),
+    "ragged_sanitize_faults": dict(
+        in_nodes=RAGGED, consensus_sanitize=True, fault_plan=PLAN
+    ),
+    "xla_sort": dict(consensus_impl="xla_sort"),
+    "pallas_interpret": dict(consensus_impl="pallas_interpret"),
+    "pallas_interpret_sort_sanitize": dict(
+        consensus_impl="pallas_interpret", consensus_sanitize=True
+    ),
+}
+
+#: The cells that stay in tier-1: the clean static-H representative and
+#: the sanitize arm. The expensive fault/ragged/pallas/h0/xla_sort
+#: cells (13-29s each) ride the slow marker — the tier-1 870s wall
+#: budget shed PR 8 applied to the fitstack matrix, with the same CI
+#: compensation: ci_tier1.sh's netstack smoke cell drives the
+#: ragged+sanitize+faults stacked-vs-dual wire-up through the real
+#: trainer on every CI run, and the full matrix still runs under
+#: `pytest tests/` (no -m filter).
+_FAST_EQUIVALENCE_MODES = ("static_h1", "sanitize")
+
+_EQUIVALENCE_PARAMS = [
+    m
+    if m in _FAST_EQUIVALENCE_MODES
+    else pytest.param(m, marks=pytest.mark.slow)
+    for m in sorted(_EQUIVALENCE_MODES)
+]
+
+
 class TestBlockEquivalence:
     """update_block(netstack=True) == update_block(netstack=False),
     leaf for leaf, across every consensus mode."""
 
-    MODES = {
-        "static_h1": {},
-        "h0": dict(H=0),
-        "sanitize": dict(consensus_sanitize=True),
-        "faults": dict(fault_plan=PLAN, consensus_sanitize=True),
-        "ragged_masked": dict(in_nodes=RAGGED),
-        "ragged_sanitize_faults": dict(
-            in_nodes=RAGGED, consensus_sanitize=True, fault_plan=PLAN
-        ),
-        "xla_sort": dict(consensus_impl="xla_sort"),
-        "pallas_interpret": dict(consensus_impl="pallas_interpret"),
-        "pallas_interpret_sort_sanitize": dict(
-            consensus_impl="pallas_interpret", consensus_sanitize=True
-        ),
-    }
+    MODES = _EQUIVALENCE_MODES
 
-    @pytest.mark.parametrize("mode", sorted(MODES))
+    @pytest.mark.parametrize("mode", _EQUIVALENCE_PARAMS)
     def test_pinned_leaf_for_leaf(self, mode):
         kw = dict(BASE)
         kw.update(self.MODES[mode])

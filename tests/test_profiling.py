@@ -43,6 +43,29 @@ def test_timer_forces_completion():
     assert dt > 0 and t.elapsed == dt
 
 
+def test_mesh_fingerprint_is_device_count_plus_axis_sizes():
+    """The id sharded bench/profile/AUDIT rows carry next to
+    cost_fingerprint: device count + named axis sizes, so MULTICHIP
+    evidence is tied to the exact mesh that produced it."""
+    from rcmarl_tpu.utils.profiling import mesh_fingerprint
+
+    if len(jax.devices()) >= 8:
+        from rcmarl_tpu.parallel.seeds import make_mesh
+
+        assert mesh_fingerprint(make_mesh(8, seed_axis=2)) == (
+            "8d:seed=2,agent=4"
+        )
+        assert mesh_fingerprint(make_mesh(2, seed_axis=1)) == (
+            "2d:seed=1,agent=2"
+        )
+    else:  # pragma: no cover - single-device CI fallback
+        from rcmarl_tpu.parallel.seeds import make_mesh
+
+        assert mesh_fingerprint(make_mesh(1, seed_axis=1)) == (
+            "1d:seed=1,agent=1"
+        )
+
+
 @pytest.mark.slow
 def test_profile_phases_covers_training_subprograms():
     times = profile_phases(tiny_cfg(), reps=1)
