@@ -156,6 +156,76 @@ def _measure(
     )
 
 
+def _measure_serve(batch: int, steps: int, reps: int, mode: str = "sample") -> None:
+    """Child: the SERVING headline — actions/sec through the compiled
+    batched inference launch (rcmarl_tpu.serve.engine.serve_block) at
+    the published reference shape (5 agents, 20-wide nets).
+
+    Fresh-init parameters: this measures the compiled serving program's
+    throughput (the infrastructure number), not a trained policy's
+    quality — `python -m rcmarl_tpu serve` serves real checkpoints and
+    emits the same row schema. A handful of distinct observation
+    buffers are cycled so the loop cannot ride one cached input.
+    """
+    import jax
+    import numpy as np
+
+    from rcmarl_tpu.config import Config
+    from rcmarl_tpu.serve.engine import serve_block, serve_keys, stack_actor_rows
+    from rcmarl_tpu.training.trainer import init_train_state
+    from rcmarl_tpu.utils.profiling import program_fingerprint
+
+    cfg = Config(slow_lr=0.002, fast_lr=0.01, seed=100)
+    state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
+    block = stack_actor_rows(state.params, cfg)
+    n_buf = 4
+    obs = [
+        jax.random.normal(
+            jax.random.PRNGKey(i), (batch, cfg.n_agents, cfg.obs_dim)
+        )
+        for i in range(n_buf)
+    ]
+    key = serve_keys(0, 0)
+    fingerprint = program_fingerprint(
+        serve_block.lower(cfg, block, obs[0], key, mode=mode)
+    )
+    # warmup: compile + one execution
+    np.asarray(serve_block(cfg, block, obs[0], key, mode=mode)[0])
+
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        actions = None
+        for s in range(steps):
+            actions, _ = serve_block(
+                cfg, block, obs[s % n_buf],
+                jax.random.fold_in(key, s), mode=mode,
+            )
+        np.asarray(actions)  # completion barrier
+        best = min(best, time.perf_counter() - t0)
+
+    total = steps * batch * cfg.n_agents
+    print(
+        json.dumps(
+            {
+                "metric": "serve_actions_per_sec",
+                "value": round(total / best, 1),
+                "unit": "actions/s",
+                "platform": jax.devices()[0].platform,
+                "cost_fingerprint": fingerprint,
+                "workload": {
+                    "batch": batch,
+                    "steps": steps,
+                    "reps": reps,
+                    "mode": mode,
+                    "n_agents": cfg.n_agents,
+                    "hidden": list(cfg.hidden),
+                },
+            }
+        )
+    )
+
+
 def _probe() -> None:
     """Child: the cheapest possible end-to-end device contact."""
     import jax
@@ -207,6 +277,77 @@ def _run_child(argv, env_overrides, timeout_s):
         return json.loads(lines[-1])
     except json.JSONDecodeError:
         return {"error": f"unparsable child output: {lines[-1][:200]}"}
+
+
+def main_serve() -> int:
+    """`python bench.py --serve`: the SERVING headline (actions/sec),
+    with the train headline's exact orchestration discipline — probe
+    the TPU with bounded retries, sweep batch-size candidates one
+    isolated child each, fall back to a smaller honest CPU measurement
+    tagged ``"headline": false`` when the tunnel is down."""
+    attempts = []
+    tpu_ok = False
+    for i in range(PROBE_ATTEMPTS):
+        res = _run_child(["--probe"], {}, PROBE_TIMEOUT_S)
+        attempts.append({"stage": f"probe{i}", **res})
+        if res.get("probe") == "ok" and res.get("platform") != "cpu":
+            tpu_ok = True
+            break
+        if i + 1 < PROBE_ATTEMPTS:
+            time.sleep(BACKOFF_S * (2**i))
+
+    if tpu_ok:
+        # batch sweep, one child each: serving throughput grows with
+        # the request batch until the chip saturates
+        candidates = []
+        for batch in (4096, 32768, 131072):
+            res = _run_child(
+                ["--serve_child", "--batch", str(batch), "--steps", "50",
+                 "--reps", "3"],
+                {},
+                TPU_TIMEOUT_S,
+            )
+            attempts.append({"stage": f"tpu_serve_{batch}", **res})
+            if "value" in res:
+                candidates.append(res)
+        if candidates:
+            best = max(candidates, key=lambda c: c["value"])
+            best["candidates"] = [
+                {"value": c["value"], "workload": c["workload"]}
+                for c in candidates
+            ]
+            best["attempts"] = len(attempts)
+            best["headline"] = True
+            print(json.dumps(best))
+            return 0
+
+    res = _run_child(
+        ["--serve_child", "--batch", "1024", "--steps", "20", "--reps", "2"],
+        {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+        CPU_TIMEOUT_S,
+    )
+    attempts.append({"stage": "cpu_serve", **res})
+    if "value" in res:
+        res["attempts"] = len(attempts)
+        res["headline"] = False
+        res["note"] = (
+            "TPU backend unavailable; CPU fallback serving measurement "
+            "— an honest actions/sec number, NOT an on-chip serving "
+            "claim (BENCH_SERVE.jsonl headline discipline)"
+        )
+        print(json.dumps(res))
+        return 0
+    print(
+        json.dumps(
+            {
+                "metric": "serve_actions_per_sec",
+                "value": None,
+                "unit": "actions/s",
+                "error": attempts,
+            }
+        )
+    )
+    return 1
 
 
 def main() -> int:
@@ -317,6 +458,20 @@ def main() -> int:
 if __name__ == "__main__":
     if "--probe" in sys.argv:
         _probe()
+    elif "--serve_child" in sys.argv:
+        args = sys.argv
+        _measure_serve(
+            batch=int(args[args.index("--batch") + 1]),
+            steps=int(args[args.index("--steps") + 1]),
+            reps=int(args[args.index("--reps") + 1]),
+            mode=(
+                _arm_arg(args, "--mode", ("sample", "greedy"))
+                if "--mode" in args
+                else "sample"
+            ),
+        )
+    elif "--serve" in sys.argv:
+        sys.exit(main_serve())
     elif "--child" in sys.argv:
         args = sys.argv
         _measure(

@@ -40,5 +40,6 @@ from rcmarl_tpu.faults import (  # noqa: F401
 # points are re-exported here for discoverability:
 #   rcmarl_tpu.training.train / train_RPBCAC
 #   rcmarl_tpu.parallel.train_parallel
+#   rcmarl_tpu.serve.ServeEngine / serve_block / CheckpointWatcher
 #   rcmarl_tpu.agents.Reference{RPBCAC,Faulty,Greedy,Malicious}Agent
 #   rcmarl_tpu.envs.GridWorld / ReferenceGridWorld

@@ -483,7 +483,7 @@ def cmd_train(argv) -> int:
     from rcmarl_tpu.training.trainer import init_train_state, train
     from rcmarl_tpu.utils.checkpoint import (
         import_reference_weights,
-        load_checkpoint_with_fallback,
+        load_checkpoint_with_meta,
         save_checkpoint,
         save_reference_artifacts,
     )
@@ -505,16 +505,16 @@ def cmd_train(argv) -> int:
             )
         if src.is_file():  # our checkpoint
             # Checksum-verified; a corrupted/truncated file falls back to
-            # the rotated <src>.prev instead of crashing the resume.
-            state, ckpt_cfg, loaded = load_checkpoint_with_fallback(src, cfg)
+            # the rotated <src>.prev instead of crashing the resume (the
+            # same discovery chain the serve watcher uses).
+            state, ckpt_cfg, loaded, ckpt_meta = load_checkpoint_with_meta(
+                src, cfg
+            )
             if loaded != src:
                 print(
                     f"WARNING: {src} is corrupted; resumed the previous "
                     f"good checkpoint {loaded}"
                 )
-            from rcmarl_tpu.utils.checkpoint import read_checkpoint_meta
-
-            ckpt_meta = read_checkpoint_meta(loaded)
             ckpt_replicas = int(ckpt_meta.get("replicas", 0))
             if ckpt_replicas != cfg.replicas:
                 # the loaded state's replica axis comes from the FILE's
@@ -1524,6 +1524,275 @@ def cmd_profile(argv) -> int:
 
 
 # --------------------------------------------------------------------------
+# serve / evaluate
+# --------------------------------------------------------------------------
+
+
+def cmd_serve(argv) -> int:
+    p = argparse.ArgumentParser(
+        prog="rcmarl_tpu serve",
+        description="Serve a trained policy checkpoint: compile-once "
+        "batched inference (ONE launch per request batch) with optional "
+        "checkpoint hot-swap and guarded degradation — the 'heavy "
+        "traffic' benchmark axis, distinct from train steps/sec "
+        "(rcmarl_tpu.serve)",
+    )
+    p.add_argument(
+        "--checkpoint",
+        type=str,
+        default="./simulation_results/checkpoint.npz",
+        help="trained checkpoint .npz (the checksummed format; a "
+        "corrupted primary falls back to <path>.prev)",
+    )
+    p.add_argument(
+        "--batch",
+        type=int,
+        default=1024,
+        help="requests per launch (B global states; every launch "
+        "produces B x n_agents actions)",
+    )
+    p.add_argument("--steps", type=int, default=50, help="timed launches per rep")
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument(
+        "--mode",
+        type=str,
+        default="sample",
+        choices=["sample", "greedy"],
+        help="serving arm: sample = categorical per (request, agent) "
+        "under the fold_in key discipline, greedy = deterministic argmax",
+    )
+    p.add_argument(
+        "--eval_seed",
+        type=int,
+        default=0,
+        help="deterministic serve-stream namespace (replaying the same "
+        "seed + launch indices replays the exact action stream)",
+    )
+    p.add_argument(
+        "--watch_every",
+        type=int,
+        default=0,
+        help="poll the checkpoint for hot-swap every K launches "
+        "(0 = off); corrupted/non-finite candidates are rejected and "
+        "the engine keeps serving the last good params",
+    )
+    p.add_argument(
+        "--obs_buffers",
+        type=int,
+        default=4,
+        help="distinct pre-generated observation batches cycled through "
+        "the timed loop (keeps the measurement off a single cached input)",
+    )
+    p.add_argument(
+        "--out",
+        type=str,
+        default=None,
+        help="append the serve row as a JSON line to this file "
+        "(BENCH_SERVE.jsonl convention)",
+    )
+    args = p.parse_args(argv)
+    if args.batch < 1 or args.steps < 1 or args.reps < 1 or args.obs_buffers < 1:
+        raise SystemExit(
+            "--batch, --steps, --reps, and --obs_buffers must be >= 1"
+        )
+
+    import jax
+    import jax.numpy as jnp
+
+    from rcmarl_tpu.envs.grid_world import env_reset, scale_state
+    from rcmarl_tpu.serve.engine import ServeEngine, serve_block, serve_keys
+    from rcmarl_tpu.serve.swap import CheckpointWatcher
+    from rcmarl_tpu.training.trainer import make_env
+    from rcmarl_tpu.utils.profiling import Timer, program_fingerprint
+
+    engine = ServeEngine(
+        args.checkpoint, mode=args.mode, eval_seed=args.eval_seed
+    )
+    cfg = engine.cfg
+    watcher = CheckpointWatcher(engine) if args.watch_every else None
+    env = make_env(cfg)
+
+    def obs_batch(i: int) -> jnp.ndarray:
+        """B random global states (env-reset draws, scaled exactly as
+        the rollout scales them) broadcast to every agent's view —
+        the (B, N, obs_dim) layout serve_block consumes."""
+        ks = jax.random.split(jax.random.PRNGKey(args.eval_seed + i), args.batch)
+        pos = jax.vmap(lambda k: env_reset(env, k))(ks)  # (B, N, 2)
+        flat = jax.vmap(lambda q: scale_state(env, q))(pos).reshape(
+            args.batch, -1
+        )  # (B, obs_dim)
+        return jnp.broadcast_to(
+            flat[:, None, :], (args.batch, cfg.n_agents, cfg.obs_dim)
+        )
+
+    buffers = [obs_batch(i) for i in range(args.obs_buffers)]
+    # tie the row to the EXACT program being timed (ledger convention)
+    fingerprint = program_fingerprint(
+        serve_block.lower(
+            cfg, engine.block, buffers[0], serve_keys(args.eval_seed, 0),
+            mode=args.mode,
+        )
+    )
+    # warmup: compile + one execution
+    jax.device_get(engine.serve(buffers[0])[0])
+    best = float("inf")
+    for _ in range(args.reps):
+        t = Timer().start()
+        actions = None
+        for s in range(args.steps):
+            actions, _ = engine.serve(buffers[s % len(buffers)])
+            if watcher is not None and (s + 1) % args.watch_every == 0:
+                watcher.poll()
+        best = min(best, t.stop(actions))
+    actions_per_launch = args.batch * cfg.n_agents
+    row = json.dumps(
+        {
+            "kind": "serve",
+            "checkpoint": str(args.checkpoint),
+            "mode": args.mode,
+            "n_agents": cfg.n_agents,
+            "hidden": list(cfg.hidden),
+            "compute_dtype": cfg.compute_dtype,
+            "batch": args.batch,
+            "actions_per_sec": round(args.steps * actions_per_launch / best, 1),
+            "launches_per_sec": round(args.steps / best, 2),
+            "sec_per_launch": round(best / args.steps, 6),
+            "cost_fingerprint": fingerprint,
+            "degradation": engine.summary(),
+            "workload": {
+                "steps": args.steps,
+                "reps": args.reps,
+                "obs_buffers": args.obs_buffers,
+                "watch_every": args.watch_every,
+            },
+            "platform": jax.devices()[0].platform,
+            # headline discipline (bench.py): only an on-chip row is a
+            # TPU serving claim; CPU rows are honest fallbacks
+            "headline": jax.devices()[0].platform == "tpu",
+            "timestamp": datetime.now().isoformat(timespec="seconds"),
+        }
+    )
+    _emit(row, args.out)
+    print(engine.summary_line())
+    return 0
+
+
+def cmd_evaluate(argv) -> int:
+    p = argparse.ArgumentParser(
+        prog="rcmarl_tpu evaluate",
+        description="Roll a trained policy checkpoint through its env "
+        "(frozen params, no updates): team/adversary returns + "
+        "per-agent discounted-return stats as JSONL "
+        "(rcmarl_tpu.serve.engine.eval_block)",
+    )
+    p.add_argument(
+        "--checkpoint",
+        type=str,
+        default="./simulation_results/checkpoint.npz",
+        help="trained checkpoint .npz (solo layout; replica worlds are "
+        "rejected loudly)",
+    )
+    p.add_argument(
+        "--episodes",
+        type=int,
+        default=100,
+        help="evaluation episodes (rounded up to whole n_ep_fixed "
+        "blocks — each block is ONE compiled launch)",
+    )
+    p.add_argument(
+        "--eps",
+        type=float,
+        default=0.0,
+        help="exploration mix during evaluation (default 0: pure "
+        "policy, unlike training's 0.1)",
+    )
+    p.add_argument("--seed", type=int, default=0, help="evaluation RNG namespace")
+    p.add_argument(
+        "--out",
+        type=str,
+        default=None,
+        help="append the evaluation row as a JSON line to this file",
+    )
+    args = p.parse_args(argv)
+    if args.episodes < 1:
+        raise SystemExit("--episodes must be >= 1")
+
+    import jax
+    import jax.numpy as jnp
+
+    from rcmarl_tpu.serve.engine import eval_block
+    from rcmarl_tpu.utils.checkpoint import load_checkpoint_with_meta
+    from rcmarl_tpu.utils.profiling import Timer, program_fingerprint
+
+    state, cfg, loaded, meta = load_checkpoint_with_meta(args.checkpoint)
+    if int(meta.get("replicas", 0)):
+        raise SystemExit(
+            f"--checkpoint: {loaded} holds a replica gossip world; "
+            "evaluate expects a solo policy checkpoint"
+        )
+    if loaded != Path(args.checkpoint):
+        print(
+            f"WARNING: {args.checkpoint} is corrupted; evaluating the "
+            f"previous good checkpoint {loaded}"
+        )
+    cfg = cfg.replace(eps_explore=args.eps)
+    n_blocks = -(-args.episodes // cfg.n_ep_fixed)  # ceil
+    key = jax.random.PRNGKey(args.seed)
+    fingerprint = program_fingerprint(
+        eval_block.lower(
+            cfg, state.params, state.desired, key, state.initial
+        )
+    )
+    team, adv, est, per_agent = [], [], [], []
+    t = Timer().start()
+    out = None
+    for b in range(n_blocks):
+        metrics, agent_returns = out = eval_block(
+            cfg,
+            state.params,
+            state.desired,
+            jax.random.fold_in(key, b),
+            state.initial,
+        )
+        team.append(metrics.true_team_returns)
+        adv.append(metrics.true_adv_returns)
+        est.append(metrics.est_team_returns)
+        per_agent.append(agent_returns)
+    dt = t.stop(out)
+    team = np.concatenate([np.asarray(x) for x in team])
+    adv = np.concatenate([np.asarray(x) for x in adv])
+    est = np.concatenate([np.asarray(x) for x in est])
+    per_agent = np.mean(np.stack([np.asarray(x) for x in per_agent]), axis=0)
+    episodes = n_blocks * cfg.n_ep_fixed
+    row = json.dumps(
+        {
+            "kind": "evaluate",
+            "checkpoint": str(args.checkpoint),
+            "episodes": int(episodes),
+            "eps_explore": args.eps,
+            "seed": args.seed,
+            "n_agents": cfg.n_agents,
+            "team_return_mean": round(float(team.mean()), 6),
+            "team_return_std": round(float(team.std()), 6),
+            "adv_return_mean": round(float(adv.mean()), 6),
+            "est_return_mean": round(float(est.mean()), 6),
+            "per_agent_returns": [round(float(v), 6) for v in per_agent],
+            "episodes_per_sec": round(episodes / dt, 2),
+            "cost_fingerprint": fingerprint,
+            "platform": jax.devices()[0].platform,
+            "timestamp": datetime.now().isoformat(timespec="seconds"),
+        }
+    )
+    _emit(row, args.out)
+    print(
+        f"evaluate: {episodes} episodes, team return "
+        f"{float(team.mean()):.4f} ± {float(team.std()):.4f} "
+        f"({episodes / dt:.1f} eps/s)"
+    )
+    return 0
+
+
+# --------------------------------------------------------------------------
 # lint
 # --------------------------------------------------------------------------
 
@@ -2193,6 +2462,8 @@ def main(argv=None) -> int:
         "plot": cmd_plot,
         "bench": cmd_bench,
         "profile": cmd_profile,
+        "serve": cmd_serve,
+        "evaluate": cmd_evaluate,
         "parity": cmd_parity,
         "quality": cmd_quality,
         "lint": cmd_lint,

@@ -64,6 +64,8 @@ _ANCHORS = {
     "train_block": "rcmarl_tpu/training/trainer.py",
     "gossip_mix_block": "rcmarl_tpu/parallel/gossip.py",
     "fit_block": "rcmarl_tpu/training/update.py",
+    "serve_block": "rcmarl_tpu/serve/engine.py",
+    "eval_block": "rcmarl_tpu/serve/engine.py",
     "aggregation": "rcmarl_tpu/ops/aggregation.py",
 }
 
@@ -219,6 +221,15 @@ def cost_arms() -> Dict[str, tuple]:
             tiny_cfg(compute_dtype="bfloat16"),
             False,
             ("update_block", "train_block"),
+        ),
+        # the serving subsystem: the batched inference launch and the
+        # evaluate rollout block, on the dual arm's config so the
+        # memoized tiny inputs are shared — "the serve program got
+        # wider/heavier" becomes a ledger fact like every hot path
+        "serve": (
+            tiny_cfg(netstack=False),
+            False,
+            ("serve_block", "eval_block"),
         ),
     }
 

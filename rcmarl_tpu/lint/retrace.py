@@ -270,4 +270,65 @@ def audit_retrace(
             states=states,
             start_round=df.attrs["gossip"]["gossip_round"],
         )
+    auditor.findings.extend(_audit_serve(auditor, steady_blocks))
     return auditor.findings
+
+
+def _audit_serve(
+    auditor: "RetraceAuditor", steady_blocks: int
+) -> List[Finding]:
+    """The serving compile-once case: ``serve_block`` warmed once per
+    static arm (sample / greedy), then driven across REPEATED request
+    batches and across a HOT-SWAP of same-shaped fresh params — the
+    block/observations/key are data, so steady-state serving and every
+    checkpoint hot-swap must re-dispatch the same two executables with
+    zero recompiles (the acceptance contract of the serve subsystem)."""
+    import jax
+
+    from rcmarl_tpu.lint.configs import tiny_cfg
+    from rcmarl_tpu.serve.engine import serve_block, stack_actor_rows
+    from rcmarl_tpu.training.trainer import init_train_state
+
+    cfg = tiny_cfg()
+    # two SAME-SHAPED parameter blocks: blocks[1] plays the hot-swapped
+    # checkpoint (fresh params, identical avals)
+    blocks = [
+        stack_actor_rows(
+            init_train_state(cfg, jax.random.PRNGKey(s)).params, cfg
+        )
+        for s in (0, 1)
+    ]
+    obs = [
+        jax.random.normal(
+            jax.random.PRNGKey(10 + i), (8, cfg.n_agents, cfg.obs_dim)
+        )
+        for i in range(2)
+    ]
+    key = jax.random.PRNGKey(7)
+    findings: List[Finding] = []
+    # warmup: exactly one compile per static mode arm
+    before = int(serve_block._cache_size())
+    serve_block(cfg, blocks[0], obs[0], key)
+    serve_block(cfg, blocks[0], obs[0], key, mode="greedy")
+    grew = int(serve_block._cache_size()) - before
+    if grew != 2:
+        path, line = _anchor(serve_block)
+        findings.append(
+            Finding(
+                "retrace",
+                path,
+                line,
+                f"serve_block compiled {grew} program(s) for the "
+                "sample/greedy warmup pair — expected exactly one per "
+                "static mode arm",
+            )
+        )
+    with auditor.expect_no_compiles(context="batched serve + hot-swap"):
+        for i in range(steady_blocks):
+            for block in blocks:  # the hot-swap boundary
+                for o in obs:  # repeated distinct request batches
+                    serve_block(
+                        cfg, block, o, jax.random.fold_in(key, i)
+                    )
+                    serve_block(cfg, block, o, key, mode="greedy")
+    return findings

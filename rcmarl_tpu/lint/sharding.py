@@ -740,6 +740,8 @@ def _determinism_lowering_walk() -> Tuple[List[Finding], List[str]]:
         "fitstack": (tiny_mixed_cfg(fitstack=True), False,
                      ("update_block", "train_block", "fit_block")),
         "gossip": (tiny_gossip_cfg(), False, ("gossip_mix_block",)),
+        "serve": (tiny_cfg(netstack=False), False,
+                  ("serve_block", "eval_block")),
     }
     for arm, (cfg, with_diag, names) in arms.items():
         for name, low in lowered_entry_points(cfg, with_diag, names).items():

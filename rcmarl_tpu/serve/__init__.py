@@ -1,0 +1,14 @@
+"""Policy serving: compile-once batched inference with checkpoint
+hot-swap and guarded degradation (ROADMAP item 5 — the "heavy traffic"
+half of the north star, distinct from the training benchmark axis)."""
+
+from rcmarl_tpu.serve.engine import (  # noqa: F401
+    SERVE_MODES,
+    ServeEngine,
+    eval_block,
+    serve_block,
+    serve_keys,
+    serve_request_keys,
+    stack_actor_rows,
+)
+from rcmarl_tpu.serve.swap import CheckpointWatcher  # noqa: F401

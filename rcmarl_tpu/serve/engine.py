@@ -1,0 +1,302 @@
+"""Policy serving engine — compile-once batched inference.
+
+Training artifacts used to dead-end in checkpoints; this module is the
+"heavy traffic" half of the north star (ROADMAP item 5). The design is
+the TF-Agents batched-inference tradition (PAPERS.md 1709.02878) fused
+with the Podracer device-resident program style (2104.06272): serve by
+compiling ONE stacked program over a huge batch axis, never by looping
+per-agent per-request.
+
+- :func:`stack_actor_rows` — ALL agents' actor heads netstacked into one
+  row-stacked parameter block
+  (:func:`rcmarl_tpu.models.mlp.netstack_stack_rows`, row i = agent i).
+  For the homogeneous actor family the result is bitwise the
+  checkpoint's stacked actor layout; the netstack construction is what
+  keeps the block well-defined if per-agent input widths ever diverge
+  (padded rows are exactly neutral, the PR-4 contract).
+- :func:`serve_block` — the jitted serving program: ``(B, N, obs_dim)``
+  batched observations -> ``(actions, probs)`` in ONE launch (vmapped
+  :func:`~rcmarl_tpu.models.mlp.actor_probs` over the stacked block +
+  per-request categorical sampling). ``mode='greedy'`` is the argmax
+  arm; sampling draws NO exploration mix (serving exploits — the
+  trainer's ε-mix is a training-time knob).
+- :func:`serve_request_keys` — the per-(request, agent) key discipline:
+  ``fold_in(fold_in(key, b), n)``, order-independent and reproducible
+  per request, so a per-agent reference path handed the same keys
+  samples IDENTICAL actions (the parity pin in tests/test_serve.py).
+- :func:`eval_block` — the evaluate rollout program: ``n_ep_fixed``
+  episodes under frozen params plus per-agent discounted returns
+  (the `evaluate` CLI's unit of work).
+- :class:`ServeEngine` — host shell: checksummed checkpoint load
+  (solo↔replica mismatch fails loudly), the stacked block, the
+  deterministic replayable eval stream, and the degradation counters
+  the hot-swap watcher (:mod:`rcmarl_tpu.serve.swap`) maintains.
+
+``serve_block`` and ``eval_block`` are registered jitted entry points
+(:func:`rcmarl_tpu.utils.profiling.jit_entry_points`): the retrace
+auditor proves exactly-once compilation across repeated batches AND
+across a hot-swap of same-shaped params, and the cost/determinism arms
+certify the compiled program like every other hot path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from pathlib import Path
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from rcmarl_tpu.config import Config
+from rcmarl_tpu.models.mlp import (
+    MLPParams,
+    actor_probs,
+    agent_slice,
+    netstack_stack_rows,
+    pad_features,
+)
+
+#: The two serving arms: 'sample' draws one categorical action per
+#: (request, agent) under the fold_in key discipline; 'greedy' is the
+#: deterministic argmax arm (no keys consumed).
+SERVE_MODES = ("sample", "greedy")
+
+
+def stack_actor_rows(params, cfg: Config) -> MLPParams:
+    """All agents' actor nets as ONE row-stacked parameter block.
+
+    Row i is agent i's actor, stacked through
+    :func:`~rcmarl_tpu.models.mlp.netstack_stack_rows` (first-layer
+    rows zero-padded to the widest input, exactly gradient/forward
+    neutral). The actor family is homogeneous (every agent observes the
+    same flattened global state), so today the result is bitwise the
+    checkpoint's stacked ``params.actor`` leaves — pinned in
+    tests/test_serve.py, which is what makes the construction safe to
+    keep on the netstack machinery.
+    """
+    rows = tuple(
+        agent_slice(params.actor, i) for i in range(cfg.n_agents)
+    )
+    return netstack_stack_rows(rows)
+
+
+def serve_request_keys(key: jax.Array, B: int, N: int) -> jax.Array:
+    """The ``(B, N)`` per-(request, agent) sampling keys:
+    ``fold_in(fold_in(key, b), n)`` — order-independent, so the batched
+    program and a per-agent per-request loop handed the same ``key``
+    draw IDENTICAL actions (the serve parity contract)."""
+    rows = jax.vmap(lambda b: jax.random.fold_in(key, b))(jnp.arange(B))
+    return jax.vmap(
+        lambda kr: jax.vmap(lambda n: jax.random.fold_in(kr, n))(
+            jnp.arange(N)
+        )
+    )(rows)
+
+
+def serve_keys(eval_seed: int, step) -> jax.Array:
+    """The deterministic serve stream: launch ``step``'s base key,
+    namespaced by ``eval_seed``. Replaying the same (seed, step) pair
+    replays the exact action stream — the eval arm's parity/pinning
+    discipline (the engine folds this per launch)."""
+    return jax.random.fold_in(jax.random.PRNGKey(eval_seed), step)
+
+
+def _serve_block(
+    cfg: Config,
+    block: MLPParams,
+    obs: jnp.ndarray,
+    key: jax.Array,
+    mode: str = "sample",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """ONE compiled launch serving a whole request batch.
+
+    Args:
+      cfg: static config (hashable — the compile key, like every entry
+        point).
+      block: the row-stacked actor block (:func:`stack_actor_rows`).
+      obs: (B, N, obs_dim) batched observations — row b is one request
+        (a global state), column n the view agent n's actor consumes.
+      key: base PRNG key for this launch (``mode='sample'``); the
+        per-request keys derive via :func:`serve_request_keys`.
+      mode: 'sample' (categorical per request/agent) or 'greedy'
+        (argmax; deterministic, key unused). Static — one program per
+        arm, zero steady-state recompiles across batches and hot-swaps.
+
+    Returns ``(actions, probs)``: (B, N) int32 and (B, N, n_actions)
+    policy probabilities (bitwise the per-agent ``actor_probs`` path —
+    the parity pin).
+    """
+    if mode not in SERVE_MODES:
+        raise ValueError(f"mode={mode!r}: expected one of {SERVE_MODES}")
+    B, N = obs.shape[0], obs.shape[1]
+    # width of the stacked first layer (== obs_dim for the homogeneous
+    # actor family; pad_features is the identity then)
+    x = pad_features(obs, block[0][0].shape[-2])
+    probs = jax.vmap(
+        lambda p, xn: actor_probs(p, xn, cfg.leaky_alpha, cfg.dot_dtype),
+        in_axes=(0, 1),
+        out_axes=1,
+    )(block, x)  # (B, N, n_actions)
+    if mode == "greedy":
+        actions = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+    else:
+        keys = serve_request_keys(key, B, N)
+        actions = jax.vmap(jax.vmap(jax.random.categorical))(
+            keys, jnp.log(probs)
+        ).astype(jnp.int32)
+    return actions, probs
+
+
+#: The jitted serving entry point (registered in
+#: ``utils/profiling.py:jit_entry_points`` — retrace/cost/determinism
+#: audited like every hot path). ``cfg`` and ``mode`` are static; the
+#: block, observations, and key are data, so a hot-swap of same-shaped
+#: params re-dispatches the SAME executable.
+serve_block = partial(
+    jax.jit, static_argnums=0, static_argnames=("mode",)
+)(_serve_block)
+
+
+def _eval_block(cfg: Config, params, desired, key, initial):
+    """The evaluate rollout program: ``n_ep_fixed`` episodes under
+    FROZEN parameters (no updates), returning the per-episode metrics
+    plus each agent's mean discounted return — the `evaluate` CLI's
+    per-block unit, ONE launch per block."""
+    from rcmarl_tpu.training.rollout import rollout_block
+    from rcmarl_tpu.training.trainer import make_env
+
+    fresh, metrics = rollout_block(
+        cfg, make_env(cfg), params, desired, key, initial
+    )
+    # fresh.r: (block_steps, N, 1) in episode order -> per-episode
+    # per-agent discounted returns, averaged over the block's episodes
+    r = fresh.r.reshape(cfg.n_ep_fixed, cfg.max_ep_len, cfg.n_agents)
+    disc = cfg.gamma ** jnp.arange(cfg.max_ep_len, dtype=jnp.float32)
+    agent_returns = jnp.mean(
+        jnp.sum(r * disc[None, :, None], axis=1), axis=0
+    )  # (N,)
+    return metrics, agent_returns
+
+
+#: The jitted evaluate entry point (registered next to serve_block).
+eval_block = partial(jax.jit, static_argnums=0)(_eval_block)
+
+
+class ServeEngine:
+    """Host shell around :func:`serve_block`: load once, serve forever.
+
+    Loads a checksummed checkpoint through the shared discovery chain
+    (:func:`rcmarl_tpu.utils.checkpoint.load_checkpoint_with_meta` —
+    primary, then the rotated ``.prev`` fallback), builds the stacked
+    actor block, and dispatches the compiled program per batch. The
+    engine only ever holds ONE block reference; the hot-swap watcher
+    (:class:`rcmarl_tpu.serve.swap.CheckpointWatcher`) replaces it
+    wholesale after fully validating a candidate, so a swap can never
+    expose a torn tree mid-loop.
+
+    A replica-world checkpoint (``__meta__`` ``replicas > 0``) fails
+    loudly: the serving layout is the SOLO stacked one, and silently
+    serving replica 0 of a gossip run would misreport what was
+    deployed. Non-finite initial params fail loudly too (there is no
+    last-good block to degrade to at construction time).
+
+    ``counters`` is the degradation ledger the summary line reports:
+    ``launches``/``actions`` (traffic), ``swaps`` (hot-swaps applied),
+    ``rejects`` (corrupted / non-finite candidates refused — the engine
+    kept serving the last good block), ``fallbacks`` (loads served by
+    the rotated ``.prev`` instead of the primary).
+    """
+
+    def __init__(
+        self,
+        checkpoint,
+        cfg: Optional[Config] = None,
+        mode: str = "sample",
+        eval_seed: int = 0,
+    ) -> None:
+        from rcmarl_tpu.faults import tree_all_finite
+        from rcmarl_tpu.utils.checkpoint import load_checkpoint_with_meta
+
+        if mode not in SERVE_MODES:
+            raise ValueError(f"mode={mode!r}: expected one of {SERVE_MODES}")
+        self.checkpoint_path = Path(checkpoint)
+        state, stored_cfg, loaded, meta = load_checkpoint_with_meta(
+            self.checkpoint_path, cfg
+        )
+        n_rep = int(meta.get("replicas", 0))
+        if n_rep:
+            raise ValueError(
+                f"checkpoint {loaded} holds a {n_rep}-replica gossip "
+                "world; the serve engine expects a SOLO policy "
+                "checkpoint (replica worlds must be exported/collapsed "
+                "explicitly, never served implicitly)"
+            )
+        if not bool(tree_all_finite(state.params)):
+            raise ValueError(
+                f"checkpoint {loaded} holds non-finite parameters; "
+                "refusing to serve a poisoned policy"
+            )
+        self.cfg = stored_cfg if cfg is None else cfg
+        self.mode = mode
+        self.eval_seed = eval_seed
+        self.block = stack_actor_rows(state.params, self.cfg)
+        #: True while the engine is serving an OLDER block than the
+        #: newest candidate it saw (a rejected swap); cleared by the
+        #: next successful swap — what the summary line's
+        #: 'served: last-good' vs 'served: fresh' status reports.
+        self.degraded = False
+        self.counters = {
+            "launches": 0,
+            "actions": 0,
+            "swaps": 0,
+            "rejects": 0,
+            "fallbacks": 1 if Path(loaded) != self.checkpoint_path else 0,
+        }
+
+    # -- serving -----------------------------------------------------------
+
+    def serve(
+        self,
+        obs: jnp.ndarray,
+        key: Optional[jax.Array] = None,
+        step: Optional[int] = None,
+        mode: Optional[str] = None,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Serve one (B, N, obs_dim) batch -> (actions, probs).
+
+        ``key=None`` uses the deterministic eval stream
+        (:func:`serve_keys` on ``eval_seed`` and the launch counter —
+        or an explicit ``step`` to REPLAY a past launch bit-for-bit).
+        """
+        if key is None:
+            key = serve_keys(
+                self.eval_seed,
+                self.counters["launches"] if step is None else step,
+            )
+        out = serve_block(
+            self.cfg, self.block, obs, key, mode=mode or self.mode
+        )
+        self.counters["launches"] += 1
+        self.counters["actions"] += int(obs.shape[0]) * int(obs.shape[1])
+        return out
+
+    # -- observability -----------------------------------------------------
+
+    def summary(self) -> dict:
+        """The degradation/traffic counters (a copy)."""
+        return dict(self.counters)
+
+    def summary_line(self) -> str:
+        """The one-line serve summary (the CI cell greps
+        ``served: last-good`` off it after a corrupted-swap sequence).
+        The status reflects the CURRENT block: ``last-good`` while the
+        newest candidate was rejected, back to ``fresh`` once a later
+        swap applies."""
+        c = self.counters
+        status = "last-good" if self.degraded else "fresh"
+        return (
+            f"serve: {c['launches']} launches, {c['actions']} actions, "
+            f"{c['swaps']} swaps, {c['rejects']} rejects, "
+            f"{c['fallbacks']} fallbacks (served: {status})"
+        )

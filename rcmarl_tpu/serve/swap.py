@@ -1,0 +1,112 @@
+"""Checkpoint hot-swap with guarded degradation.
+
+The trainer writes checkpoints through the write-then-rename + ``.prev``
+rotation (:mod:`rcmarl_tpu.utils.checkpoint`), so at every instant there
+is a loadable primary and a rotated fallback. This watcher closes the
+loop on the serving side, mirroring the trainer's guard-rail pattern
+(PR 2): poll the file, and when it changes run the candidate through a
+fault guard BEFORE it can reach the engine —
+
+- unreadable / truncated / checksum-failing primary: the shared
+  discovery chain falls back to ``.prev`` (counted as a ``fallback``);
+  if BOTH are bad the candidate is REJECTED and the engine keeps
+  serving the last good block (counted as a ``reject``);
+- non-finite parameters (a poisoned but checksum-valid file): rejected,
+  last good block kept;
+- solo↔replica world mismatch or a structural/shape mismatch against
+  the engine's config: fails LOUDLY (an operator error, not a transport
+  fault — degrading over it would silently serve the wrong policy).
+
+A swap is atomic by construction: the new stacked block is built and
+validated COMPLETELY, then the engine's single block reference is
+replaced wholesale — a serve launched before the assignment uses the
+old tree, one launched after uses the new tree, and no launch can ever
+observe a mix (pinned in tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional
+
+from rcmarl_tpu.serve.engine import ServeEngine, stack_actor_rows
+from rcmarl_tpu.utils.checkpoint import CheckpointError
+
+
+class CheckpointWatcher:
+    """Poll a checkpoint file and hot-swap validated params into an
+    engine, maintaining its degradation counters."""
+
+    def __init__(
+        self, engine: ServeEngine, path: Optional[os.PathLike] = None
+    ) -> None:
+        self.engine = engine
+        self.path = Path(path) if path is not None else engine.checkpoint_path
+        self._sig = self._signature()
+
+    def _signature(self):
+        """(mtime_ns, size, inode) of the primary — the cheap change
+        probe; the rename-based checkpoint write always moves all
+        three."""
+        try:
+            st = os.stat(self.path)
+        except FileNotFoundError:
+            return None
+        return (st.st_mtime_ns, st.st_size, st.st_ino)
+
+    def poll(self, force: bool = False) -> bool:
+        """Check the file; attempt a swap when it changed (or ``force``).
+
+        Returns True iff a swap was APPLIED. A changed-but-rejected
+        candidate returns False with ``rejects`` incremented — the
+        engine keeps serving the last good block either way.
+        """
+        sig = self._signature()
+        if not force and sig == self._sig:
+            return False
+        self._sig = sig
+        return self._try_swap()
+
+    def _try_swap(self) -> bool:
+        from rcmarl_tpu.faults import tree_all_finite
+        from rcmarl_tpu.utils.checkpoint import load_checkpoint_with_meta
+
+        eng = self.engine
+        try:
+            state, _, loaded, meta = load_checkpoint_with_meta(
+                self.path, eng.cfg
+            )
+        except (FileNotFoundError, CheckpointError):
+            # bad FILE (missing, truncated, checksum-failed — and the
+            # .prev fallback too): degrade, keep serving the last good
+            # block
+            eng.counters["rejects"] += 1
+            eng.degraded = True
+            return False
+        # A replica-world checkpoint appearing under a solo serving
+        # path is an operator error — loud, exactly like the engine's
+        # constructor (structure/shape mismatches already raised above).
+        n_rep = int(meta.get("replicas", 0))
+        if n_rep:
+            raise ValueError(
+                f"hot-swap candidate {loaded} holds a {n_rep}-replica "
+                "gossip world; the serving layout is solo — refusing "
+                "the swap loudly (this is a deployment error, not a "
+                "transport fault)"
+            )
+        # fault guard in front of the swap: a checksum-valid file can
+        # still carry poisoned (non-finite) params — never serve them
+        if not bool(tree_all_finite(state.params)):
+            eng.counters["rejects"] += 1
+            eng.degraded = True
+            return False
+        # build + validate COMPLETELY, then swap the single reference:
+        # no serve can ever observe a torn tree
+        block = stack_actor_rows(state.params, eng.cfg)
+        eng.block = block
+        eng.counters["swaps"] += 1
+        eng.degraded = False  # serving the newest candidate again
+        if Path(loaded) != self.path:
+            eng.counters["fallbacks"] += 1
+        return True

@@ -263,6 +263,20 @@ def load_checkpoint_with_fallback(
         return state, stored, prev
 
 
+def load_checkpoint_with_meta(
+    path, cfg: Optional[Config] = None
+) -> Tuple[TrainState, Config, Path, dict]:
+    """The ONE checkpoint-discovery chain shared by ``cmd_train``
+    resume and the serve watcher: :func:`load_checkpoint_with_fallback`
+    (primary, then the rotated ``.prev``) followed by
+    :func:`read_checkpoint_meta` of the file that ACTUALLY served the
+    load. Returns ``(state, stored_cfg, loaded_path, meta)`` — the meta
+    always describes ``loaded_path``, so a fallback load can never pair
+    the previous state with the corrupted primary's header."""
+    state, stored, loaded = load_checkpoint_with_fallback(path, cfg)
+    return state, stored, loaded, read_checkpoint_meta(loaded)
+
+
 def read_checkpoint_meta(path) -> dict:
     """The ``__meta__`` header of a checkpoint (``{}`` when absent) —
     how the gossip resume recovers its round counter and exclusion mask

@@ -47,6 +47,7 @@ def jit_entry_points() -> Dict[str, object]:
     ``utils`` stays cheap to import.
     """
     from rcmarl_tpu.parallel.gossip import gossip_mix_block
+    from rcmarl_tpu.serve.engine import eval_block, serve_block
     from rcmarl_tpu.training.trainer import train_block, train_block_donated
     from rcmarl_tpu.training.update import (
         fit_block,
@@ -61,6 +62,8 @@ def jit_entry_points() -> Dict[str, object]:
         "train_block_donated": train_block_donated,
         "gossip_mix_block": gossip_mix_block,
         "fit_block": fit_block,
+        "serve_block": serve_block,
+        "eval_block": eval_block,
     }
 
 
@@ -207,6 +210,31 @@ def gossip_entry_inputs(cfg):
     return _GOSSIP_INPUT_CACHE[cfg]
 
 
+_SERVE_INPUT_CACHE: dict = {}
+
+#: Canonical serving batch for the audit arms — tiny (the cost rows'
+#: full relative sensitivity is the point), but > 1 so the batch axis
+#: is real in the audited program.
+SERVE_AUDIT_BATCH = 4
+
+
+def serve_entry_inputs(cfg):
+    """(actor block, obs, key): tiny serving inputs for lowering the
+    serve entry point, memoized per config. Derives the block from the
+    SAME memoized :func:`entry_point_inputs` state the other arms use,
+    so a ``lint --all`` run pays no extra init."""
+    if cfg not in _SERVE_INPUT_CACHE:
+        from rcmarl_tpu.serve.engine import stack_actor_rows
+
+        state, _, _, _ = entry_point_inputs(cfg)
+        block = stack_actor_rows(state.params, cfg)
+        obs = jnp.zeros(
+            (SERVE_AUDIT_BATCH, cfg.n_agents, cfg.obs_dim), jnp.float32
+        )
+        _SERVE_INPUT_CACHE[cfg] = (block, obs, jax.random.PRNGKey(2))
+    return _SERVE_INPUT_CACHE[cfg]
+
+
 def lowered_entry_points(
     cfg, with_diag: bool = False, names: Optional[Tuple[str, ...]] = None
 ) -> Dict[str, object]:
@@ -233,6 +261,13 @@ def lowered_entry_points(
                 if name == "gossip_mix_block":
                     params, rnd, excl = gossip_entry_inputs(cfg)
                     lowered = fn.lower(cfg, params, params, rnd, excl)
+                elif name == "serve_block":
+                    block, obs, skey = serve_entry_inputs(cfg)
+                    lowered = fn.lower(cfg, block, obs, skey)
+                elif name == "eval_block":
+                    lowered = fn.lower(
+                        cfg, state.params, state.desired, key, state.initial
+                    )
                 elif name == "fit_block":
                     p = state.params
                     lowered = fn.lower(
@@ -311,7 +346,16 @@ def _traced_entry(cfg, with_diag: bool, name: str):
             _ENTRY_JAXPR_CACHE[cache_key] = (closed, out_shape)
             return _ENTRY_JAXPR_CACHE[cache_key]
         state, batch, fresh, key = entry_point_inputs(cfg)
-        if name == "fit_block":
+        if name == "serve_block":
+            block, obs, skey = serve_entry_inputs(cfg)
+            closed, out_shape = jax.make_jaxpr(
+                lambda bl, o, k: fn(cfg, bl, o, k), return_shape=True
+            )(block, obs, skey)
+        elif name == "eval_block":
+            closed, out_shape = jax.make_jaxpr(
+                lambda p, d, k, i: fn(cfg, p, d, k, i), return_shape=True
+            )(state.params, state.desired, key, state.initial)
+        elif name == "fit_block":
             p = state.params
             closed, out_shape = jax.make_jaxpr(
                 lambda c, b, rc, k: fn(cfg, c, b, rc, k),

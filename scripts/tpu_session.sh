@@ -96,6 +96,12 @@ run_step "4. fused published matrix, one program per phase" \
 run_step "5. headline" \
     timeout 3600 python bench.py
 
+# The serving benchmark axis (PR 10): on-chip actions/sec through the
+# compiled batched inference launch — the committed BENCH_SERVE.jsonl
+# rows are CPU fallbacks (headline:false); this is their TPU refit.
+run_step "6. serve actions/sec refit (batched policy serving headline)" \
+    bash -c 'set -o pipefail; timeout 1800 python bench.py --serve | tee -a BENCH_SERVE.jsonl'
+
 echo "== session summary =="
 rc=0
 for name in "${step_order[@]}"; do

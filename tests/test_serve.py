@@ -1,0 +1,357 @@
+"""Serving subsystem contracts (rcmarl_tpu.serve).
+
+The pins that make the serve path trustworthy:
+
+- batched-vs-per-agent PARITY: the one-launch ``serve_block`` computes
+  probabilities BITWISE equal to the per-agent ``actor_probs`` path
+  (the reference get_action's policy computation), and samples
+  IDENTICAL actions when a per-agent per-request loop is handed the
+  same fold_in keys;
+- hot-swap ATOMICITY: a swap mid-loop replaces the whole block or
+  nothing — no launch ever observes a torn tree;
+- guarded DEGRADATION: corrupted/truncated/non-finite candidates are
+  rejected with counters incremented while the engine keeps serving the
+  last good params; a replica-world checkpoint fails loudly;
+- the bf16 serve arm stays finite.
+
+Everything runs on a tiny 3-agent config with states built directly by
+``init_train_state`` (no training) to stay inside the tier-1 budget.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+from rcmarl_tpu.models.mlp import actor_probs, agent_slice
+from rcmarl_tpu.serve.engine import (
+    ServeEngine,
+    serve_block,
+    serve_keys,
+    serve_request_keys,
+    stack_actor_rows,
+)
+from rcmarl_tpu.serve.swap import CheckpointWatcher
+from rcmarl_tpu.training.trainer import init_train_state
+from rcmarl_tpu.utils.checkpoint import save_checkpoint
+
+
+def tiny_cfg(**overrides):
+    base = dict(
+        n_agents=3,
+        agent_roles=(Roles.COOPERATIVE,) * 3,
+        in_nodes=circulant_in_nodes(3, 3),
+        nrow=3,
+        ncol=3,
+        n_episodes=4,
+        n_ep_fixed=2,
+        max_ep_len=4,
+        n_epochs=2,
+        H=1,
+    )
+    base.update(overrides)
+    return Config(**base)
+
+
+CFG = tiny_cfg()
+STATE = init_train_state(CFG, jax.random.PRNGKey(0))
+STATE_B = init_train_state(CFG, jax.random.PRNGKey(1))
+OBS = jax.random.normal(
+    jax.random.PRNGKey(5), (6, CFG.n_agents, CFG.obs_dim)
+)
+KEY = jax.random.PRNGKey(9)
+
+
+def _leaves_equal(a, b):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def _engine(tmp_path, state=STATE, cfg=CFG, **kw):
+    path = tmp_path / "checkpoint.npz"
+    save_checkpoint(path, state, cfg)
+    return ServeEngine(path, **kw)
+
+
+class TestServeBlock:
+    def test_stacked_block_is_the_checkpoint_actor_layout(self):
+        """netstack_stack_rows over the homogeneous actor family is
+        bitwise the checkpoint's stacked actor leaves (the padding is
+        a provable no-op here)."""
+        _leaves_equal(stack_actor_rows(STATE.params, CFG), STATE.params.actor)
+
+    def test_probs_bitwise_vs_per_agent_path(self):
+        """The batched launch's probabilities == the per-agent eager
+        actor_probs path, bitwise, for every (request, agent)."""
+        _, probs = serve_block(CFG, stack_actor_rows(STATE.params, CFG), OBS, KEY)
+        for n in range(CFG.n_agents):
+            ref = actor_probs(
+                agent_slice(STATE.params.actor, n),
+                OBS[:, n, :],
+                CFG.leaky_alpha,
+                CFG.dot_dtype,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(probs[:, n]), np.asarray(ref)
+            )
+
+    def test_actions_identical_under_shared_keys(self):
+        """A per-agent per-request loop handed the same fold_in keys
+        samples the exact actions the batched launch emitted."""
+        block = stack_actor_rows(STATE.params, CFG)
+        actions, probs = serve_block(CFG, block, OBS, KEY)
+        keys = serve_request_keys(KEY, OBS.shape[0], CFG.n_agents)
+        for b in range(OBS.shape[0]):
+            for n in range(CFG.n_agents):
+                a = jax.random.categorical(keys[b, n], jnp.log(probs[b, n]))
+                assert int(a) == int(actions[b, n]), (b, n)
+
+    def test_greedy_is_argmax(self):
+        block = stack_actor_rows(STATE.params, CFG)
+        actions, probs = serve_block(CFG, block, OBS, KEY, mode="greedy")
+        np.testing.assert_array_equal(
+            np.asarray(actions), np.asarray(jnp.argmax(probs, axis=-1))
+        )
+
+    def test_eval_arm_replays_fixed_seeds(self, tmp_path):
+        """The deterministic eval stream: the same (eval_seed, step)
+        pair replays the exact action stream across engines."""
+        e1 = _engine(tmp_path, eval_seed=7)
+        a1, p1 = e1.serve(OBS, step=3)
+        e2 = ServeEngine(tmp_path / "checkpoint.npz", eval_seed=7)
+        a2, p2 = e2.serve(OBS, step=3)
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+        np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+        # and the explicit-key form agrees with the stream form
+        a3, _ = serve_block(
+            CFG, e1.block, OBS, serve_keys(7, 3)
+        )
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a3))
+
+    def test_bf16_serve_arm_finite(self, tmp_path):
+        """The bfloat16 compute arm serves finite, normalized policies
+        (a distinct jit cache entry — compute_dtype is part of the
+        static config, the PR-8 no-dtype-leak discipline)."""
+        cfg16 = tiny_cfg(compute_dtype="bfloat16")
+        state = init_train_state(cfg16, jax.random.PRNGKey(0))
+        path = tmp_path / "c16.npz"
+        save_checkpoint(path, state, cfg16)
+        eng = ServeEngine(path)
+        actions, probs = eng.serve(OBS)
+        assert np.isfinite(np.asarray(probs)).all()
+        np.testing.assert_allclose(
+            np.asarray(probs).sum(-1), 1.0, rtol=1e-5
+        )
+        assert np.asarray(actions).shape == (OBS.shape[0], CFG.n_agents)
+
+
+class TestHotSwap:
+    def test_swap_applies_new_params_atomically(self, tmp_path):
+        """Swap mid-loop: every launch is either pure-A or pure-B —
+        the engine's single block reference is replaced wholesale, so
+        the post-swap launch equals a pure-B engine's output bitwise."""
+        eng = _engine(tmp_path)
+        watcher = CheckpointWatcher(eng)
+        a_before, p_before = eng.serve(OBS, key=KEY)
+        assert watcher.poll() is False  # unchanged file: no-op
+        save_checkpoint(tmp_path / "checkpoint.npz", STATE_B, CFG)
+        assert watcher.poll() is True
+        _leaves_equal(eng.block, STATE_B.params.actor)  # the WHOLE tree
+        a_after, p_after = eng.serve(OBS, key=KEY)
+        # pure-B reference output (fresh block, same key)
+        ref_a, ref_p = serve_block(
+            CFG, stack_actor_rows(STATE_B.params, CFG), OBS, KEY
+        )
+        np.testing.assert_array_equal(np.asarray(a_after), np.asarray(ref_a))
+        np.testing.assert_array_equal(np.asarray(p_after), np.asarray(ref_p))
+        # and the pre-swap launch was pure-A
+        ref_a0, _ = serve_block(
+            CFG, stack_actor_rows(STATE.params, CFG), OBS, KEY
+        )
+        np.testing.assert_array_equal(np.asarray(a_before), np.asarray(ref_a0))
+        assert eng.counters["swaps"] == 1
+        assert eng.counters["rejects"] == 0
+
+    def test_corrupted_candidate_serves_last_good(self, tmp_path):
+        """Corrupting BOTH the primary and its .prev rotation must be
+        rejected (counter incremented) with the engine still serving
+        the pre-corruption block bitwise."""
+        eng = _engine(tmp_path)
+        watcher = CheckpointWatcher(eng)
+        save_checkpoint(tmp_path / "checkpoint.npz", STATE_B, CFG)
+        assert watcher.poll() is True
+        for name in ("checkpoint.npz", "checkpoint.npz.prev"):
+            with open(tmp_path / name, "r+b") as f:
+                f.seek(100)
+                f.write(b"\xde\xad\xbe\xef" * 16)
+        assert watcher.poll() is False
+        assert eng.counters["rejects"] == 1
+        _leaves_equal(eng.block, STATE_B.params.actor)  # last good kept
+        assert "served: last-good" in eng.summary_line()
+
+    def test_corrupt_primary_falls_back_to_prev(self, tmp_path):
+        """A corrupted primary with a good .prev swaps the PREVIOUS
+        params in (the discovery chain's fallback), counted as a
+        fallback, not a reject."""
+        eng = _engine(tmp_path)
+        watcher = CheckpointWatcher(eng)
+        save_checkpoint(tmp_path / "checkpoint.npz", STATE_B, CFG)
+        # primary = B, .prev = A; corrupt only the primary
+        with open(tmp_path / "checkpoint.npz", "r+b") as f:
+            f.seek(100)
+            f.write(b"\xde\xad\xbe\xef" * 16)
+        assert watcher.poll() is True
+        _leaves_equal(eng.block, STATE.params.actor)  # .prev holds A
+        assert eng.counters["fallbacks"] == 1
+        assert eng.counters["rejects"] == 0
+
+    def test_status_recovers_after_successful_swap(self, tmp_path):
+        """'served: last-good' reflects the CURRENT block: a rejected
+        candidate degrades the status, the next applied swap restores
+        'served: fresh' (the counters keep the full history)."""
+        eng = _engine(tmp_path)
+        watcher = CheckpointWatcher(eng)
+        save_checkpoint(tmp_path / "checkpoint.npz", STATE_B, CFG)
+        assert watcher.poll() is True
+        for name in ("checkpoint.npz", "checkpoint.npz.prev"):
+            with open(tmp_path / name, "r+b") as f:
+                f.seek(100)
+                f.write(b"\xde\xad\xbe\xef" * 16)
+        assert watcher.poll() is False
+        assert "served: last-good" in eng.summary_line()
+        save_checkpoint(tmp_path / "checkpoint.npz", STATE, CFG)  # fixed deploy
+        assert watcher.poll() is True
+        assert "served: fresh" in eng.summary_line()
+        assert eng.counters["rejects"] == 1  # history preserved
+
+    def test_nonfinite_candidate_rejected(self, tmp_path):
+        """A checksum-valid file carrying NaN params is refused by the
+        fault guard in front of the swap."""
+        eng = _engine(tmp_path)
+        watcher = CheckpointWatcher(eng)
+        poisoned = STATE_B._replace(
+            params=STATE_B.params._replace(
+                actor=jax.tree.map(
+                    lambda l: l.at[0].set(jnp.nan), STATE_B.params.actor
+                )
+            )
+        )
+        save_checkpoint(tmp_path / "checkpoint.npz", poisoned, CFG)
+        assert watcher.poll() is False
+        assert eng.counters["rejects"] == 1
+        _leaves_equal(eng.block, STATE.params.actor)
+
+    def test_replica_world_fails_loudly(self, tmp_path):
+        """A replica-stacked gossip checkpoint must raise at engine
+        construction AND at hot-swap — never silently serve replica 0."""
+        states = jax.vmap(lambda k: init_train_state(CFG, k))(
+            jax.random.split(jax.random.PRNGKey(0), 2)
+        )
+        rpath = tmp_path / "replica.npz"
+        save_checkpoint(
+            rpath, states, CFG,
+            meta={"replicas": 2, "gossip_round": 0, "excluded": [False] * 2},
+        )
+        with pytest.raises(ValueError, match="replica"):
+            ServeEngine(rpath)
+        eng = _engine(tmp_path)
+        watcher = CheckpointWatcher(eng)
+        save_checkpoint(
+            tmp_path / "checkpoint.npz", states, CFG,
+            meta={"replicas": 2, "gossip_round": 0, "excluded": [False] * 2},
+        )
+        with pytest.raises(ValueError, match="replica"):
+            watcher.poll()
+
+    def test_nonfinite_initial_checkpoint_refused(self, tmp_path):
+        """At construction there is no last-good block to degrade to:
+        a poisoned initial checkpoint is a loud error."""
+        poisoned = STATE._replace(
+            params=STATE.params._replace(
+                actor=jax.tree.map(
+                    lambda l: l.at[0].set(jnp.inf), STATE.params.actor
+                )
+            )
+        )
+        path = tmp_path / "bad.npz"
+        save_checkpoint(path, poisoned, CFG)
+        with pytest.raises(ValueError, match="non-finite"):
+            ServeEngine(path)
+
+
+class TestServeCLI:
+    def test_serve_cli_emits_actions_per_sec_row(self, tmp_path, capsys):
+        import json
+
+        from rcmarl_tpu.cli import main
+
+        path = tmp_path / "checkpoint.npz"
+        save_checkpoint(path, STATE, CFG)
+        assert main([
+            "serve", "--checkpoint", str(path),
+            "--batch", "8", "--steps", "2", "--reps", "1",
+            "--obs_buffers", "2",
+        ]) == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        row = json.loads(out[0])
+        assert row["kind"] == "serve"
+        assert row["actions_per_sec"] > 0
+        assert row["cost_fingerprint"]
+        assert row["headline"] is False  # CPU row discipline
+        assert row["degradation"]["rejects"] == 0
+        assert "served: fresh" in out[-1]
+
+    def test_evaluate_cli_emits_stats_row(self, tmp_path, capsys):
+        import json
+
+        from rcmarl_tpu.cli import main
+
+        path = tmp_path / "checkpoint.npz"
+        save_checkpoint(path, STATE, CFG)
+        assert main([
+            "evaluate", "--checkpoint", str(path), "--episodes", "2",
+        ]) == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        row = json.loads(out[0])
+        assert row["kind"] == "evaluate"
+        assert row["episodes"] == CFG.n_ep_fixed  # rounded up to a block
+        assert len(row["per_agent_returns"]) == CFG.n_agents
+        assert np.isfinite(row["team_return_mean"])
+
+    def test_evaluate_rejects_replica_checkpoint(self, tmp_path):
+        from rcmarl_tpu.cli import main
+
+        states = jax.vmap(lambda k: init_train_state(CFG, k))(
+            jax.random.split(jax.random.PRNGKey(0), 2)
+        )
+        path = tmp_path / "replica.npz"
+        save_checkpoint(
+            path, states, CFG,
+            meta={"replicas": 2, "gossip_round": 0, "excluded": [False] * 2},
+        )
+        with pytest.raises(SystemExit, match="replica"):
+            main(["evaluate", "--checkpoint", str(path)])
+
+
+class TestEvalBlock:
+    def test_eval_block_shapes_and_finiteness(self):
+        from rcmarl_tpu.serve.engine import eval_block
+
+        metrics, agent_returns = eval_block(
+            CFG, STATE.params, STATE.desired, KEY, STATE.initial
+        )
+        assert np.asarray(metrics.true_team_returns).shape == (CFG.n_ep_fixed,)
+        assert np.asarray(agent_returns).shape == (CFG.n_agents,)
+        assert np.isfinite(np.asarray(agent_returns)).all()
+        # per-agent returns are consistent with the team metric: the
+        # cooperative mean of per-agent discounted returns equals the
+        # mean over episodes of true_team_returns (all-coop cast)
+        np.testing.assert_allclose(
+            np.asarray(agent_returns).mean(),
+            np.asarray(metrics.true_team_returns).mean(),
+            rtol=1e-5,
+        )
