@@ -504,6 +504,73 @@ def bf16_parity_section(artifact_path) -> list:
     ]
 
 
+def staleness_section(artifact_path) -> list:
+    """QUALITY.md lines for the pipeline staleness quality cell,
+    rendered from the committed ``scripts/staleness_quality.py``
+    artifact (``simulation_results/staleness_quality.json``) — same
+    byte-stable render-from-evidence contract as the gossip/bf16
+    sections. Empty when the artifact does not exist."""
+    p = Path(artifact_path)
+    if not p.exists():
+        return []
+    d = json.loads(p.read_text())
+    cfg = d["config"]
+    lines = [
+        "",
+        "## Pipeline staleness vs return",
+        "",
+        "The async actor-learner pipeline (`Config.pipeline_depth`, "
+        "README \"Async pipeline\") buys rollout-in-the-epoch's-shadow "
+        "throughput by letting the actor tier act on parameters the "
+        "learner published up to depth-1 (+ publish-lag) blocks ago — "
+        "the same replay semantics the `stale_p` link-fault knob "
+        "injects per link, lifted to the whole policy and made a "
+        "SCHEDULED quantity the trainer counts per block "
+        "(`df.attrs['pipeline']`). The committed sweep "
+        f"(`{p.name}`, `scripts/staleness_quality.py`: "
+        f"{cfg['scenario']}, {cfg['episodes']} episodes, seed "
+        f"{cfg['seed']}, depth {cfg['depth']}, measured on "
+        f"{d['platform']}) holds the depth fixed and sweeps "
+        "`publish_every`, so the off-policy axis is isolated from the "
+        "overlap machinery:",
+        "",
+        "| arm | measured staleness (mean / max blocks) | final return "
+        f"| episodes to sync threshold ({d['threshold']}) | verdict |",
+        "|---|---|---|---|---|",
+    ]
+    for arm in d["arms"]:
+        ep = arm["ep_to_threshold"]
+        verdict = (
+            "within the sync band"
+            if arm["within_band"]
+            else "**OUTSIDE the sync band**"
+        )
+        if arm["pipeline_depth"] == 0:
+            verdict = "— (threshold source)"
+        lines.append(
+            f"| {arm['label']} | {arm['staleness_mean']} / "
+            f"{arm['staleness_max']} | {arm['final_return']} | "
+            f"{ep if ep is not None else 'not reached'} | {verdict} |"
+        )
+    lines += [
+        "",
+        "Reading: exactly like the `stale_p` degradation curves above, "
+        "the cost of staleness shows up FIRST as sample efficiency "
+        "(episodes-to-threshold stretches monotonically with the "
+        "measured staleness) and only later as converged quality — an "
+        "arm is usable as long as its final return stays inside the "
+        "synchronous arm's own quality band (the PARITY.md tolerance "
+        f"of {cfg['tol']:.0%}). The staleness column is the MEASURED "
+        "per-run counter, not the configured knob: depth and "
+        "publish_every compose (steady state ≈ depth-1 + the average "
+        "publish lag), and the ramp blocks at the start pull the mean "
+        "below the steady state. Pick the publish cadence by this "
+        "table, not by intuition; the TPU session re-measures the "
+        "throughput side of the trade (tpu_session.sh).",
+    ]
+    return lines
+
+
 def write_quality_md(
     table: pd.DataFrame,
     out_path,
@@ -700,6 +767,10 @@ def write_quality_md(
         Path(out_path).parent / "simulation_results/bf16_parity.json"
     )
     lines += bf16_parity_section(bf16_artifact)
+    staleness_artifact = (
+        Path(out_path).parent / "simulation_results/staleness_quality.json"
+    )
+    lines += staleness_section(staleness_artifact)
     lines += [
         "",
         "## Related artifacts",
@@ -723,6 +794,12 @@ def write_quality_md(
             "- `simulation_results/bf16_parity.json` — the measured "
             "bf16-vs-f32 returns-curve agreement cell behind the mixed-"
             "precision section (`scripts/bf16_parity.py`)"
+        )
+    if staleness_artifact.exists():
+        lines.append(
+            "- `simulation_results/staleness_quality.json` — the "
+            "measured staleness-vs-return sweep behind the pipeline "
+            "staleness section (`scripts/staleness_quality.py`)"
         )
     # like cmd_parity's related-artifacts list: only link the robustness
     # companion when it exists, and never from itself

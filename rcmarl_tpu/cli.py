@@ -168,7 +168,34 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         help="matmul compute precision: float32 = reference-parity, "
         "bfloat16 = MXU-native inputs with f32 accumulation (scale-out)",
     )
+    _add_pipeline_flags(p)
     _add_fault_flags(p)
+
+
+def _add_pipeline_flags(p: argparse.ArgumentParser) -> None:
+    """Async actor-learner pipeline knobs (rcmarl_tpu.pipeline)."""
+    g = p.add_argument_group("async actor-learner pipeline")
+    g.add_argument(
+        "--pipeline_depth",
+        type=int,
+        default=0,
+        help="rollout blocks the actor tier runs AHEAD of the learner "
+        "(rcmarl_tpu.pipeline): 0 = synchronous handoff (the fused "
+        "reference block, bitwise the historical trainer), >= 2 = "
+        "rollout dispatched into the epoch's shadow at depth-1 epochs "
+        "of measured parameter staleness (counted per block in "
+        "df.attrs['pipeline'] and the summary line)",
+    )
+    g.add_argument(
+        "--publish_every",
+        type=int,
+        default=1,
+        help="the learner publishes its params to the actor tier every "
+        "K blocks (validate-then-swap-wholesale, the in-memory twin of "
+        "the serving hot-swap chain); K > 1 adds up to K-1 blocks of "
+        "staleness — the off-policy axis the staleness quality cell "
+        "sweeps (QUALITY.md)",
+    )
 
 
 def _add_fault_flags(p: argparse.ArgumentParser) -> None:
@@ -400,6 +427,8 @@ def config_from_args(args) -> Config:
         netstack=_netstack_value(getattr(args, "netstack", "auto")),
         fitstack=_netstack_value(getattr(args, "fitstack", "auto")),
         compute_dtype=args.compute_dtype,
+        pipeline_depth=getattr(args, "pipeline_depth", 0),
+        publish_every=getattr(args, "publish_every", 1),
         fault_plan=fault_plan_from_args(args),
         consensus_sanitize=args.sanitize,
         replicas=getattr(args, "replicas", 0),
@@ -607,6 +636,17 @@ def cmd_train(argv) -> int:
                 # a resume
                 "excluded": g["excluded_mask"],
             }
+        elif cfg.pipeline_depth:
+            from rcmarl_tpu.pipeline.trainer import train_pipelined
+
+            state, sim_data = train_pipelined(
+                cfg,
+                state=state,
+                verbose=not args.quiet,
+                block_callback=checkpoint_cb,
+                guard={"auto": None, "on": True, "off": False}[args.guard],
+                max_retries=args.max_retries,
+            )
         else:
             state, sim_data = train(
                 cfg,
@@ -617,6 +657,10 @@ def cmd_train(argv) -> int:
                 max_retries=args.max_retries,
             )
     dt = time.perf_counter() - t0
+    if "pipeline" in sim_data.attrs:
+        from rcmarl_tpu.pipeline.trainer import pipeline_summary
+
+        print(pipeline_summary(sim_data.attrs["pipeline"]))
     if "guard" in sim_data.attrs:
         g = sim_data.attrs["guard"]
         print(
@@ -1147,6 +1191,98 @@ def _netstack_arm_flag(p: argparse.ArgumentParser) -> None:
     )
 
 
+def _bench_pipeline_cell(args, name: str, cfg, depth: int) -> int:
+    """One sync-vs-pipelined bench cell (`bench --pipeline_depth ...`):
+    ``args.blocks`` training blocks through the host-looped pipelined
+    trainer — depth 0 dispatches the fused synchronous block through
+    the SAME harness, so the depth-0 row is the honest sync arm of the
+    A/B — best-of-``reps`` wall clock, rows carrying the measured
+    staleness counters and the combined actor+learner
+    ``cost_fingerprint``. Returns 1 on cell failure (the bench
+    fault-isolation discipline), else 0."""
+    import jax
+
+    from rcmarl_tpu.ops.aggregation import resolve_impl
+    from rcmarl_tpu.pipeline.trainer import (
+        pipeline_fingerprint,
+        train_pipelined,
+    )
+    from rcmarl_tpu.training.update import fitstack_enabled, netstack_enabled
+    from rcmarl_tpu.utils.profiling import Timer, train_block_fingerprint
+
+    pcfg = cfg.replace(
+        pipeline_depth=depth, publish_every=args.publish_every
+    )
+    n_eps = args.blocks * pcfg.n_ep_fixed
+    try:
+        fingerprint = (
+            train_block_fingerprint(pcfg)
+            if depth == 0
+            else pipeline_fingerprint(pcfg)
+        )
+        state, df = train_pipelined(pcfg, n_episodes=n_eps)  # compile + warm
+        attrs = df.attrs["pipeline"]
+        best = float("inf")
+        for _ in range(args.reps):
+            t = Timer().start()
+            state, df = train_pipelined(pcfg, n_episodes=n_eps, state=state)
+            best = min(best, t.stop(state.params))
+            attrs = df.attrs["pipeline"]
+    except Exception as e:  # noqa: BLE001 — bench fault isolation
+        _emit(
+            json.dumps(
+                {
+                    "config": name,
+                    "pipeline_depth": depth,
+                    "publish_every": args.publish_every,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+            ),
+            args.out,
+            err=True,
+        )
+        return 1
+    steps = args.blocks * pcfg.block_steps
+    row = json.dumps(
+        {
+            "kind": "pipeline",
+            "config": name,
+            "impl": pcfg.consensus_impl,
+            "impl_resolved": resolve_impl(
+                pcfg.consensus_impl, pcfg.n_in,
+                n_agents=pcfg.n_agents, H=pcfg.H,
+            ),
+            "netstack": netstack_enabled(pcfg),
+            "fitstack": fitstack_enabled(pcfg),
+            "compute_dtype": pcfg.compute_dtype,
+            "n_agents": pcfg.n_agents,
+            "n_in": pcfg.n_in,
+            "hidden": list(pcfg.hidden),
+            "H": pcfg.H,
+            "pipeline_depth": depth,
+            "publish_every": args.publish_every,
+            "staleness_mean": round(attrs["staleness_mean"], 3),
+            "staleness_max": attrs["staleness_max"],
+            "publishes": attrs["publishes"],
+            "cost_fingerprint": fingerprint,
+            "env_steps_per_sec": round(steps / best, 1),
+            "sec_per_block": round(best / args.blocks, 4),
+            "workload": {
+                "blocks": args.blocks,
+                "reps": args.reps,
+                "block_steps": pcfg.block_steps,
+            },
+            "platform": jax.devices()[0].platform,
+            # headline discipline: only an on-chip row is a TPU
+            # shadow-overlap claim; CPU rows are honest fallbacks
+            "headline": jax.devices()[0].platform == "tpu",
+            "timestamp": datetime.now().isoformat(timespec="seconds"),
+        }
+    )
+    _emit(row, args.out)
+    return 0
+
+
 def cmd_bench(argv) -> int:
     p = argparse.ArgumentParser(
         prog="rcmarl_tpu bench",
@@ -1199,6 +1335,26 @@ def cmd_bench(argv) -> int:
         "MXU-native inputs, f32 accumulation)",
     )
     p.add_argument(
+        "--pipeline_depth",
+        nargs="+",
+        type=int,
+        default=[0],
+        help="async-pipeline arm(s) to compare (rcmarl_tpu.pipeline): "
+        "any nonzero depth switches the WHOLE depth list to the "
+        "host-looped pipelined harness, so the depth-0 row is the "
+        "synchronous fused block measured through the SAME harness "
+        "(the honest A/B); rows carry the measured staleness counters "
+        "and a combined actor+learner cost_fingerprint. Default [0]: "
+        "the historical device-scanned path, untouched",
+    )
+    p.add_argument(
+        "--publish_every",
+        type=int,
+        default=1,
+        help="learner->actor publish cadence for the pipelined arms "
+        "(blocks; see rcmarl_tpu.pipeline.publish)",
+    )
+    p.add_argument(
         "--out",
         type=str,
         default=None,
@@ -1208,6 +1364,10 @@ def cmd_bench(argv) -> int:
     args = p.parse_args(argv)
     if args.blocks < 1 or args.reps < 1 or args.n_ep_fixed < 1:
         raise SystemExit("--blocks, --reps, and --n_ep_fixed must be >= 1")
+    if any(d < 0 for d in args.pipeline_depth) or args.publish_every < 1:
+        raise SystemExit(
+            "--pipeline_depth arms must be >= 0 and --publish_every >= 1"
+        )
 
     import jax
 
@@ -1218,10 +1378,14 @@ def cmd_bench(argv) -> int:
     from rcmarl_tpu.utils.profiling import Timer, mesh_fingerprint
 
     shard_modes = [None] if args.shard_agents is None else args.shard_agents
+    # any nonzero depth switches the WHOLE list to the host-looped
+    # pipelined harness (the depth-0 row then measures the fused sync
+    # block through the same harness — the honest sync-vs-pipelined A/B)
+    pipeline_mode = any(d > 0 for d in args.pipeline_depth)
     n_failed = 0
-    for name, dtype, impl, layout, ns, fs, shard in itertools.product(
+    for name, dtype, impl, layout, ns, fs, shard, depth in itertools.product(
         args.configs, args.compute_dtype, args.impl, args.layout,
-        args.netstack, args.fitstack, shard_modes,
+        args.netstack, args.fitstack, shard_modes, args.pipeline_depth,
     ):
         cfg = _bench_config(
             name, impl, args.n_ep_fixed, dtype, layout,
@@ -1234,6 +1398,18 @@ def cmd_bench(argv) -> int:
                 "per-leaf layout only exists on the dual-launch arm",
                 file=sys.stderr,
             )
+            continue
+        if pipeline_mode:
+            if shard is not None:
+                print(
+                    f"# skip {name} pipeline_depth={depth} "
+                    "shard_agents: the pipelined harness is the "
+                    "single-device host loop (the sharded pipeline "
+                    "rides the TPU session)",
+                    file=sys.stderr,
+                )
+                continue
+            n_failed += _bench_pipeline_cell(args, name, cfg, depth)
             continue
         fingerprint = None
         if shard is None:
@@ -1400,6 +1576,21 @@ def cmd_profile(argv) -> int:
     p.add_argument("--n_ep_fixed", type=int, default=10)
     p.add_argument("--reps", type=int, default=3)
     p.add_argument(
+        "--pipeline_depth",
+        type=int,
+        default=0,
+        help="tag the breakdown rows with an async-pipeline depth "
+        "(rcmarl_tpu.pipeline) — the per-phase timings are what the "
+        "shadow math reads: rollout_block is the cost depth >= 2 hides "
+        "inside ms_epochs_total (the rollout_shadow_fraction field)",
+    )
+    p.add_argument(
+        "--publish_every",
+        type=int,
+        default=1,
+        help="learner->actor publish cadence tag for pipelined rows",
+    )
+    p.add_argument(
         "--out",
         type=str,
         default=None,
@@ -1408,6 +1599,10 @@ def cmd_profile(argv) -> int:
     args = p.parse_args(argv)
     if args.reps < 1 or args.n_ep_fixed < 1:
         raise SystemExit("--reps and --n_ep_fixed must be >= 1")
+    if args.pipeline_depth < 0 or args.publish_every < 1:
+        raise SystemExit(
+            "--pipeline_depth must be >= 0 and --publish_every >= 1"
+        )
 
     import jax
 
@@ -1429,6 +1624,9 @@ def cmd_profile(argv) -> int:
             name, impl, args.n_ep_fixed, dtype, layout,
             netstack=_netstack_value(ns),
             fitstack=_netstack_value(fs),
+        ).replace(
+            pipeline_depth=args.pipeline_depth,
+            publish_every=args.publish_every,
         )
         if netstack_enabled(cfg) and layout == "per_leaf":
             print(
@@ -1481,6 +1679,8 @@ def cmd_profile(argv) -> int:
                 "n_agents": cfg.n_agents,
                 "hidden": list(cfg.hidden),
                 "H": cfg.H,
+                "pipeline_depth": cfg.pipeline_depth,
+                "publish_every": cfg.publish_every,
                 "cost_fingerprint": fingerprint,
                 "ms": {k: round(v * 1e3, 3) for k, v in phases.items()},
                 "ms_epochs_total": round(
@@ -1488,6 +1688,15 @@ def cmd_profile(argv) -> int:
                 ),
                 "ms_unfused_sum": round(unfused * 1e3, 3),
                 "fusion_speedup": round(unfused / phases["full_block"], 3),
+                # the async-pipeline shadow budget: the rollout cost a
+                # depth>=2 pipeline hides inside the epoch run, as a
+                # fraction of the epochs it hides in (< 1 means the
+                # shadow fully covers it on overlap-capable hardware)
+                "rollout_shadow_fraction": round(
+                    phases["rollout_block"]
+                    / max(cfg.n_epochs * phases["critic_tr_epoch"], 1e-9),
+                    4,
+                ),
                 "workload": {
                     "n_ep_fixed": args.n_ep_fixed,
                     "reps": args.reps,

@@ -253,6 +253,25 @@ class Config:
     gossip_mix: str = "trimmed"
     gossip_seed: int = 0
     replica_fault_plan: Optional[ReplicaFaultPlan] = None
+    # --- async actor-learner pipeline (rcmarl_tpu.pipeline) ---
+    # pipeline_depth: how many rollout blocks the actor tier runs AHEAD
+    # of the learner tier (the Podracer/TorchBeast split). 0 (default) =
+    # synchronous handoff: the fused one-launch train block, bit-for-bit
+    # the historical train() behavior — the pinned reference arm.
+    # 1 = decoupled actor/learner programs with a direct (staleness-0)
+    # handoff; >= 2 = genuinely pipelined: rollout block b+depth is
+    # dispatched while epoch b+1 runs, so rollout cost hides in the
+    # epoch's shadow at the price of acting on parameters
+    # depth-1 (+ publish lag) updates stale. Staleness is COUNTED per
+    # block (df.attrs['pipeline'], train summary line), never silent.
+    # publish_every: the learner publishes its parameters to the actor
+    # tier every K blocks (the in-memory twin of the serving
+    # checkpoint hot-swap chain — validate fully, then swap the single
+    # acting-params reference wholesale). K > 1 adds up to K-1 blocks
+    # of staleness on top of the depth: the measured off-policy axis
+    # the staleness quality cell sweeps (QUALITY.md).
+    pipeline_depth: int = 0
+    publish_every: int = 1
     # --- matmul compute precision ---
     # 'float32' (default): true-fp32 dots, the reference-parity path.
     # 'bfloat16': opt-in scale-out mode — matmul inputs in the MXU's
@@ -313,8 +332,27 @@ class Config:
                 f"(got {type(self.fault_plan).__name__}); dicts don't "
                 "hash and would break jit-staticness"
             )
+        if self.pipeline_depth < 0:
+            raise ValueError(
+                f"pipeline_depth={self.pipeline_depth} must be >= 0 "
+                "(0 = synchronous handoff, the reference arm)"
+            )
+        if self.publish_every < 1:
+            raise ValueError(
+                f"publish_every={self.publish_every} must be >= 1 "
+                "(the learner publishes at least every K blocks; an "
+                "actor that never refreshes is not an experiment arm)"
+            )
         if self.replicas < 0:
             raise ValueError(f"replicas={self.replicas} must be >= 0")
+        if self.replicas and self.pipeline_depth:
+            raise ValueError(
+                f"pipeline_depth={self.pipeline_depth} with "
+                f"replicas={self.replicas}: the pipelined gossip-replica "
+                "learner tier is queued for the on-chip session "
+                "(tpu_session.sh) — run the replica set synchronously "
+                "(pipeline_depth=0) or pipeline a solo learner"
+            )
         if self.gossip_every < 0:
             raise ValueError(
                 f"gossip_every={self.gossip_every} must be >= 0 "

@@ -489,6 +489,20 @@ def tree_all_finite(tree):
     return jnp.stack(leaves).all()
 
 
+def params_finite(params) -> bool:
+    """Host bool: a candidate parameter tree is fully finite — THE
+    publish/hot-swap guard, shared by every chain that swaps a policy
+    into a running consumer (the serving engine's constructor and
+    checkpoint watcher, :mod:`rcmarl_tpu.serve`, and the pipeline's
+    in-memory publisher, :mod:`rcmarl_tpu.pipeline.publish`). A
+    poisoned-but-well-formed candidate (the transport threat model
+    above, landed in a parameter tree) must be rejected BEFORE the
+    swap, with the consumer kept on its last good tree. Host-syncing —
+    callers that need block-free handoff only validate when a guard is
+    active."""
+    return bool(tree_all_finite(params))
+
+
 def tree_finite_per_replica(tree):
     """(R,) numpy bool: :func:`tree_all_finite` factored per LEADING index.
 

@@ -66,6 +66,8 @@ _ANCHORS = {
     "fit_block": "rcmarl_tpu/training/update.py",
     "serve_block": "rcmarl_tpu/serve/engine.py",
     "eval_block": "rcmarl_tpu/serve/engine.py",
+    "actor_block": "rcmarl_tpu/serve/engine.py",
+    "learner_block": "rcmarl_tpu/pipeline/trainer.py",
     "aggregation": "rcmarl_tpu/ops/aggregation.py",
 }
 
@@ -230,6 +232,16 @@ def cost_arms() -> Dict[str, tuple]:
             tiny_cfg(netstack=False),
             False,
             ("serve_block", "eval_block"),
+        ),
+        # the async pipeline's two tiers: the actor-tier rollout
+        # program and the learner block (undonated + donated twins) at
+        # a pipelined-depth config — "the decoupled tiers grew
+        # heavier/diverged from the fused block" is a ledger fact, and
+        # the donated twin's alias_bytes are on record next to it
+        "pipeline": (
+            tiny_cfg(pipeline_depth=2),
+            False,
+            ("actor_block", "learner_block", "learner_block_donated"),
         ),
     }
 

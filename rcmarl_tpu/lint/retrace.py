@@ -271,7 +271,33 @@ def audit_retrace(
             start_round=df.attrs["gossip"]["gossip_round"],
         )
     auditor.findings.extend(_audit_serve(auditor, steady_blocks))
+    _audit_pipeline(auditor, steady_blocks)
     return auditor.findings
+
+
+def _audit_pipeline(auditor: "RetraceAuditor", steady_blocks: int) -> None:
+    """The pipelined compile-once case: a depth-2 pipelined train
+    (actor tier = ``actor_block`` acting on published params, learner
+    tier = the donated ``learner_block``) warms up once, then a resumed
+    steady run — spanning publisher hot-swap rounds every block — must
+    re-dispatch the same two executables with ZERO recompiles: the
+    acting parameters are data, exactly like the serving hot-swap, so a
+    publish can never be a compile."""
+    from rcmarl_tpu.lint.configs import tiny_cfg
+    from rcmarl_tpu.pipeline.trainer import train_pipelined
+
+    cfg = tiny_cfg(pipeline_depth=2)
+    # warmup: compiles actor_block + learner_block_donated (prefill +
+    # two learner blocks, one publish round)
+    state, _ = train_pipelined(cfg, n_episodes=cfg.n_ep_fixed * 2)
+    with auditor.expect_no_compiles(
+        context="pipelined actor/learner across publish rounds"
+    ):
+        train_pipelined(
+            cfg,
+            n_episodes=cfg.n_ep_fixed * (steady_blocks + 1),
+            state=state,
+        )
 
 
 def _audit_serve(

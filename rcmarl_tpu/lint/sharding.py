@@ -742,6 +742,9 @@ def _determinism_lowering_walk() -> Tuple[List[Finding], List[str]]:
         "gossip": (tiny_gossip_cfg(), False, ("gossip_mix_block",)),
         "serve": (tiny_cfg(netstack=False), False,
                   ("serve_block", "eval_block")),
+        "pipeline": (tiny_cfg(pipeline_depth=2), False,
+                     ("actor_block", "learner_block",
+                      "learner_block_donated")),
     }
     for arm, (cfg, with_diag, names) in arms.items():
         for name, low in lowered_entry_points(cfg, with_diag, names).items():

@@ -1,0 +1,22 @@
+"""Async actor-learner pipeline (ROADMAP item 1).
+
+Runs the actor tier (the serving engine's compile-once rollout program,
+:func:`rcmarl_tpu.serve.engine.actor_block`) and the learner tier (the
+donated block-stepping epoch) as decoupled stages of ONE continuous
+system: rollout blocks are dispatched up to ``Config.pipeline_depth``
+blocks ahead of the learner through a bounded on-device queue with
+``block_until_ready``-free handoff, acting on parameters the learner
+publishes every ``Config.publish_every`` blocks through a
+validate-then-swap-wholesale publisher (the in-memory twin of the
+serving checkpoint hot-swap chain). Off-policy staleness is a counted,
+first-class quantity — never an accident (``df.attrs['pipeline']``).
+"""
+
+from rcmarl_tpu.pipeline.publish import PolicyPublisher  # noqa: F401
+from rcmarl_tpu.pipeline.queue import BlockQueue  # noqa: F401
+from rcmarl_tpu.pipeline.trainer import (  # noqa: F401
+    learner_block,
+    learner_block_donated,
+    pipeline_summary,
+    train_pipelined,
+)

@@ -1,0 +1,99 @@
+"""Learner->actor parameter publishing — the in-memory hot-swap chain.
+
+The serving side already solved this problem once: the
+:class:`~rcmarl_tpu.serve.swap.CheckpointWatcher` validates a candidate
+COMPLETELY, then replaces the engine's single block reference wholesale,
+so a consumer can never observe a torn tree and a poisoned candidate is
+rejected with the consumer kept on its last good parameters. The
+pipeline's publisher is that exact discipline with the file system cut
+out: the learner offers its parameter tree at publish boundaries
+(``Config.publish_every``), the actor tier always acts on ONE acting
+reference, and the swap is a single Python rebind — atomic with respect
+to actor dispatches by construction.
+
+Two knobs mirror the two trainer regimes:
+
+- ``copy=True`` (the donated learner loop): the published tree is
+  device-copied at offer time, because the learner's next donated block
+  will consume the source buffers in place — the copies are dispatched
+  asynchronously, so the handoff stays ``block_until_ready``-free.
+- ``validate=True`` (guarded runs): the shared publish-candidate guard
+  (:func:`rcmarl_tpu.faults.params_finite`) runs in front of the swap —
+  a NaN-poisoned learner can degrade its own metrics, but it can never
+  poison the acting tier; rejects are counted, the actor keeps the last
+  good parameters. Validation host-syncs, which guarded runs already do
+  per block; unguarded runs skip it to keep the pipeline free-running.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class PolicyPublisher:
+    """Single-reference acting-parameter publisher with staleness
+    bookkeeping.
+
+    ``acting`` is the tree the actor tier dispatches against;
+    ``published_block`` the learner block count it corresponds to —
+    ``dispatch_block - published_block`` is the pipeline's measured
+    staleness, counted by the trainer at every actor dispatch.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        publish_every: int = 1,
+        *,
+        copy: bool = False,
+        validate: bool = False,
+        learner_block: int = 0,
+    ) -> None:
+        if publish_every < 1:
+            raise ValueError(
+                f"publish_every={publish_every} must be >= 1"
+            )
+        self.publish_every = publish_every
+        self.copy = copy
+        self.validate = validate
+        self.acting = self._prepare(params)
+        self.published_block = learner_block
+        self.counters = {"publishes": 0, "rejects": 0}
+
+    def _prepare(self, params: Any) -> Any:
+        if not self.copy:
+            return params
+        import jax
+        import jax.numpy as jnp
+
+        # async device copies: dispatched BEFORE the learner's next
+        # donated block can consume the source buffers, completed by
+        # XLA's ordinary dependency ordering — never a host sync
+        return jax.tree.map(jnp.copy, params)
+
+    def offer(self, params: Any, learner_block: int) -> bool:
+        """Offer the learner's parameters after ``learner_block``
+        completed blocks; publish iff this is a publish boundary and
+        (under ``validate``) the candidate is fully finite.
+
+        Returns True iff the acting reference was swapped. A rejected
+        candidate leaves the actor tier on the last good parameters
+        with ``rejects`` incremented — the watcher's degradation
+        contract, one level down the stack.
+        """
+        if learner_block % self.publish_every != 0:
+            return False
+        if self.validate:
+            from rcmarl_tpu.faults import params_finite
+
+            if not params_finite(params):
+                self.counters["rejects"] += 1
+                return False
+        # validate fully, then swap the single reference wholesale: an
+        # actor dispatched before this line acts on the old tree, one
+        # dispatched after acts on the new tree, and no dispatch can
+        # ever see a mix (the CheckpointWatcher atomicity contract)
+        self.acting = self._prepare(params)
+        self.published_block = learner_block
+        self.counters["publishes"] += 1
+        return True

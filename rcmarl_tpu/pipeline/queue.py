@@ -1,0 +1,61 @@
+"""Bounded on-device block queue — the actor->learner handoff.
+
+The TorchBeast/Sebulba shape (PAPERS.md 1910.03552, 2104.06272): actors
+feed the learner through a bounded queue so the two tiers can run out of
+phase. Here both tiers live in one host process over one JAX device
+stream, so the queue holds *dispatched-but-possibly-unfinished* device
+values (jax arrays are futures): ``put``/``get`` move references only —
+no ``block_until_ready``, no host fetch — and XLA's own data
+dependencies order the actual execution. The bound IS the pipeline
+depth: a full queue means the actor tier is the configured number of
+blocks ahead, and the host simply stops dispatching more rollout until
+the learner drains one.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Tuple
+
+
+class BlockQueue:
+    """FIFO of at most ``depth`` in-flight rollout blocks.
+
+    Overflow and underflow raise: the pipeline trainer's dispatch
+    schedule is deterministic, so either is a driver bug, not a
+    backpressure condition to paper over.
+    """
+
+    def __init__(self, depth: int) -> None:
+        if depth < 1:
+            raise ValueError(f"BlockQueue depth={depth} must be >= 1")
+        self.depth = depth
+        self._q: deque = deque()
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    @property
+    def full(self) -> bool:
+        return len(self._q) >= self.depth
+
+    def put(self, item: Tuple[int, Any, Any]) -> None:
+        """Enqueue one ``(block_index, fresh, metrics)`` payload —
+        reference handoff only, never a device sync."""
+        if self.full:
+            raise RuntimeError(
+                f"BlockQueue overflow: {len(self._q)} in-flight blocks "
+                f"at depth {self.depth} — the actor tier dispatched "
+                "ahead of schedule"
+            )
+        self._q.append(item)
+
+    def get(self) -> Tuple[int, Any, Any]:
+        """Dequeue the oldest payload (the learner consumes strictly in
+        block order)."""
+        if not self._q:
+            raise RuntimeError(
+                "BlockQueue underflow: the learner asked for a block "
+                "the actor tier never dispatched"
+            )
+        return self._q.popleft()

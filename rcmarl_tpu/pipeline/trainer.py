@@ -1,0 +1,383 @@
+"""The pipelined trainer: rollout in the epoch's shadow.
+
+The synchronous trainer (:func:`rcmarl_tpu.training.trainer.train`)
+fuses rollout + update into one launch per block, so the ~ms rollout
+serializes with the ~s epoch and the compile-once acting program sits
+idle while the learner runs. This module runs the two tiers out of
+phase (the Podracer/Sebulba split, PAPERS.md 2104.06272; TorchBeast's
+queue decoupling, 1910.03552):
+
+- **actor tier** — :func:`rcmarl_tpu.serve.engine.actor_block`, the
+  serving engine's compile-once rollout program, dispatched up to
+  ``Config.pipeline_depth`` blocks ahead of the learner against the
+  parameters the learner last PUBLISHED
+  (:class:`~rcmarl_tpu.pipeline.publish.PolicyPublisher`, the in-memory
+  twin of the serving checkpoint hot-swap chain).
+- **learner tier** — :data:`learner_block` /
+  :data:`learner_block_donated`: the existing block-stepping epoch
+  (``update_batch`` -> ``update_block`` -> ``buffer_push_block``) minus
+  the rollout, with the same state-donation policy as the synchronous
+  loop.
+- **handoff** — a bounded
+  :class:`~rcmarl_tpu.pipeline.queue.BlockQueue` of in-flight device
+  values; no stage ever calls ``block_until_ready``, so XLA's data
+  dependencies are the only ordering and rollout executes in the shadow
+  of the epoch wherever the hardware has the parallelism to pay for it.
+
+**RNG discipline.** The per-block key chain is EXACTLY the synchronous
+trainer's (``key, k_roll, k_upd = split(key, 3)`` per block), walked
+host-side ahead of the dispatch schedule — a pipelined run differs from
+its synchronous twin ONLY through which parameters act, never through
+different random draws.
+
+**Staleness is counted, not accidental.** At every actor dispatch the
+trainer records ``block - published_block``: steady state is
+``depth - 1`` extra epochs of off-policy lag (plus up to
+``publish_every - 1`` of publish lag), ramping 0,1,... over the first
+``depth`` blocks. Counters land in ``df.attrs['pipeline']`` and the
+train summary line; the FaultPlan ``stale_p`` machinery
+(:mod:`rcmarl_tpu.faults`) models the same replay semantics per link —
+this module makes it a whole-policy, schedule-level knob, and the
+staleness quality cell (QUALITY.md) measures what it costs in return.
+
+**depth=0 is the reference arm.** Synchronous handoff DELEGATES to
+:func:`~rcmarl_tpu.training.trainer.train` itself and attaches the
+degenerate pipeline counters — bitwise the synchronous trainer by
+construction (and still pinned leaf-for-leaf in tests/test_pipeline.py
+and ci_tier1.sh as the regression net), so the synchronous trainer
+remains the trusted baseline every pipelined arm is judged against.
+
+**Guard semantics at depth > 0.** The per-block guard is LEARNER-side:
+a non-finite learner output rolls back and retries with a perturbed
+update key (the rollout batch already exists and is not re-drawn), then
+skips; the publisher additionally validates every publish candidate,
+and a skipped block publishes NOTHING (the rolled-back tree is what the
+actor already acts on), so a poisoned learner can never reach the
+acting tier and skips lengthen the measured staleness instead of
+silently resetting it. After a skip the in-flight dispatch chain stays
+unperturbed (later rollouts are already queued on it) while the STORED
+key folds exactly like the synchronous skip, so a checkpoint taken at a
+skipped block never replays the failing draws on resume — the depth-0
+arm keeps the synchronous skip semantics exactly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from rcmarl_tpu.config import Config
+from rcmarl_tpu.pipeline.publish import PolicyPublisher
+from rcmarl_tpu.pipeline.queue import BlockQueue
+from rcmarl_tpu.training.buffer import buffer_push_block, update_batch
+from rcmarl_tpu.training.trainer import (
+    TrainState,
+    _block_healthy,
+    init_train_state,
+    metrics_to_dataframe,
+    train,
+)
+from rcmarl_tpu.training.update import update_block
+
+
+def _learner_block(
+    cfg: Config, state: TrainState, fresh, k_upd, new_key,
+    with_diag: bool = False,
+):
+    """One LEARNER block: consume a rollout window the actor tier
+    produced — replay-window assembly, the ``n_epochs`` critic/TR
+    consensus epochs + actor phase, buffer push — and advance the
+    block counter. The synchronous ``_train_block`` minus the rollout:
+    ``new_key`` is the next chain key the host pre-derived, stored so
+    checkpoints stay resume-compatible with the synchronous format."""
+    batch = update_batch(state.buffer, fresh)
+    if with_diag:
+        params, diag = update_block(
+            cfg, state.params, batch, fresh, k_upd, with_diag=True
+        )
+    else:
+        params = update_block(cfg, state.params, batch, fresh, k_upd)
+    buffer = buffer_push_block(state.buffer, fresh)
+    out = TrainState(
+        params, buffer, state.desired, state.initial, new_key,
+        state.block + 1,
+    )
+    if with_diag:
+        return out, diag
+    return out
+
+
+#: The standard jitted learner block (inputs stay alive — the guarded
+#: retry path re-runs from the same pre-block state).
+learner_block = partial(
+    jax.jit, static_argnums=0, static_argnames=("with_diag",)
+)(_learner_block)
+
+#: Same program with ``state`` DONATED — the steady-state pipelined
+#: loop's allocation saver, exactly the synchronous trainer's
+#: ``train_block_donated`` policy (the publisher holds COPIES of
+#: published params, so donation can never invalidate the acting tier's
+#: buffers). The passed ``state`` is consumed.
+learner_block_donated = jax.jit(
+    _learner_block,
+    static_argnums=0,
+    static_argnames=("with_diag",),
+    donate_argnums=(1,),
+)
+
+
+def pipeline_fingerprint(cfg: Config) -> str:
+    """The ``cost_fingerprint`` of a pipelined measurement: one hash
+    over BOTH tier programs (the actor-tier rollout block and the
+    donated learner block — the steady-state pair a clean pipelined run
+    dispatches), abstract lowering only (no allocation, no compile) —
+    the ledger convention of
+    :func:`rcmarl_tpu.utils.profiling.train_block_fingerprint`, for the
+    two-program arm."""
+    from rcmarl_tpu.serve.engine import actor_block
+    from rcmarl_tpu.training.rollout import rollout_block
+    from rcmarl_tpu.training.trainer import make_env
+    from rcmarl_tpu.utils.profiling import program_fingerprint
+
+    key = jax.random.PRNGKey(0)
+    state = jax.eval_shape(lambda k: init_train_state(cfg, k), key)
+    fresh, _ = jax.eval_shape(
+        lambda p, d, k, i: rollout_block(cfg, make_env(cfg), p, d, k, i),
+        state.params, state.desired, key, state.initial,
+    )
+    actor = actor_block.lower(
+        cfg, state.params, state.desired, key, state.initial
+    )
+    learner = learner_block_donated.lower(cfg, state, fresh, key, key)
+    return program_fingerprint(actor.as_text() + learner.as_text())
+
+
+def pipeline_summary(attrs: dict) -> str:
+    """The one-line pipeline summary (cmd_train prints it; the CI
+    smoke cell greps the staleness counters off it)."""
+    return (
+        f"pipeline: depth {attrs['depth']}, publish_every "
+        f"{attrs['publish_every']} — staleness mean "
+        f"{attrs['staleness_mean']:.2f} / max {attrs['staleness_max']} "
+        f"over {attrs['blocks']} blocks, {attrs['publishes']} publishes, "
+        f"{attrs['rejects']} rejects"
+    )
+
+
+def train_pipelined(
+    cfg: Config,
+    n_episodes: Optional[int] = None,
+    state: Optional[TrainState] = None,
+    verbose: bool = False,
+    block_callback=None,
+    guard: Optional[bool] = None,
+    max_retries: int = 1,
+):
+    """Host-looped pipelined training run (see module docstring).
+
+    The :func:`~rcmarl_tpu.training.trainer.train` signature and return
+    contract, plus ``df.attrs['pipeline']``: ``depth``/
+    ``publish_every``/``blocks``, the per-block ``staleness`` list with
+    its ``staleness_mean``/``staleness_max``, and the publisher's
+    ``publishes``/``rejects`` counters. ``cfg.pipeline_depth == 0`` is
+    the synchronous-handoff reference arm, bitwise the synchronous
+    trainer; ``verbose`` adds host fetches per block (quiet runs keep
+    the pipeline free-running).
+    """
+    n_eps = cfg.n_episodes if n_episodes is None else n_episodes
+    if n_eps % cfg.n_ep_fixed != 0:
+        raise ValueError(
+            f"n_episodes={n_eps} must be a multiple of "
+            f"n_ep_fixed={cfg.n_ep_fixed}"
+        )
+    if max_retries < 0:
+        raise ValueError(f"max_retries={max_retries} must be >= 0")
+    n_blocks = n_eps // cfg.n_ep_fixed
+    depth = cfg.pipeline_depth
+    if guard is None:
+        guard = cfg.fault_plan is not None
+    with_diag = cfg.fault_plan is not None and cfg.fault_plan.active
+
+    if depth == 0:
+        # ---- synchronous handoff IS the synchronous trainer: delegate,
+        # so the depth-0 reference arm is bitwise by CONSTRUCTION, not
+        # by a hand-maintained twin loop (publish accounting is
+        # degenerate: every block's parameters act immediately)
+        state, df = train(
+            cfg,
+            n_episodes=n_eps,
+            state=state,
+            verbose=verbose,
+            block_callback=block_callback,
+            guard=guard,
+            max_retries=max_retries,
+        )
+        df.attrs["pipeline"] = {
+            "depth": 0,
+            "publish_every": cfg.publish_every,
+            "blocks": n_blocks,
+            "staleness": [0] * n_blocks,
+            "staleness_mean": 0.0,
+            "staleness_max": 0,
+            "publishes": n_blocks,
+            "rejects": 0,
+        }
+        return state, df
+
+    if state is None:
+        state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
+    elif not guard:
+        # the donated entries below CONSUME their input state; work on a
+        # one-time copy so the caller's resume state stays alive (the
+        # synchronous trainer's exact policy)
+        state = jax.tree.map(jnp.copy, state)
+    stats = {"retries": 0, "skipped": 0, "nonfinite": 0, "deficit": 0}
+    all_metrics = []
+    staleness = []
+
+    # ---- the decoupled pipeline
+    donate = not guard
+    # no validate= here: only ACCEPTED (health-checked) blocks ever
+    # reach offer() below, so a trainer-side publish validation
+    # would re-reduce a tree the guard just proved finite and pay a
+    # host sync for a check that cannot fail; PolicyPublisher's
+    # validate arm stays for standalone publisher users
+    publisher = PolicyPublisher(
+        state.params, cfg.publish_every, copy=donate
+    )
+    # actor-tier stable buffers: desired/initial never change, but
+    # the donated learner aliases the state's copies every block —
+    # the actor dispatches against its own never-donated pair
+    desired0 = jnp.copy(state.desired)
+    initial0 = jnp.copy(state.initial)
+    # the synchronous per-block key chain, walked ahead of the
+    # dispatch schedule: chain[b] is block b's state.key, keys[b]
+    # its (k_roll, k_upd) — identical draws to the sync trainer
+    from rcmarl_tpu.serve.engine import actor_block
+
+    chain = [state.key]
+    keys = []
+
+    def block_keys(j: int):
+        while len(keys) <= j:
+            nk, kr, ku = jax.random.split(chain[-1], 3)
+            chain.append(nk)
+            keys.append((kr, ku))
+        return keys[j]
+
+    queue = BlockQueue(depth)
+
+    def dispatch_actor(j: int) -> None:
+        k_roll, _ = block_keys(j)
+        fresh, m = actor_block(
+            cfg, publisher.acting, desired0, k_roll, initial0
+        )
+        staleness.append(j - publisher.published_block)
+        queue.put((j, fresh, m))
+
+    for j in range(min(depth, n_blocks)):
+        dispatch_actor(j)
+
+    learner = learner_block if guard else learner_block_donated
+    for b in range(n_blocks):
+        j, fresh, m = queue.get()
+        assert j == b, f"pipeline order broke: got block {j} at {b}"
+        _, k_upd = block_keys(b)
+        new_key = chain[b + 1]
+        attempt = 0
+        accepted = True
+        while True:
+            if attempt:
+                # the synchronous retry discipline applied to the
+                # learner side: deterministic in (key, block,
+                # attempt), rollout batch kept as produced
+                k_upd = jax.random.fold_in(chain[b], attempt)
+            diag = None
+            if with_diag:
+                new_state, diag = learner(
+                    cfg, state, fresh, k_upd, new_key, with_diag=True
+                )
+            else:
+                new_state = learner(cfg, state, fresh, k_upd, new_key)
+            if not guard or _block_healthy(new_state, m):
+                state = new_state
+                break
+            if attempt < max_retries:
+                attempt += 1
+                stats["retries"] += 1
+                if verbose:
+                    print(
+                        f"| Block {b + 1} | non-finite learner "
+                        f"output — rolling back (retry "
+                        f"{attempt}/{max_retries})"
+                    )
+                continue
+            stats["skipped"] += 1
+            if verbose:
+                print(
+                    f"| Block {b + 1} | still non-finite after "
+                    f"{max_retries} retries — skipping (params "
+                    "rolled back)"
+                )
+            # The in-flight dispatch chain stays unperturbed (later
+            # rollouts are already queued on it), but the STORED key
+            # folds exactly like the synchronous skip — a checkpoint
+            # taken at this state must not make a resumed run replay
+            # the failing block's draws forever.
+            state = state._replace(
+                key=jax.random.fold_in(state.key, 0x5C1B + b),
+                block=state.block + 1,
+            )
+            accepted = False
+            break
+        if diag is not None:
+            stats["nonfinite"] += int(diag.nonfinite)
+            stats["deficit"] += int(diag.deficit)
+        all_metrics.append(m)
+        if accepted:
+            # a skipped block publishes NOTHING: the rolled-back
+            # tree is what the actor already acts on, and counting
+            # it as a fresh publish would silently understate the
+            # measured staleness of every later dispatch
+            publisher.offer(state.params, b + 1)
+        if b + depth < n_blocks:
+            dispatch_actor(b + depth)
+        if verbose:
+            _print_block(cfg, state, m, b)
+        if block_callback is not None:
+            block_callback(state, b)
+    publishes = publisher.counters["publishes"]
+    rejects = publisher.counters["rejects"]
+
+    metrics = jax.tree.map(lambda *xs: jnp.concatenate(xs), *all_metrics)
+    df = metrics_to_dataframe(metrics)
+    df.attrs["pipeline"] = {
+        "depth": depth,
+        "publish_every": cfg.publish_every,
+        "blocks": n_blocks,
+        "staleness": staleness,
+        "staleness_mean": (
+            sum(staleness) / len(staleness) if staleness else 0.0
+        ),
+        "staleness_max": max(staleness, default=0),
+        "publishes": publishes,
+        "rejects": rejects,
+    }
+    if guard or with_diag:
+        df.attrs["guard"] = stats
+    return state, df
+
+
+def _print_block(cfg: Config, state: TrainState, m, b: int) -> None:
+    """The synchronous trainer's per-block verbose line (host-syncing —
+    verbose runs trade the free-running pipeline for live output)."""
+    tt = float(jnp.mean(m.true_team_returns))
+    et = float(jnp.mean(m.est_team_returns))
+    print(
+        f"| Block {int(state.block)} | episodes "
+        f"{(b + 1) * cfg.n_ep_fixed} | team return {tt:.3f} | "
+        f"est return {et:.3f}"
+    )

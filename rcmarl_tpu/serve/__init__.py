@@ -5,6 +5,7 @@ half of the north star, distinct from the training benchmark axis)."""
 from rcmarl_tpu.serve.engine import (  # noqa: F401
     SERVE_MODES,
     ServeEngine,
+    actor_block,
     eval_block,
     serve_block,
     serve_keys,

@@ -183,6 +183,33 @@ def _eval_block(cfg: Config, params, desired, key, initial):
 eval_block = partial(jax.jit, static_argnums=0)(_eval_block)
 
 
+def _actor_block(cfg: Config, params, desired, key, initial):
+    """The ACTOR-TIER rollout program of the async pipeline
+    (:mod:`rcmarl_tpu.pipeline`): one full rollout block —
+    ``n_ep_fixed`` episodes — acted under the parameters the learner
+    last PUBLISHED, returning the fresh on-policy window plus the
+    block's episode metrics. The acting/serving twin of
+    :func:`eval_block`: same frozen-params rollout program, but it
+    keeps the ``(block_steps, N, ...)`` batch the learner tier
+    consumes instead of reducing to returns. Like :func:`serve_block`,
+    the parameters are DATA (one compile; every publish/hot-swap
+    re-dispatches the same executable — the retrace-audited contract),
+    and the sampling path is the exact training rollout
+    (:func:`rcmarl_tpu.training.rollout.rollout_block`, ε-mix
+    included), so a pipelined run differs from the synchronous trainer
+    ONLY through parameter staleness, never through a different acting
+    program."""
+    from rcmarl_tpu.training.rollout import rollout_block
+    from rcmarl_tpu.training.trainer import make_env
+
+    return rollout_block(cfg, make_env(cfg), params, desired, key, initial)
+
+
+#: The jitted actor-tier entry point (registered next to eval_block;
+#: the pipeline trainer dispatches it ahead of the learner).
+actor_block = partial(jax.jit, static_argnums=0)(_actor_block)
+
+
 class ServeEngine:
     """Host shell around :func:`serve_block`: load once, serve forever.
 
@@ -215,7 +242,7 @@ class ServeEngine:
         mode: str = "sample",
         eval_seed: int = 0,
     ) -> None:
-        from rcmarl_tpu.faults import tree_all_finite
+        from rcmarl_tpu.faults import params_finite
         from rcmarl_tpu.utils.checkpoint import load_checkpoint_with_meta
 
         if mode not in SERVE_MODES:
@@ -232,7 +259,7 @@ class ServeEngine:
                 "checkpoint (replica worlds must be exported/collapsed "
                 "explicitly, never served implicitly)"
             )
-        if not bool(tree_all_finite(state.params)):
+        if not params_finite(state.params):
             raise ValueError(
                 f"checkpoint {loaded} holds non-finite parameters; "
                 "refusing to serve a poisoned policy"

@@ -69,7 +69,7 @@ class CheckpointWatcher:
         return self._try_swap()
 
     def _try_swap(self) -> bool:
-        from rcmarl_tpu.faults import tree_all_finite
+        from rcmarl_tpu.faults import params_finite
         from rcmarl_tpu.utils.checkpoint import load_checkpoint_with_meta
 
         eng = self.engine
@@ -97,7 +97,8 @@ class CheckpointWatcher:
             )
         # fault guard in front of the swap: a checksum-valid file can
         # still carry poisoned (non-finite) params — never serve them
-        if not bool(tree_all_finite(state.params)):
+        # (the shared publish-candidate guard, rcmarl_tpu.faults)
+        if not params_finite(state.params):
             eng.counters["rejects"] += 1
             eng.degraded = True
             return False

@@ -47,7 +47,11 @@ def jit_entry_points() -> Dict[str, object]:
     ``utils`` stays cheap to import.
     """
     from rcmarl_tpu.parallel.gossip import gossip_mix_block
-    from rcmarl_tpu.serve.engine import eval_block, serve_block
+    from rcmarl_tpu.pipeline.trainer import (
+        learner_block,
+        learner_block_donated,
+    )
+    from rcmarl_tpu.serve.engine import actor_block, eval_block, serve_block
     from rcmarl_tpu.training.trainer import train_block, train_block_donated
     from rcmarl_tpu.training.update import (
         fit_block,
@@ -64,6 +68,9 @@ def jit_entry_points() -> Dict[str, object]:
         "fit_block": fit_block,
         "serve_block": serve_block,
         "eval_block": eval_block,
+        "actor_block": actor_block,
+        "learner_block": learner_block,
+        "learner_block_donated": learner_block_donated,
     }
 
 
@@ -264,9 +271,18 @@ def lowered_entry_points(
                 elif name == "serve_block":
                     block, obs, skey = serve_entry_inputs(cfg)
                     lowered = fn.lower(cfg, block, obs, skey)
-                elif name == "eval_block":
+                elif name in ("eval_block", "actor_block"):
                     lowered = fn.lower(
                         cfg, state.params, state.desired, key, state.initial
+                    )
+                elif name.startswith("learner_block"):
+                    lowered = fn.lower(
+                        cfg,
+                        state,
+                        fresh,
+                        key,
+                        jax.random.fold_in(key, 1),
+                        with_diag=with_diag,
                     )
                 elif name == "fit_block":
                     p = state.params
@@ -351,10 +367,15 @@ def _traced_entry(cfg, with_diag: bool, name: str):
             closed, out_shape = jax.make_jaxpr(
                 lambda bl, o, k: fn(cfg, bl, o, k), return_shape=True
             )(block, obs, skey)
-        elif name == "eval_block":
+        elif name in ("eval_block", "actor_block"):
             closed, out_shape = jax.make_jaxpr(
                 lambda p, d, k, i: fn(cfg, p, d, k, i), return_shape=True
             )(state.params, state.desired, key, state.initial)
+        elif name.startswith("learner_block"):
+            closed, out_shape = jax.make_jaxpr(
+                lambda s, f, k, nk: fn(cfg, s, f, k, nk, with_diag=with_diag),
+                return_shape=True,
+            )(state, fresh, key, jax.random.fold_in(key, 1))
         elif name == "fit_block":
             p = state.params
             closed, out_shape = jax.make_jaxpr(

@@ -229,6 +229,51 @@ grep -q "hot-swap atomic OK" "$serve_log"
 grep -q "served: last-good" "$serve_log"
 echo "serve smoke cell OK"
 
+# Pipeline smoke cell: the async actor-learner pipeline end to end
+# through the real CLI — a depth-2 pipelined run with a sparse publish
+# cadence must exit rc=0 with the staleness counters on the summary
+# line (CLI flags -> Config -> train_pipelined -> actor_block/
+# learner_block_donated -> publisher), and the depth-0 synchronous-
+# handoff arm must stay leaf-for-leaf BITWISE the historical trainer
+# on a mixed ragged+faulted+sanitize cell through the real trainer
+# (the acceptance pin; the wider equivalence matrix rides the slow
+# marker in tests/test_pipeline.py per the tier-1 budget pattern).
+pipe_log="$smoke_dir/pipeline.log"
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
+    --n_agents 3 --in_degree 3 --nrow 3 --ncol 3 \
+    --n_episodes 8 --n_ep_fixed 2 --max_ep_len 4 --n_epochs 2 --H 1 \
+    --pipeline_depth 2 --publish_every 2 \
+    --summary_dir "$smoke_dir" --quiet | tee "$pipe_log"
+grep -q "pipeline: depth 2, publish_every 2" "$pipe_log"
+grep -q "staleness mean" "$pipe_log"
+timeout -k 10 420 env JAX_PLATFORMS=cpu python - <<'PY'
+import numpy as np, jax
+from rcmarl_tpu.config import Config, Roles
+from rcmarl_tpu.faults import FaultPlan
+from rcmarl_tpu.pipeline.trainer import train_pipelined
+from rcmarl_tpu.training.trainer import train
+
+cfg = Config(
+    n_agents=4,
+    agent_roles=(Roles.COOPERATIVE,) * 2 + (Roles.GREEDY, Roles.MALICIOUS),
+    in_nodes=((0, 1, 2, 3), (1, 2, 3, 0), (2, 3, 0), (3, 0, 1)),
+    nrow=3, ncol=3,
+    n_episodes=4, n_ep_fixed=2, max_ep_len=4, n_epochs=2, H=1,
+    consensus_sanitize=True,
+    fault_plan=FaultPlan(drop_p=0.2, nan_p=0.2, stale_p=0.1),
+)
+s_ref, df_ref = train(cfg)
+s_pipe, df_pipe = train_pipelined(cfg)
+for a, b in zip(jax.tree.leaves(s_ref), jax.tree.leaves(s_pipe)):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+for col in df_ref.columns:
+    np.testing.assert_array_equal(df_ref[col].values, df_pipe[col].values)
+assert df_ref.attrs["guard"] == df_pipe.attrs["guard"]
+assert df_pipe.attrs["pipeline"]["staleness"] == [0, 0]
+print("pipeline depth-0 bitwise pin OK (ragged+faulted+guarded)")
+PY
+echo "pipeline smoke cell OK"
+
 # graftlint cell: the AST passes over the installed package (zero
 # findings is the contract — rcmarl_tpu.lint) plus the retrace audit
 # (tiny guarded+faulted 2-block trains on both netstack arms + a clean

@@ -102,6 +102,19 @@ run_step "5. headline" \
 run_step "6. serve actions/sec refit (batched policy serving headline)" \
     bash -c 'set -o pipefail; timeout 1800 python bench.py --serve | tee -a BENCH_SERVE.jsonl'
 
+# The async pipeline (PR 11): the committed sync-vs-pipelined rows are
+# CPU fallbacks (headline:false — a serial core executes the two tiers
+# back to back, so they measure host-loop overhead, not overlap). This
+# is the on-chip refit where the shadow claim is actually decidable:
+# rollout cost must disappear into the epoch shadow at depth >= 2.
+run_step "7. pipeline shadow refit (sync vs pipelined, on-chip)" \
+    timeout 3600 python -m rcmarl_tpu bench \
+    --configs n16_full n64_full --pipeline_depth 0 2 4 \
+    --n_ep_fixed 10 --blocks 5 --reps 3 --out PERF.jsonl
+
+run_step "7b. pipeline headline pair (bench.py orchestration)" \
+    bash -c 'set -o pipefail; timeout 1800 python bench.py --pipeline | tee -a PERF.jsonl'
+
 echo "== session summary =="
 rc=0
 for name in "${step_order[@]}"; do

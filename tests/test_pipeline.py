@@ -1,0 +1,249 @@
+"""Async actor-learner pipeline (rcmarl_tpu.pipeline).
+
+Tier-1 pins the cheap contracts: the queue/publisher units, the Config
+validation, staleness accounting (exact per-block counts at several
+depth x publish_every cells), the depth-0 synchronous-handoff arm
+BITWISE against the reference trainer on one tiny cell, and a depth-2
+finite end-to-end run. The heavier depth-0 equivalence matrix
+(mixed / faulted+guarded / netstack cells) rides the slow marker per
+the tier-1 budget discipline; ci_tier1.sh re-proves the depth-0 pin
+through the real CLI every run.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from rcmarl_tpu.config import Config
+from rcmarl_tpu.lint.configs import tiny_cfg, tiny_faulted_cfg, tiny_mixed_cfg
+from rcmarl_tpu.pipeline.publish import PolicyPublisher
+from rcmarl_tpu.pipeline.queue import BlockQueue
+from rcmarl_tpu.pipeline.trainer import pipeline_summary, train_pipelined
+from rcmarl_tpu.training.trainer import train
+
+
+def _assert_trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# --------------------------------------------------------------------------
+# units: queue / publisher / config validation
+# --------------------------------------------------------------------------
+
+
+class TestBlockQueue:
+    def test_fifo_and_bounds(self):
+        q = BlockQueue(2)
+        q.put((0, "f0", "m0"))
+        q.put((1, "f1", "m1"))
+        assert q.full and len(q) == 2
+        with pytest.raises(RuntimeError, match="overflow"):
+            q.put((2, "f2", "m2"))
+        assert q.get() == (0, "f0", "m0")
+        q.put((2, "f2", "m2"))
+        assert [q.get()[0] for _ in range(2)] == [1, 2]
+        with pytest.raises(RuntimeError, match="underflow"):
+            q.get()
+
+    def test_depth_validation(self):
+        with pytest.raises(ValueError):
+            BlockQueue(0)
+
+
+class TestPolicyPublisher:
+    def test_publish_boundary_and_staleness_bookkeeping(self):
+        params = {"w": np.ones(3)}
+        pub = PolicyPublisher(params, publish_every=2)
+        assert pub.offer({"w": np.full(3, 2.0)}, 1) is False  # not a boundary
+        assert pub.published_block == 0
+        assert pub.offer({"w": np.full(3, 2.0)}, 2) is True
+        assert pub.published_block == 2
+        assert pub.counters == {"publishes": 1, "rejects": 0}
+
+    def test_validate_rejects_nonfinite_keeps_last_good(self):
+        good = {"w": np.ones(3, np.float32)}
+        pub = PolicyPublisher(good, validate=True)
+        bad = {"w": np.array([1.0, np.nan, 1.0], np.float32)}
+        assert pub.offer(bad, 1) is False
+        assert pub.acting is good  # last good kept, wholesale
+        assert pub.counters == {"publishes": 0, "rejects": 1}
+        fresh = {"w": np.full(3, 2.0, np.float32)}
+        assert pub.offer(fresh, 2) is True
+        assert pub.acting is fresh and pub.published_block == 2
+
+    def test_copy_mode_snapshots_the_tree(self):
+        src = {"w": np.ones(3, np.float32)}
+        pub = PolicyPublisher(src, copy=True)
+        assert pub.acting is not src
+        np.testing.assert_array_equal(np.asarray(pub.acting["w"]), src["w"])
+
+
+class TestConfigValidation:
+    def test_negative_depth_rejected(self):
+        with pytest.raises(ValueError, match="pipeline_depth"):
+            Config(pipeline_depth=-1)
+
+    def test_publish_every_zero_rejected(self):
+        with pytest.raises(ValueError, match="publish_every"):
+            Config(publish_every=0)
+
+    def test_replica_pipeline_combination_rejected(self):
+        with pytest.raises(ValueError, match="gossip-replica"):
+            Config(replicas=2, pipeline_depth=2)
+
+
+# --------------------------------------------------------------------------
+# staleness accounting (exact, per block)
+# --------------------------------------------------------------------------
+
+
+class TestStalenessAccounting:
+    def test_depth2_ramp_then_steady(self):
+        cfg = tiny_cfg(pipeline_depth=2, n_episodes=12)
+        _, df = train_pipelined(cfg)
+        p = df.attrs["pipeline"]
+        assert p["staleness"] == [0, 1, 1, 1, 1, 1]
+        assert p["staleness_max"] == 1 and p["publishes"] == 6
+        assert np.isfinite(df["True_team_returns"].values).all()
+        assert "staleness mean" in pipeline_summary(p)
+
+    def test_publish_every_adds_publish_lag(self):
+        cfg = tiny_cfg(pipeline_depth=1, publish_every=2, n_episodes=12)
+        _, df = train_pipelined(cfg)
+        p = df.attrs["pipeline"]
+        # depth 1 dispatches block j right after learner block j, but
+        # the publisher only swaps at even blocks: odd-block rollouts
+        # act one block stale
+        assert p["staleness"] == [0, 1, 0, 1, 0, 1]
+        assert p["publishes"] == 3
+
+    def test_depth0_counts_zero_staleness(self):
+        cfg = tiny_cfg(pipeline_depth=0)
+        _, df = train_pipelined(cfg)
+        p = df.attrs["pipeline"]
+        assert p["staleness"] == [0] * p["blocks"]
+        assert p["depth"] == 0
+
+
+# --------------------------------------------------------------------------
+# the depth-0 synchronous-handoff pin (the reference arm)
+# --------------------------------------------------------------------------
+
+
+class TestDepth0Bitwise:
+    def test_depth0_bitwise_vs_train_tiny(self):
+        cfg = tiny_cfg()
+        s_ref, df_ref = train(cfg)
+        s_pipe, df_pipe = train_pipelined(cfg)
+        _assert_trees_equal(s_ref, s_pipe)
+        np.testing.assert_array_equal(
+            df_ref["True_team_returns"].values,
+            df_pipe["True_team_returns"].values,
+        )
+        np.testing.assert_array_equal(
+            df_ref["Estimated_team_returns"].values,
+            df_pipe["Estimated_team_returns"].values,
+        )
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize(
+        "label,cfg",
+        [
+            ("mixed", tiny_mixed_cfg()),
+            ("faulted+guarded", tiny_faulted_cfg(False)),
+            ("netstack", tiny_faulted_cfg(True)),
+            ("netstack+fitstack", tiny_mixed_cfg(netstack=True, fitstack=True)),
+        ],
+    )
+    def test_depth0_bitwise_matrix(self, label, cfg):
+        s_ref, df_ref = train(cfg)
+        s_pipe, df_pipe = train_pipelined(cfg)
+        _assert_trees_equal(s_ref, s_pipe)
+        for col in df_ref.columns:
+            np.testing.assert_array_equal(
+                df_ref[col].values, df_pipe[col].values
+            )
+        if "guard" in df_ref.attrs:
+            assert df_ref.attrs["guard"] == df_pipe.attrs["guard"]
+
+
+# --------------------------------------------------------------------------
+# the decoupled pipeline (depth >= 1)
+# --------------------------------------------------------------------------
+
+
+class TestPipelined:
+    def test_depth1_matches_sync_key_chain_rollouts(self):
+        # depth 1, publish_every 1 is the staleness-0 decoupled arm:
+        # every rollout acts on the params the sync trainer would act
+        # on, drawn with the sync key chain — returns match the sync
+        # run EXACTLY only if rollout and update numerics are
+        # unchanged by the program split, which is not guaranteed
+        # across fusion boundaries; what IS contractual is staleness 0
+        # and a healthy finite run.
+        cfg = tiny_cfg(pipeline_depth=1, n_episodes=8)
+        _, df = train_pipelined(cfg)
+        p = df.attrs["pipeline"]
+        assert p["staleness"] == [0, 0, 0, 0]
+        assert np.isfinite(df["True_team_returns"].values).all()
+
+    def test_guarded_faulted_pipeline_counts_and_stays_finite(self):
+        cfg = tiny_faulted_cfg(False, pipeline_depth=2)
+        state, df = train_pipelined(cfg)
+        assert bool(np.all([np.isfinite(np.asarray(l)).all()
+                            for l in jax.tree.leaves(state.params)]))
+        g = df.attrs["guard"]
+        assert g["nonfinite"] > 0  # the plan injected, the diag counted
+        assert df.attrs["pipeline"]["publishes"] >= 1
+
+    def test_skipped_blocks_publish_nothing_and_fold_the_stored_key(self):
+        # an unconditional NaN bomb without sanitize poisons EVERY
+        # learner block: all blocks skip, the publisher must never
+        # advance (staleness keeps growing against the initial params,
+        # publishes stays 0), and the stored key must fold per skip so
+        # a checkpoint-resume cannot replay the failing draws forever
+        from rcmarl_tpu.faults import FaultPlan
+
+        cfg = tiny_cfg(
+            pipeline_depth=2,
+            n_episodes=6,
+            fault_plan=FaultPlan(nan_p=1.0),
+        )
+        state, df = train_pipelined(cfg, max_retries=0)
+        p = df.attrs["pipeline"]
+        assert df.attrs["guard"]["skipped"] == 3
+        assert p["publishes"] == 0 and p["rejects"] == 0
+        assert p["staleness"] == [0, 1, 2]
+        # params rolled back to the (finite) initial tree every block
+        assert all(
+            np.isfinite(np.asarray(l)).all()
+            for l in jax.tree.leaves(state.params)
+        )
+        # the stored key is the per-skip fold of the synchronous
+        # protocol, not the untouched chain key
+        key = jax.random.PRNGKey(cfg.seed)
+        _, _, _, key = jax.random.split(key, 4)  # init_train_state split
+        for b in range(3):
+            key = jax.random.fold_in(key, 0x5C1B + b)
+        np.testing.assert_array_equal(np.asarray(state.key), np.asarray(key))
+
+    def test_resume_continues_block_counter(self):
+        cfg = tiny_cfg(pipeline_depth=2, n_episodes=4)
+        state, _ = train_pipelined(cfg)
+        state2, df2 = train_pipelined(cfg, n_episodes=4, state=state)
+        assert int(np.asarray(state2.block)) == 4
+        assert df2.attrs["pipeline"]["blocks"] == 2
+
+    def test_verbose_and_callback_fire_per_block(self, capsys):
+        seen = []
+        cfg = tiny_cfg(pipeline_depth=2, n_episodes=6)
+        train_pipelined(
+            cfg, verbose=True,
+            block_callback=lambda s, b: seen.append(b),
+        )
+        assert seen == [0, 1, 2]
+        out = capsys.readouterr().out
+        assert out.count("| Block ") == 3
