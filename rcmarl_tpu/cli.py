@@ -30,6 +30,8 @@ import numpy as np
 
 from rcmarl_tpu.config import (
     CONSENSUS_IMPLS,
+    ENV_NAMES,
+    GRAPH_SCHEDULES,
     Config,
     Roles,
     circulant_in_nodes,
@@ -38,12 +40,16 @@ from rcmarl_tpu.config import (
 
 #: The published experiment matrix (reference README "four scenarios" and
 #: raw_data/ layout): the adversary, when present, is node 4 (verified in
-#: raw_data/*/H=1/seed=100/out.txt config dumps).
+#: raw_data/*/H=1/seed=100/out.txt config dumps), plus this framework's
+#: 'adaptive' cast — the colluding omniscient adversary crafting its
+#: payload against the trimmed mean (Roles.ADAPTIVE, QUALITY.md
+#: "Adaptive colluding adversary").
 SCENARIOS = {
     "coop": ["Cooperative"] * 5,
     "greedy": ["Cooperative"] * 4 + ["Greedy"],
     "faulty": ["Cooperative"] * 4 + ["Faulty"],
     "malicious": ["Cooperative"] * 4 + ["Malicious"],
+    "adaptive": ["Cooperative"] * 4 + ["Adaptive"],
 }
 
 
@@ -78,6 +84,17 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         type=str,
         default=None,
         help="explicit topology as JSON, e.g. '[[0,1,2,3],[1,2,3,4],...]'",
+    )
+    p.add_argument(
+        "--env",
+        type=str,
+        default="grid_world",
+        choices=list(ENV_NAMES),
+        help="environment to train in (the env-zoo registry, "
+        "rcmarl_tpu.envs: grid_world = the reference task, pursuit = "
+        "chase a fleeing evader, coverage = spread over a landmark "
+        "layout, congestion = goal routing with literal load costs on "
+        "shared cells)",
     )
     p.add_argument("--n_actions", type=int, default=5)
     p.add_argument("--n_states", type=int, default=2)
@@ -167,6 +184,50 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         choices=["float32", "bfloat16"],
         help="matmul compute precision: float32 = reference-parity, "
         "bfloat16 = MXU-native inputs with f32 accumulation (scale-out)",
+    )
+    g = p.add_argument_group("time-varying communication graphs")
+    g.add_argument(
+        "--graph_schedule",
+        type=str,
+        default="static",
+        choices=list(GRAPH_SCHEDULES),
+        help="communication-graph schedule: static (default) = the "
+        "fixed --in_nodes/--in_degree topology, bit-for-bit the seed "
+        "behavior; random_geometric = resample the in-neighborhoods "
+        "every --graph_every blocks as a deterministic random-"
+        "geometric digraph (gather indices are DATA — zero recompiles, "
+        "lint --retrace case). Solo trainer only.",
+    )
+    g.add_argument(
+        "--graph_every",
+        type=int,
+        default=1,
+        help="resample the time-varying graph every K blocks",
+    )
+    g.add_argument(
+        "--graph_degree",
+        type=int,
+        default=0,
+        help="in-degree (incl. self) of the resampled graph; 0 = reuse "
+        "the static graph's n_in (needs 2H <= degree-1)",
+    )
+    g.add_argument(
+        "--graph_seed",
+        type=int,
+        default=0,
+        help="graph-schedule namespace (independent of the training "
+        "seed; resumed runs replay their exact graph sequence)",
+    )
+    p.add_argument(
+        "--adaptive_scale",
+        type=float,
+        default=10.0,
+        help="payload magnitude of Adaptive colluding adversaries, in "
+        "units of the cooperative messages' per-coordinate spread: "
+        "small = just inside the trim bounds (residual-influence "
+        "stress test for H), large = the unbounded coordinated-mean "
+        "attack H=0 cannot absorb (rcmarl_tpu.faults."
+        "adaptive_payload_tree)",
     )
     _add_pipeline_flags(p)
     _add_fault_flags(p)
@@ -403,6 +464,12 @@ def config_from_args(args) -> Config:
         n_agents=args.n_agents,
         agent_roles=tuple(Roles.BY_NAME[l] for l in labels),
         in_nodes=in_nodes,
+        env=getattr(args, "env", "grid_world"),
+        graph_schedule=getattr(args, "graph_schedule", "static"),
+        graph_every=getattr(args, "graph_every", 1),
+        graph_degree=getattr(args, "graph_degree", 0),
+        graph_seed=getattr(args, "graph_seed", 0),
+        adaptive_scale=getattr(args, "adaptive_scale", 10.0),
         n_actions=args.n_actions,
         n_states=args.n_states,
         n_episodes=args.n_episodes,
@@ -875,6 +942,15 @@ def cmd_sweep(argv) -> int:
         default=["coop", "greedy", "faulty", "malicious"],
         help="scenario names; append '_global' for team-average reward",
     )
+    p.add_argument(
+        "--env",
+        type=str,
+        default="grid_world",
+        choices=list(ENV_NAMES),
+        help="environment every cell trains in (the env-zoo registry); "
+        "artifacts land in the same raw_data layout, so the "
+        "parity/quality pipeline applies per env tree",
+    )
     p.add_argument("--H", nargs="+", type=int, default=[0, 1])
     p.add_argument("--seeds", nargs="+", type=int, default=[100, 200, 300])
     p.add_argument("--n_episodes", type=int, default=4000)
@@ -974,6 +1050,7 @@ def cmd_sweep(argv) -> int:
             labels,
             H=H,
             common_reward=is_global,
+            env=args.env,
             n_episodes=args.n_episodes,
             max_ep_len=args.max_ep_len,
             n_ep_fixed=args.n_ep_fixed,
@@ -1133,6 +1210,7 @@ def _bench_config(
     layout: str = "flat",
     netstack: "bool | str" = "auto",
     fitstack: "bool | str" = "auto",
+    env: str = "grid_world",
 ) -> Config:
     spec = BENCH_CONFIGS[name]
     n = spec["n_agents"]
@@ -1148,6 +1226,7 @@ def _bench_config(
         n_agents=n,
         agent_roles=roles,
         in_nodes=in_nodes,
+        env=env,
         nrow=side,
         ncol=side,
         hidden=spec["hidden"],
@@ -1247,6 +1326,7 @@ def _bench_pipeline_cell(args, name: str, cfg, depth: int) -> int:
         {
             "kind": "pipeline",
             "config": name,
+            "env": pcfg.env,
             "impl": pcfg.consensus_impl,
             "impl_resolved": resolve_impl(
                 pcfg.consensus_impl, pcfg.n_in,
@@ -1301,6 +1381,14 @@ def cmd_bench(argv) -> int:
         default=["xla"],
         choices=list(CONSENSUS_IMPLS),
         help="consensus implementation(s) to compare",
+    )
+    p.add_argument(
+        "--env",
+        nargs="+",
+        default=["grid_world"],
+        choices=list(ENV_NAMES),
+        help="environment arm(s) to measure (the env-zoo registry); "
+        "every row is tagged with the resolved env name",
     )
     p.add_argument("--n_ep_fixed", type=int, default=10)
     p.add_argument("--blocks", type=int, default=3, help="timed blocks per rep")
@@ -1383,14 +1471,15 @@ def cmd_bench(argv) -> int:
     # block through the same harness — the honest sync-vs-pipelined A/B)
     pipeline_mode = any(d > 0 for d in args.pipeline_depth)
     n_failed = 0
-    for name, dtype, impl, layout, ns, fs, shard, depth in itertools.product(
-        args.configs, args.compute_dtype, args.impl, args.layout,
+    for name, env, dtype, impl, layout, ns, fs, shard, depth in itertools.product(
+        args.configs, args.env, args.compute_dtype, args.impl, args.layout,
         args.netstack, args.fitstack, shard_modes, args.pipeline_depth,
     ):
         cfg = _bench_config(
             name, impl, args.n_ep_fixed, dtype, layout,
             netstack=_netstack_value(ns),
             fitstack=_netstack_value(fs),
+            env=env,
         )
         if netstack_enabled(cfg) and layout == "per_leaf":
             print(
@@ -1464,6 +1553,7 @@ def cmd_bench(argv) -> int:
             err = json.dumps(
                 {
                     "config": name,
+                    "env": cfg.env,
                     "impl": impl,
                     "layout": layout,
                     "netstack": netstack_enabled(cfg),
@@ -1480,6 +1570,7 @@ def cmd_bench(argv) -> int:
         row = json.dumps(
             {
                 "config": name,
+                "env": cfg.env,
                 "impl": impl,
                 "impl_resolved": resolve_impl(impl, cfg.n_in, n_agents=cfg.n_agents, H=cfg.H),
                 "layout": cfg.consensus_layout,
@@ -1545,6 +1636,13 @@ def cmd_profile(argv) -> int:
         nargs="+",
         default=["xla"],
         choices=list(CONSENSUS_IMPLS),
+    )
+    p.add_argument(
+        "--env",
+        nargs="+",
+        default=["grid_world"],
+        choices=list(ENV_NAMES),
+        help="environment arm(s) to profile (the env-zoo registry)",
     )
     p.add_argument(
         "--compute_dtype",
@@ -1616,14 +1714,15 @@ def cmd_profile(argv) -> int:
     )
 
     n_failed = 0
-    for name, dtype, impl, layout, ns, fs in itertools.product(
-        args.configs, args.compute_dtype, args.impl, args.layout,
+    for name, env, dtype, impl, layout, ns, fs in itertools.product(
+        args.configs, args.env, args.compute_dtype, args.impl, args.layout,
         args.netstack, args.fitstack,
     ):
         cfg = _bench_config(
             name, impl, args.n_ep_fixed, dtype, layout,
             netstack=_netstack_value(ns),
             fitstack=_netstack_value(fs),
+            env=env,
         ).replace(
             pipeline_depth=args.pipeline_depth,
             publish_every=args.publish_every,
@@ -1670,6 +1769,7 @@ def cmd_profile(argv) -> int:
         row = json.dumps(
             {
                 "config": name,
+                "env": cfg.env,
                 "impl": impl,
                 "impl_resolved": resolve_impl(impl, cfg.n_in, n_agents=cfg.n_agents, H=cfg.H),
                 "layout": cfg.consensus_layout,
@@ -1713,6 +1813,7 @@ def cmd_profile(argv) -> int:
                 {
                     "kind": "consensus_micro",
                     "config": name,
+                    "env": cfg.env,
                     "impl": impl,
                     "impl_resolved": resolve_impl(
                         impl, cfg.n_in, n_agents=cfg.n_agents, H=cfg.H
@@ -1808,7 +1909,7 @@ def cmd_serve(argv) -> int:
     import jax
     import jax.numpy as jnp
 
-    from rcmarl_tpu.envs.grid_world import env_reset, scale_state
+    from rcmarl_tpu.envs.api import env_obs, env_reset
     from rcmarl_tpu.serve.engine import ServeEngine, serve_block, serve_keys
     from rcmarl_tpu.serve.swap import CheckpointWatcher
     from rcmarl_tpu.training.trainer import make_env
@@ -1827,7 +1928,7 @@ def cmd_serve(argv) -> int:
         the (B, N, obs_dim) layout serve_block consumes."""
         ks = jax.random.split(jax.random.PRNGKey(args.eval_seed + i), args.batch)
         pos = jax.vmap(lambda k: env_reset(env, k))(ks)  # (B, N, 2)
-        flat = jax.vmap(lambda q: scale_state(env, q))(pos).reshape(
+        flat = jax.vmap(lambda q: env_obs(env, q))(pos).reshape(
             args.batch, -1
         )  # (B, obs_dim)
         return jnp.broadcast_to(
@@ -1858,6 +1959,7 @@ def cmd_serve(argv) -> int:
         {
             "kind": "serve",
             "checkpoint": str(args.checkpoint),
+            "env": cfg.env,
             "mode": args.mode,
             "n_agents": cfg.n_agents,
             "hidden": list(cfg.hidden),
@@ -1977,6 +2079,7 @@ def cmd_evaluate(argv) -> int:
         {
             "kind": "evaluate",
             "checkpoint": str(args.checkpoint),
+            "env": cfg.env,
             "episodes": int(episodes),
             "eps_explore": args.eps,
             "seed": args.seed,

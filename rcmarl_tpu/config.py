@@ -37,6 +37,23 @@ CONSENSUS_IMPLS = (
 )
 
 
+#: Valid environment names — the keys of the env-zoo registry
+#: (``rcmarl_tpu.envs.api.make_env``). Kept here (jax-free) so Config
+#: validation and the CLI ``--env`` choices never drift from the
+#: registry; tests pin the registry's keys to this tuple.
+ENV_NAMES = ("grid_world", "pursuit", "coverage", "congestion")
+
+#: Valid communication-graph schedules: 'static' = the fixed
+#: ``in_nodes`` topology compiled into the program (the seed behavior,
+#: bit-for-bit), 'random_geometric' = the in-neighborhoods are
+#: REGENERATED every ``graph_every`` blocks as a deterministic
+#: random-geometric digraph (``random_geometric_in_nodes`` — the same
+#: builder the replica gossip layer uses, applied at the agent level)
+#: and passed to the jitted block as DATA (gather indices, not program
+#: structure), so resampling never recompiles.
+GRAPH_SCHEDULES = ("static", "random_geometric")
+
+
 #: Valid replica gossip graphs (parallel/gossip.py:replica_in_nodes):
 #: 'ring' = directed circulant of in-degree ``gossip_degree`` (incl.
 #: self), 'full' = fully connected, 'random_geometric' = deterministic
@@ -52,19 +69,25 @@ GOSSIP_MIXES = ("trimmed", "mean")
 
 
 class Roles:
-    """Integer role codes for the four agent behaviors (reference
-    ``main.py:88-104`` dispatches on the same four labels)."""
+    """Integer role codes for the agent behaviors. The first four are
+    the reference's labels (``main.py:88-104``); ADAPTIVE is this
+    framework's colluding omniscient adversary — it transmits a payload
+    crafted against the trimmed mean from the CURRENT epoch's
+    cooperative messages (``rcmarl_tpu.faults.adaptive_payload_tree``)
+    instead of any fitted net, the natural stress test for ``H``."""
 
     COOPERATIVE = 0
     GREEDY = 1
     FAULTY = 2
     MALICIOUS = 3
+    ADAPTIVE = 4
 
     BY_NAME = {
         "Cooperative": COOPERATIVE,
         "Greedy": GREEDY,
         "Faulty": FAULTY,
         "Malicious": MALICIOUS,
+        "Adaptive": ADAPTIVE,
     }
     NAMES = {v: k for k, v in BY_NAME.items()}
 
@@ -91,6 +114,65 @@ def full_in_nodes(n_agents: int) -> Tuple[Tuple[int, ...], ...]:
     return tuple(
         (i,) + tuple(j for j in range(n_agents) if j != i) for i in range(n_agents)
     )
+
+
+def random_geometric_in_nodes(n: int, degree: int, seed) -> Tuple[Tuple[int, ...], ...]:
+    """Deterministic random-geometric digraph, self first.
+
+    ``n`` nodes get positions ~ U[0,1)^2 from ``default_rng(seed)``;
+    each node is wired to itself plus its ``degree - 1`` nearest others
+    (stable tie-break), so every row has exactly ``degree`` entries —
+    a REGULAR graph, no padding/masking needed. ``seed`` may be an int
+    or a tuple (e.g. ``(graph_seed, round)`` for per-round resampling).
+
+    This is THE random-geometric builder of the framework: the replica
+    gossip layer (:func:`rcmarl_tpu.parallel.gossip.replica_in_nodes`)
+    and the agent-level time-varying communication schedule
+    (:func:`scheduled_in_nodes`) both call it, so the two levels of the
+    stack cannot drift apart.
+    """
+    import numpy as np
+
+    if not 1 <= degree <= n:
+        raise ValueError(
+            f"random_geometric degree must be in [1, {n}], got {degree}"
+        )
+    rng = np.random.default_rng(seed)
+    pos = rng.random((n, 2))
+    out = []
+    for i in range(n):
+        d = np.linalg.norm(pos - pos[i], axis=1)
+        d[i] = -1.0  # self sorts first
+        order = np.argsort(d, kind="stable")
+        out.append(tuple(int(j) for j in order[:degree]))
+    return tuple(out)
+
+
+def scheduled_in_nodes(cfg: "Config", block: int):
+    """The (N, degree) int32 gather-index array of the time-varying
+    communication graph active at training block ``block``.
+
+    Host-side and deterministic in ``(graph_seed, block // graph_every)``
+    alone, so a resumed run replays its exact graph sequence. The array
+    is DATA to the jitted train block (``train_block(..., graph=...)``):
+    every resample re-dispatches the same executable — the lint retrace
+    case proves zero steady-state recompiles across resampled blocks.
+    Rows are self-first with exactly ``cfg.resolved_graph_degree``
+    entries (every neighborhood keeps ``n_in >= 2H+1`` by the Config
+    validation), matching the static-graph gather layout.
+    """
+    import numpy as np
+
+    if cfg.graph_schedule == "static":
+        raise ValueError(
+            "scheduled_in_nodes is only defined for a time-varying "
+            "graph_schedule; the static topology is cfg.in_nodes"
+        )
+    rnd = int(block) // cfg.graph_every
+    nodes = random_geometric_in_nodes(
+        cfg.n_agents, cfg.resolved_graph_degree, (cfg.graph_seed, rnd)
+    )
+    return np.asarray(nodes, dtype=np.int32)
 
 
 @dataclass(frozen=True)
@@ -130,10 +212,47 @@ class Config:
     # --- model ---
     hidden: Tuple[int, ...] = (20, 20)
     leaky_alpha: float = 0.1
+    # --- environment selection (the env-zoo registry, rcmarl_tpu.envs) ---
+    # Which environment the trainer/evaluator rolls: 'grid_world' (the
+    # default — bit-for-bit the seed behavior, pinned), 'pursuit'
+    # (cooperative pursuit of a fleeing evader), 'coverage' (spread to
+    # cover a landmark layout), 'congestion' (goal navigation where
+    # shared cells carry a literal per-step load cost). All envs are
+    # pure-functional and JAX-native behind the same protocol
+    # (envs/api.py), so every trainer/serving/bench path is
+    # env-agnostic.
+    env: str = "grid_world"
     # --- env behavior ---
     collision_physics: bool = False  # opt-in *intended* collision semantics
     scaling: bool = True
     randomize_state: bool = True
+    # --- time-varying communication graphs ---
+    # graph_schedule: 'static' (default) keeps the fixed `in_nodes`
+    # topology compiled into the program — bit-for-bit the seed
+    # behavior. 'random_geometric' REGENERATES the in-neighborhoods
+    # every `graph_every` blocks as a deterministic random-geometric
+    # digraph of in-degree `graph_degree` (incl. self; 0 = reuse the
+    # static graph's n_in), seeded by (`graph_seed`, round). The
+    # resampled indices are DATA to the jitted block (gather indices,
+    # not program structure), so resampling causes ZERO recompiles
+    # (lint --retrace case). Solo-trainer feature: rejected with
+    # replicas / pipeline_depth; the device-scanned parallel trainers
+    # raise loudly.
+    graph_schedule: str = "static"
+    graph_every: int = 1
+    graph_degree: int = 0
+    graph_seed: int = 0
+    # --- adaptive (colluding) adversary ---
+    # Payload magnitude of Roles.ADAPTIVE agents, in units of the
+    # cooperative messages' per-coordinate spread: all colluding
+    # adversaries transmit mean_coop + adaptive_scale * (max_coop -
+    # min_coop) for every parameter coordinate
+    # (rcmarl_tpu.faults.adaptive_payload_tree). Small values sit just
+    # inside the trim bounds (the residual-influence stress test for
+    # H); large values are the unbounded mean attack that destroys
+    # H=0 consensus while H>=#adversaries-per-neighborhood absorbs it
+    # (QUALITY.md "Adaptive colluding adversary").
+    adaptive_scale: float = 10.0
     #: Reference-exact move clipping (both coordinates bounded by nrow-1,
     #: reference grid_world.py:55) — only differs from the default
     #: per-axis clip on non-square grids; see envs/grid_world.py.
@@ -299,6 +418,57 @@ class Config:
                     f"H={self.H} too large for in_nodes[{i}] of degree "
                     f"{len(nbrs)}: need 2H <= degree-1"
                 )
+        if self.env not in ENV_NAMES:
+            raise ValueError(
+                f"env={self.env!r}: expected one of {ENV_NAMES} "
+                "(the rcmarl_tpu.envs registry keys)"
+            )
+        if self.env != "grid_world" and (
+            self.collision_physics or self.reference_clip
+        ):
+            # grid-world-only semantics; silently ignoring them would
+            # let a user believe they are active (loud-rejection
+            # convention, like graph_schedule vs replicas)
+            raise ValueError(
+                f"collision_physics/reference_clip are grid_world-only "
+                f"knobs; env={self.env!r} does not implement them"
+            )
+        if self.graph_schedule not in GRAPH_SCHEDULES:
+            raise ValueError(
+                f"graph_schedule={self.graph_schedule!r}: expected one "
+                f"of {GRAPH_SCHEDULES}"
+            )
+        if self.graph_every < 1:
+            raise ValueError(
+                f"graph_every={self.graph_every} must be >= 1 "
+                "(resample cadence in blocks)"
+            )
+        if not 0 <= self.graph_degree <= self.n_agents:
+            raise ValueError(
+                f"graph_degree={self.graph_degree} must be in "
+                f"[0, n_agents={self.n_agents}] (0 = reuse the static "
+                "graph's n_in; degree counts the agent itself)"
+            )
+        if self.graph_schedule != "static":
+            deg = self.resolved_graph_degree
+            if not 0 <= 2 * self.H <= deg - 1:
+                raise ValueError(
+                    f"H={self.H} too large for a resampled "
+                    f"random_geometric graph of in-degree {deg}: need "
+                    "2H <= degree-1 in EVERY neighborhood (rows are "
+                    "regular by construction)"
+                )
+            if self.replicas or self.pipeline_depth:
+                raise ValueError(
+                    "graph_schedule='random_geometric' is a "
+                    "solo-trainer feature (the per-block resample "
+                    "lives in the host loop); run with replicas=0 and "
+                    "pipeline_depth=0"
+                )
+        if not float(self.adaptive_scale) >= 0.0:
+            raise ValueError(
+                f"adaptive_scale={self.adaptive_scale} must be >= 0"
+            )
         if self.consensus_impl not in CONSENSUS_IMPLS:
             raise ValueError(
                 f"consensus_impl={self.consensus_impl!r}: expected one of "
@@ -416,6 +586,15 @@ class Config:
     @property
     def in_degrees(self) -> Tuple[int, ...]:
         return tuple(len(nbrs) for nbrs in self.in_nodes)
+
+    @property
+    def resolved_graph_degree(self) -> int:
+        """In-degree (incl. self) of the resampled time-varying graph:
+        ``graph_degree`` when set, else the static graph's
+        :attr:`n_in` (so switching the schedule on keeps the gather
+        shape — and therefore the compiled program's input avals —
+        unchanged)."""
+        return self.graph_degree if self.graph_degree else self.n_in
 
     @property
     def gossip_n_in(self) -> int:

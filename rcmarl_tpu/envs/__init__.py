@@ -6,3 +6,21 @@ from rcmarl_tpu.envs.grid_world import (  # noqa: F401
     scale_reward,
 )
 from rcmarl_tpu.envs.reference_api import ReferenceGridWorld  # noqa: F401
+
+# The env-zoo protocol layer (rcmarl_tpu.envs.api). The grid-world
+# names above keep their historical single-env signatures (env_step
+# returns a 2-tuple — back-compat for scripts/tests written against
+# the seed API); the generic protocol names below are what the
+# trainer/serving stack consumes and dispatch over EVERY registered
+# world. api.env_reset(GridWorld, key) == env_reset(GridWorld, key).
+from rcmarl_tpu.envs.api import (  # noqa: F401
+    ENV_REGISTRY,
+    env_obs,
+    env_reward_scaled,
+    env_task,
+    env_transition,
+    make_env,
+)
+from rcmarl_tpu.envs.congestion import CongestionWorld  # noqa: F401
+from rcmarl_tpu.envs.coverage import CoverageWorld  # noqa: F401
+from rcmarl_tpu.envs.pursuit import PursuitWorld  # noqa: F401

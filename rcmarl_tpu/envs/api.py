@@ -1,0 +1,177 @@
+"""The env-zoo protocol: a registry of pure-functional JAX-native envs.
+
+Every environment in the zoo is the same shape the grid world pioneered
+(:mod:`rcmarl_tpu.envs.grid_world`): a STATIC, hashable world
+description (a NamedTuple of Python scalars, closed over by jitted
+code — the world is part of the compile key exactly like the Config)
+plus pure functions over integer state arrays. The protocol, generic
+over every env:
+
+- ``make_env(cfg)``       — registry dispatch on ``Config.env``;
+- ``env_reset(env, key)`` — initial agent state, ``(N, n_states)`` int32;
+- ``env_task(env, key)``  — the task layout drawn at run start (goals /
+  landmarks / evader start — the array living in TrainState's
+  ``desired`` slot), same ``(N, n_states)`` int32 layout;
+- ``env_transition(env, pos, task, actions)`` →
+  ``(new_pos, new_task, reward)`` — ONE synchronous vectorized step for
+  all agents. The task rides the rollout scan carry, so envs whose task
+  state evolves inside an episode (the pursuit evader) fit the same
+  compiled program as envs with static tasks (for which
+  ``new_task is task`` and XLA carries it for free);
+- ``env_obs(env, pos)``   — the scaled observation (the grid-family
+  standardization: per-axis ``(pos - mean(arange)) / std(arange)``);
+- ``env_reward_scaled(env, r)`` — the shared ``/5`` reward scale.
+
+Dispatch is by the world's TYPE at trace time (the env is jit-static),
+so the generic layer costs nothing in the compiled program and the
+rollout/trainer/serving stack is written once against this API
+(:mod:`rcmarl_tpu.training.rollout` and everything above it).
+
+The registry keys are pinned to :data:`rcmarl_tpu.config.ENV_NAMES`
+(jax-free, so Config validation and CLI choices never import an env
+module); tests assert the two stay identical.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rcmarl_tpu.config import ENV_NAMES, Config
+from rcmarl_tpu.envs import congestion, coverage, grid_world, pursuit
+from rcmarl_tpu.envs.congestion import CongestionWorld
+from rcmarl_tpu.envs.coverage import CoverageWorld
+from rcmarl_tpu.envs.grid_world import REWARD_SCALE, GridWorld
+from rcmarl_tpu.envs.pursuit import PursuitWorld
+
+
+def _make_grid_world(cfg: Config) -> GridWorld:
+    return GridWorld(
+        nrow=cfg.nrow,
+        ncol=cfg.ncol,
+        n_agents=cfg.n_agents,
+        scaling=cfg.scaling,
+        collision_physics=cfg.collision_physics,
+        reference_clip=cfg.reference_clip,
+    )
+
+
+def _make_pursuit(cfg: Config) -> PursuitWorld:
+    return PursuitWorld(
+        nrow=cfg.nrow, ncol=cfg.ncol, n_agents=cfg.n_agents,
+        scaling=cfg.scaling,
+    )
+
+
+def _make_coverage(cfg: Config) -> CoverageWorld:
+    return CoverageWorld(
+        nrow=cfg.nrow, ncol=cfg.ncol, n_agents=cfg.n_agents,
+        scaling=cfg.scaling,
+    )
+
+
+def _make_congestion(cfg: Config) -> CongestionWorld:
+    return CongestionWorld(
+        nrow=cfg.nrow, ncol=cfg.ncol, n_agents=cfg.n_agents,
+        scaling=cfg.scaling,
+    )
+
+
+#: ``Config.env`` name -> world constructor. Keys are pinned to
+#: config.ENV_NAMES (tests/test_envs.py).
+ENV_REGISTRY = {
+    "grid_world": _make_grid_world,
+    "pursuit": _make_pursuit,
+    "coverage": _make_coverage,
+    "congestion": _make_congestion,
+}
+
+assert tuple(ENV_REGISTRY) == ENV_NAMES, (
+    "envs/api.py ENV_REGISTRY drifted from config.ENV_NAMES"
+)
+
+
+def make_env(cfg: Config):
+    """The registry dispatch: ``cfg.env`` -> static world description.
+
+    ``Config.env='grid_world'`` (the default) builds exactly the
+    GridWorld the trainer always built — the pinned seed behavior."""
+    try:
+        return ENV_REGISTRY[cfg.env](cfg)
+    except KeyError:
+        raise ValueError(
+            f"Config.env={cfg.env!r} is not a registered environment; "
+            f"expected one of {tuple(ENV_REGISTRY)}"
+        ) from None
+
+
+def env_reset(env, key: jax.Array) -> jnp.ndarray:
+    """Initial agent state for any registered world: (N, n_states) int32."""
+    if isinstance(env, GridWorld):
+        return grid_world.env_reset(env, key)
+    if isinstance(env, PursuitWorld):
+        return pursuit.env_reset(env, key)
+    if isinstance(env, CoverageWorld):
+        return coverage.env_reset(env, key)
+    if isinstance(env, CongestionWorld):
+        return congestion.env_reset(env, key)
+    raise TypeError(f"not a registered env world: {type(env).__name__}")
+
+
+def env_task(env, key: jax.Array) -> jnp.ndarray:
+    """The run-start task layout (TrainState's ``desired`` slot). For
+    the grid world this IS ``env_reset`` — bit-for-bit the seed's goal
+    draw."""
+    if isinstance(env, GridWorld):
+        return grid_world.env_reset(env, key)
+    if isinstance(env, PursuitWorld):
+        return pursuit.env_task(env, key)
+    if isinstance(env, CoverageWorld):
+        return coverage.env_task(env, key)
+    if isinstance(env, CongestionWorld):
+        return congestion.env_task(env, key)
+    raise TypeError(f"not a registered env world: {type(env).__name__}")
+
+
+def env_transition(
+    env, pos: jnp.ndarray, task: jnp.ndarray, actions: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One synchronous step: ``(new_pos, new_task, reward)`` with the
+    reward UNscaled (:func:`env_reward_scaled` applies the shared
+    scale, mirroring the grid world's ``get_data`` split). Envs with
+    static tasks return ``task`` unchanged."""
+    if isinstance(env, GridWorld):
+        npos, reward = grid_world.env_step(env, pos, task, actions)
+        return npos, task, reward
+    if isinstance(env, PursuitWorld):
+        return pursuit.env_step(env, pos, task, actions)
+    if isinstance(env, CoverageWorld):
+        return coverage.env_step(env, pos, task, actions)
+    if isinstance(env, CongestionWorld):
+        return congestion.env_step(env, pos, task, actions)
+    raise TypeError(f"not a registered env world: {type(env).__name__}")
+
+
+def env_obs(env, pos: jnp.ndarray) -> jnp.ndarray:
+    """The scaled observation: per-axis ``(pos - mean)/std`` of
+    ``arange(nrow)`` / ``arange(ncol)`` when ``env.scaling``, else a
+    plain float cast — the grid family shares one standardization
+    (every zoo world lives on the same integer grid)."""
+    if isinstance(env, GridWorld):
+        return grid_world.scale_state(env, pos)  # the pinned seed path
+    if not env.scaling:
+        return pos.astype(jnp.float32)
+    x, y = np.arange(env.nrow), np.arange(env.ncol)
+    mean = np.array([np.mean(x), np.mean(y)], dtype=np.float32)
+    std = np.array([np.std(x), np.std(y)], dtype=np.float32)
+    return (pos.astype(jnp.float32) - mean) / std
+
+
+def env_reward_scaled(env, reward: jnp.ndarray) -> jnp.ndarray:
+    """``reward / 5`` — the shared scale convention, applied
+    unconditionally like the reference's ``get_data``
+    (:func:`rcmarl_tpu.envs.grid_world.scale_reward`)."""
+    return reward / REWARD_SCALE

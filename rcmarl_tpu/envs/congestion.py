@@ -1,0 +1,82 @@
+"""Congestion/routing: goal navigation where shared cells carry load.
+
+JAX-native member of the env zoo (``rcmarl_tpu.envs.api``): the
+grid-world navigation task (each agent routes to its own goal cell,
+the task array — drawn at run start exactly like the grid world's
+``desired``) with the north star's "heavy traffic" made LITERAL — a
+cell is a shared resource, and every agent occupying it alongside
+others pays a per-step congestion toll proportional to the load:
+
+    reward[i] = grid-world shaping               # 0 at-goal-and-stay,
+                                                 # else -(L1 before) - 1
+                - congestion_weight * load[i]    # load = # OTHER agents
+                                                 #   on agent i's cell
+
+The shaping term is bitwise the grid world's observed reward rule
+(:func:`rcmarl_tpu.envs.grid_world._step_observed`), so the only new
+pressure is the congestion toll: the selfish shortest path through a
+shared corridor stops being optimal once enough teammates route
+through it. Bounded in ``[-(nrow + ncol - 1) - congestion_weight *
+(n_agents - 1), 0]``, scaled by the shared ``/5`` convention. Pure
+function of ``(pos, task, actions)`` — no RNG; the task never evolves.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from rcmarl_tpu.envs.grid_world import MOVES
+
+
+class CongestionWorld(NamedTuple):
+    """Static environment description (closed over by jitted code)."""
+
+    nrow: int = 5
+    ncol: int = 5
+    n_agents: int = 5
+    scaling: bool = True
+    #: per-step toll per OTHER agent sharing the cell
+    congestion_weight: float = 1.0
+
+
+def env_reset(env: CongestionWorld, key: jax.Array) -> jnp.ndarray:
+    """Agent positions ~ U over the grid. (n_agents, 2) int32."""
+    return jax.random.randint(
+        key,
+        (env.n_agents, 2),
+        jnp.array([0, 0]),
+        jnp.array([env.nrow, env.ncol]),
+        dtype=jnp.int32,
+    )
+
+
+def env_task(env: CongestionWorld, key: jax.Array) -> jnp.ndarray:
+    """Per-agent goal cells ~ U over the grid (the grid world's
+    ``desired`` draw, unchanged)."""
+    return env_reset(env, key)
+
+
+def env_step(
+    env: CongestionWorld,
+    pos: jnp.ndarray,
+    task: jnp.ndarray,
+    actions: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One synchronous step. Returns (new_pos, task, reward)."""
+    clip_hi = jnp.array([env.nrow - 1, env.ncol - 1], dtype=jnp.int32)
+    move = jnp.asarray(MOVES)[actions]
+    dist_before = jnp.sum(jnp.abs(pos - task), axis=1)  # (N,)
+    npos = jnp.clip(pos + move, 0, clip_hi)
+    at_goal_stay = (dist_before == 0) & (actions == 0)
+    shaping = jnp.where(
+        at_goal_stay, 0.0, -(dist_before.astype(jnp.float32)) - 1.0
+    )
+    # load: how many OTHER agents landed on my cell this step
+    pair = jnp.sum(jnp.abs(npos[:, None, :] - npos[None, :, :]), axis=-1)
+    same_cell = (pair == 0).astype(jnp.float32)
+    load = jnp.sum(same_cell, axis=1) - 1.0  # exclude self
+    reward = shaping - env.congestion_weight * load
+    return npos, task, reward

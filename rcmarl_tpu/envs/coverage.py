@@ -1,0 +1,81 @@
+"""Coverage/formation: spread out to cover a fixed landmark layout.
+
+JAX-native member of the env zoo (``rcmarl_tpu.envs.api``), the
+grid-world twin of the particle-world "simple spread" task: the task
+array holds ``n_agents`` landmark cells drawn at run start (the
+protocol's ``desired`` slot, static within the run like the grid
+world's goals), and the team is rewarded for keeping EVERY landmark
+close to SOME agent while not stacking on one cell.
+
+Reward row i (per-landmark credit, so the reward keeps the protocol's
+per-agent layout while the objective stays cooperative):
+
+    reward[i] = -(L1 distance of landmark i to its NEAREST agent)
+                - 1.0 * [agent i shares a cell with another agent]
+
+Any agent may cover any landmark — the min over agents is what makes
+the task a coverage problem rather than N independent navigations; the
+collision term penalizes degenerate "everyone sits on one landmark"
+solutions. Bounded in ``[-(nrow + ncol - 1), 0]``, scaled by the shared
+``/5`` convention. The step is a pure function of
+``(pos, task, actions)`` — no RNG, exact dynamics determinism; the
+task never evolves (``new_task is task``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from rcmarl_tpu.envs.grid_world import MOVES
+
+
+class CoverageWorld(NamedTuple):
+    """Static environment description (closed over by jitted code)."""
+
+    nrow: int = 5
+    ncol: int = 5
+    n_agents: int = 5
+    scaling: bool = True
+    #: per-step penalty for sharing a cell with another agent
+    collide_penalty: float = 1.0
+
+
+def env_reset(env: CoverageWorld, key: jax.Array) -> jnp.ndarray:
+    """Agent positions ~ U over the grid. (n_agents, 2) int32."""
+    return jax.random.randint(
+        key,
+        (env.n_agents, 2),
+        jnp.array([0, 0]),
+        jnp.array([env.nrow, env.ncol]),
+        dtype=jnp.int32,
+    )
+
+
+def env_task(env: CoverageWorld, key: jax.Array) -> jnp.ndarray:
+    """The landmark layout: n_agents cells ~ U over the grid (may
+    coincide — covering duplicated landmarks is just easier)."""
+    return env_reset(env, key)
+
+
+def env_step(
+    env: CoverageWorld,
+    pos: jnp.ndarray,
+    task: jnp.ndarray,
+    actions: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One synchronous step. Returns (new_pos, task, reward)."""
+    clip_hi = jnp.array([env.nrow - 1, env.ncol - 1], dtype=jnp.int32)
+    move = jnp.asarray(MOVES)[actions]
+    npos = jnp.clip(pos + move, 0, clip_hi)
+    # (landmark, agent) pairwise L1 distances after the move
+    d = jnp.sum(jnp.abs(task[:, None, :] - npos[None, :, :]), axis=-1)
+    cover = jnp.min(d, axis=1).astype(jnp.float32)  # (N,) per landmark
+    # collision: agent i shares its cell with at least one other agent
+    pair = jnp.sum(jnp.abs(npos[:, None, :] - npos[None, :, :]), axis=-1)
+    pair = pair + jnp.eye(env.n_agents, dtype=pair.dtype) * 10**6
+    crowded = (jnp.min(pair, axis=1) == 0).astype(jnp.float32)
+    reward = -cover - env.collide_penalty * crowded
+    return npos, task, reward

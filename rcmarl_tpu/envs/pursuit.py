@@ -1,0 +1,94 @@
+"""Pursuit-evasion: the team chases a deterministically fleeing evader.
+
+JAX-native member of the env zoo (``rcmarl_tpu.envs.api``): the same
+pure-functional shape as :mod:`rcmarl_tpu.envs.grid_world` — a static
+hashable world description closed over by jitted code, integer
+positions, one synchronous vectorized step — but the TASK state (the
+evader) evolves inside the episode, which is why the env protocol
+threads the task through the rollout scan carry
+(:func:`rcmarl_tpu.envs.api.env_transition`).
+
+Dynamics, per step (all simultaneous):
+
+1. every agent applies its move (grid-world action table, clipped);
+2. the evader — the shared task state, one position broadcast to every
+   task row — flees DETERMINISTICALLY: among the five candidate moves
+   (clipped) it takes the one maximizing its distance to the nearest
+   pursuer (min over agents of the L1 distance; stable first-max
+   tie-break). No RNG: the step is a pure function of
+   ``(pos, task, actions)``, so dynamics determinism is exact;
+3. a capture pins the evader: when some pursuer stands on the evader's
+   cell after the moves, the evader does not flee this step.
+
+Reward (cooperative, grid-world-shaped so the critic scales carry
+over): ``0`` for agent i when the team has the evader caught
+(min distance 0), else ``-(L1 distance of agent i to the evader) - 1``
+— bounded in ``[-(nrow + ncol - 1), 0]``, scaled by the shared ``/5``
+convention (:data:`rcmarl_tpu.envs.grid_world.REWARD_SCALE`).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from rcmarl_tpu.envs.grid_world import MOVES
+
+
+class PursuitWorld(NamedTuple):
+    """Static environment description (closed over by jitted code)."""
+
+    nrow: int = 5
+    ncol: int = 5
+    n_agents: int = 5
+    scaling: bool = True
+
+
+def env_reset(env: PursuitWorld, key: jax.Array) -> jnp.ndarray:
+    """Pursuer positions ~ U over the grid. (n_agents, 2) int32."""
+    return jax.random.randint(
+        key,
+        (env.n_agents, 2),
+        jnp.array([0, 0]),
+        jnp.array([env.nrow, env.ncol]),
+        dtype=jnp.int32,
+    )
+
+
+def env_task(env: PursuitWorld, key: jax.Array) -> jnp.ndarray:
+    """The evader's start cell, broadcast to every task row — the task
+    array keeps the protocol's (n_agents, 2) int32 layout (TrainState's
+    ``desired`` slot) with all rows identical."""
+    e = jax.random.randint(
+        key, (2,), jnp.array([0, 0]), jnp.array([env.nrow, env.ncol]),
+        dtype=jnp.int32,
+    )
+    return jnp.broadcast_to(e, (env.n_agents, 2)).astype(jnp.int32)
+
+
+def env_step(
+    env: PursuitWorld,
+    pos: jnp.ndarray,
+    task: jnp.ndarray,
+    actions: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One synchronous step. Returns (new_pos, new_task, reward)."""
+    clip_hi = jnp.array([env.nrow - 1, env.ncol - 1], dtype=jnp.int32)
+    move = jnp.asarray(MOVES)[actions]
+    npos = jnp.clip(pos + move, 0, clip_hi)
+    evader = task[0]
+    # the evader's five candidate cells (stay/left/right/down/up), clipped
+    cand = jnp.clip(evader[None, :] + jnp.asarray(MOVES), 0, clip_hi)  # (5, 2)
+    # distance of each candidate to its NEAREST pursuer (after the moves)
+    d = jnp.sum(jnp.abs(cand[None, :, :] - npos[:, None, :]), axis=-1)  # (N, 5)
+    nearest = jnp.min(d, axis=0)  # (5,)
+    flee = cand[jnp.argmax(nearest)]
+    dist_now = jnp.sum(jnp.abs(npos - evader[None, :]), axis=1)  # (N,)
+    caught = jnp.min(dist_now) == 0
+    new_evader = jnp.where(caught, evader, flee)
+    dist = jnp.sum(jnp.abs(npos - new_evader[None, :]), axis=1)  # (N,)
+    reward = jnp.where(caught, 0.0, -(dist.astype(jnp.float32)) - 1.0)
+    ntask = jnp.broadcast_to(new_evader, (env.n_agents, 2)).astype(jnp.int32)
+    return npos, ntask, reward

@@ -243,6 +243,61 @@ def apply_replica_faults(key, fresh, stale, plan: ReplicaFaultPlan, in_nodes):
     return v
 
 
+def adaptive_payload_tree(tree, coop_mask, adaptive_mask, scale):
+    """Colluding omniscient-adversary payloads optimized against the
+    trimmed mean (the ``Roles.ADAPTIVE`` label's message transform).
+
+    For EVERY parameter coordinate, all colluding adversaries replace
+    their transmitted message with the same crafted value::
+
+        payload = mean_coop + scale * (max_coop - min_coop)
+
+    computed over the CURRENT epoch's cooperative messages — the
+    "little is enough" placement family: at small ``scale`` the payload
+    sits at (or just past) the edge of the healthy values' spread, so
+    an ``H``-trimming neighborhood clips it back to the cooperative
+    range and the residual influence is bounded by the healthy spread
+    itself; at large ``scale`` it is the unbounded coordinated-mean
+    attack that an untrimmed (``H=0``) clip-and-average neighborhood
+    has no defense against (its clip bounds are the min/max of the
+    gathered block, which the adversaries themselves set). All
+    adversaries transmitting the SAME payload is what makes the
+    collusion maximal: their ≤H copies stack on one side of every
+    coordinate's order statistics.
+
+    Deterministic (no RNG) and computed from the messages alone, so it
+    traces identically on both netstack arms and leaves the clean-run
+    key streams untouched.
+
+    Args:
+      tree: the epoch's message pytree, leaves ``(N, ...)``.
+      coop_mask / adaptive_mask: ``(N,)`` bools (disjoint).
+      scale: the static payload magnitude (``Config.adaptive_scale``).
+
+    Returns the tree with adaptive rows replaced; all other rows are
+    bitwise untouched.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    coop = jnp.asarray(coop_mask)
+    adaptive = jnp.asarray(adaptive_mask)
+    n_coop = jnp.maximum(jnp.sum(coop.astype(jnp.int32)), 1).astype(
+        jnp.float32
+    )
+
+    def craft(leaf):
+        m = coop.reshape((-1,) + (1,) * (leaf.ndim - 1))
+        mean_c = jnp.sum(jnp.where(m, leaf, 0.0), axis=0) / n_coop
+        max_c = jnp.max(jnp.where(m, leaf, -jnp.inf), axis=0)
+        min_c = jnp.min(jnp.where(m, leaf, jnp.inf), axis=0)
+        payload = mean_c + jnp.asarray(scale, leaf.dtype) * (max_c - min_c)
+        a = adaptive.reshape((-1,) + (1,) * (leaf.ndim - 1))
+        return jnp.where(a, payload[None], leaf)
+
+    return jax.tree.map(craft, tree)
+
+
 class FaultDiag(NamedTuple):
     """Per-block degradation counters (int32 scalars, summable across
     epochs/trees): ``nonfinite`` = NaN/±Inf payload entries seen in the

@@ -215,7 +215,9 @@ def audit_retrace(
     The cases cover the production paths: a guarded+faulted run on the
     dual arm and on the stacked arms (netstack phase II fed by the
     fused fitstack phase I, mixed cast — the undonated retry-capable
-    entries, diag on), a clean run (the donated steady-state entries),
+    entries, diag on), a time-varying-graph run (per-block resampled
+    random-geometric gather indices fed in as data — a resample may
+    never be a compile), a clean run (the donated steady-state entries),
     the alternating f32/bf16 fused-fit case (exactly one compile per
     compute_dtype, zero steady-state recompiles across alternation —
     :func:`_audit_fitstack_dtypes`), and a Byzantine gossip-replica
@@ -234,6 +236,17 @@ def audit_retrace(
     auditor = RetraceAuditor()
     cases = [
         ("faulted+guarded, netstack off", _tiny_cfg(False, True)),
+        # the time-varying communication graph: every block gets a
+        # FRESH random-geometric gather-index array (same shape, new
+        # values — data, not program structure), so a resample may
+        # never be a compile. This is the env-zoo acceptance proof
+        # that indices-as-data works (config.scheduled_in_nodes).
+        (
+            "per-block resampled communication graph",
+            _tiny_cfg(False, False).replace(
+                graph_schedule="random_geometric", graph_degree=3
+            ),
+        ),
         # one stacked case covers BOTH stacked arms: fused cross-flavor
         # phase-I fits (fitstack) feeding the combined netstack
         # phase-II block. Compile-once discipline is role-independent

@@ -48,7 +48,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from rcmarl_tpu.config import Config, circulant_in_nodes, full_in_nodes
+from rcmarl_tpu.config import (
+    Config,
+    circulant_in_nodes,
+    full_in_nodes,
+    random_geometric_in_nodes,
+)
 
 #: fold_in tag deriving the replica-fault stream from the gossip round
 #: key — a DEDICATED stream (the training replicas' RNG streams and the
@@ -87,16 +92,11 @@ def replica_in_nodes(cfg: Config) -> Tuple[Tuple[int, ...], ...]:
     if cfg.gossip_graph == "ring":
         return circulant_in_nodes(R, cfg.gossip_degree)
     # random_geometric: host-side, deterministic in gossip_seed alone —
-    # the graph is static data (regenerating per run would retrace).
-    rng = np.random.default_rng(cfg.gossip_seed)
-    pos = rng.random((R, 2))
-    out = []
-    for i in range(R):
-        d = np.linalg.norm(pos - pos[i], axis=1)
-        d[i] = -1.0  # self sorts first
-        order = np.argsort(d, kind="stable")
-        out.append(tuple(int(j) for j in order[: cfg.gossip_degree]))
-    return tuple(out)
+    # the graph is static data here (regenerating per run would
+    # retrace). The builder is SHARED with the agent-level time-varying
+    # schedule (config.py:random_geometric_in_nodes), which resamples
+    # it per block and feeds the indices in as data instead.
+    return random_geometric_in_nodes(R, cfg.gossip_degree, cfg.gossip_seed)
 
 
 def _mix_tree(params):

@@ -84,6 +84,20 @@ def _check_fusable(base: Config, cells: Sequence[Config]) -> None:
             "the fused matrix runs consensus on the XLA path (traced H); "
             f"consensus_impl={base.consensus_impl!r} cannot apply"
         )
+    if base.graph_schedule != "static":
+        raise ValueError(
+            "the fused matrix cannot run a time-varying graph_schedule "
+            "(the per-block resample is host-side data the device scan "
+            "cannot regenerate); use the solo trainer"
+        )
+    from rcmarl_tpu.config import Roles
+
+    if any(Roles.ADAPTIVE in c.agent_roles for c in cells):
+        raise ValueError(
+            "the fused matrix (traced CellSpec) does not model the "
+            "ADAPTIVE colluding adversary; run adaptive cells through "
+            "the per-cell sweep or the solo trainer"
+        )
 
 
 def matrix_specs(cells: Sequence[Config], n_seeds: int) -> CellSpec:

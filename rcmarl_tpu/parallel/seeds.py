@@ -221,6 +221,12 @@ def train_parallel(
     """
     if (seeds is None) == (states is None):
         raise ValueError("pass exactly one of `seeds` (fresh) or `states` (resume)")
+    if cfg.graph_schedule != "static":
+        raise ValueError(
+            "train_parallel cannot run a time-varying graph_schedule "
+            "(the per-block resample is host-side data the device scan "
+            "cannot regenerate); use train() (the solo host loop)"
+        )
     if mesh is None:
         # Default mesh must evenly shard the replica axis: use the largest
         # device count that divides the replica count, all on 'seed'.

@@ -5,8 +5,16 @@ TPU-native rebuild of the reference's rollout hot loop
 ``predict`` calls on batches of 1 per episode (~2.5 env-steps/s). Here a
 whole update block — ``n_ep_fixed`` episodes x ``max_ep_len`` steps — is
 one XLA program: vmapped policy forward for all agents at once, the pure
-grid-world step, and metric accumulation, scanned over steps and episodes
+env step, and metric accumulation, scanned over steps and episodes
 with zero host round-trips.
+
+Generic over the env-zoo protocol (:mod:`rcmarl_tpu.envs.api`): the env
+is a static world description dispatched at trace time, the task array
+(goals / landmarks / evader — TrainState's ``desired``) rides the step
+scan carry so task-evolving envs (pursuit) share this exact program
+shape with static-task envs, for which the carried task is unchanged
+data and the compiled program's arithmetic is bit-for-bit the
+historical grid-world rollout (the ``Config.env='grid_world'`` pin).
 """
 
 from __future__ import annotations
@@ -18,12 +26,11 @@ import jax.numpy as jnp
 
 from rcmarl_tpu.agents.updates import AgentParams, Batch, CellSpec
 from rcmarl_tpu.config import Config
-from rcmarl_tpu.envs.grid_world import (
-    GridWorld,
+from rcmarl_tpu.envs.api import (
+    env_obs,
     env_reset,
-    env_step,
-    scale_reward,
-    scale_state,
+    env_reward_scaled,
+    env_transition,
 )
 from rcmarl_tpu.models.mlp import actor_probs, mlp_forward
 
@@ -64,7 +71,7 @@ def sample_actions(
 
 def rollout_episode(
     cfg: Config,
-    env: GridWorld,
+    env,
     params: AgentParams,
     desired: jnp.ndarray,
     key: jax.Array,
@@ -76,11 +83,14 @@ def rollout_episode(
     reference interleaves metric evaluation with training
     (``train_agents.py:55-71``).
 
-    Reset honors ``cfg.randomize_state`` (reference ``grid_world.py:39-43``):
-    random positions by default, else the fixed ``initial`` layout drawn at
-    startup (reference ``main.py:49``). Rollout dynamics are
-    role-independent; ``spec`` (the fused-matrix path) only redefines
-    which agents count as cooperative in the METRICS.
+    ``env`` is any registered env-zoo world; ``desired`` is its task
+    array (episode-START layout — a task-evolving env restarts from it
+    every episode). Reset honors ``cfg.randomize_state`` (reference
+    ``grid_world.py:39-43``): random positions by default, else the
+    fixed ``initial`` layout drawn at startup (reference ``main.py:49``).
+    Rollout dynamics are role-independent; ``spec`` (the fused-matrix
+    path) only redefines which agents count as cooperative in the
+    METRICS.
     """
     k_reset, k_steps = jax.random.split(key)
     if cfg.randomize_state:
@@ -94,7 +104,7 @@ def rollout_episode(
         pos0 = initial
 
     # Estimated team returns at s0 (train_agents.py:60-62)
-    s0 = scale_state(env, pos0)
+    s0 = env_obs(env, pos0)
     if spec is None:
         coop = jnp.asarray(cfg.coop_mask)
         n_coop = max(cfg.n_coop, 1)
@@ -111,23 +121,23 @@ def rollout_episode(
     est = jnp.sum(jnp.where(coop, v0, 0.0)) / n_coop
 
     def step(carry, k):
-        pos, ret, j = carry
-        s_scaled = scale_state(env, pos)
+        pos, task, ret, j = carry
+        s_scaled = env_obs(env, pos)
         actions = sample_actions(cfg, params.actor, s_scaled, k)
-        npos, reward = env_step(env, pos, desired, actions)
-        r_scaled = scale_reward(env, reward)  # (N,)
+        npos, ntask, reward = env_transition(env, pos, task, actions)
+        r_scaled = env_reward_scaled(env, reward)  # (N,)
         ret = ret + r_scaled * cfg.gamma**j
         out = (
             s_scaled,
-            scale_state(env, npos),
+            env_obs(env, npos),
             actions.astype(jnp.float32)[:, None],
             r_scaled[:, None],
         )
-        return (npos, ret, j + 1.0), out
+        return (npos, ntask, ret, j + 1.0), out
 
-    (_, ep_returns, _), (s, ns, a, r) = jax.lax.scan(
+    (_, _, ep_returns, _), (s, ns, a, r) = jax.lax.scan(
         step,
-        (pos0, jnp.zeros((cfg.n_agents,)), 0.0),
+        (pos0, desired, jnp.zeros((cfg.n_agents,)), 0.0),
         jax.random.split(k_steps, cfg.max_ep_len),
     )
 
@@ -139,7 +149,7 @@ def rollout_episode(
 
 def rollout_block(
     cfg: Config,
-    env: GridWorld,
+    env,
     params: AgentParams,
     desired: jnp.ndarray,
     key: jax.Array,

@@ -22,8 +22,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from rcmarl_tpu.agents.updates import AgentParams
-from rcmarl_tpu.config import Config
-from rcmarl_tpu.envs.grid_world import GridWorld, env_reset
+from rcmarl_tpu.config import Config, scheduled_in_nodes
+from rcmarl_tpu.envs.api import env_reset, env_task
+from rcmarl_tpu.envs.api import make_env as _registry_make_env
 from rcmarl_tpu.faults import tree_all_finite, tree_finite_per_replica
 from rcmarl_tpu.training.buffer import (
     ReplayBuffer,
@@ -46,15 +47,13 @@ class TrainState(NamedTuple):
     block: jnp.ndarray  # () int32 completed-block counter
 
 
-def make_env(cfg: Config) -> GridWorld:
-    return GridWorld(
-        nrow=cfg.nrow,
-        ncol=cfg.ncol,
-        n_agents=cfg.n_agents,
-        scaling=cfg.scaling,
-        collision_physics=cfg.collision_physics,
-        reference_clip=cfg.reference_clip,
-    )
+def make_env(cfg: Config):
+    """The env-zoo registry dispatch (``Config.env`` -> static world,
+    :func:`rcmarl_tpu.envs.api.make_env`). Kept as a trainer-level name
+    because every layer above (serving, pipeline, profiling, CLI)
+    historically imports it from here; ``env='grid_world'`` (default)
+    builds exactly the world this function always built."""
+    return _registry_make_env(cfg)
 
 
 def init_train_state(
@@ -71,7 +70,9 @@ def init_train_state(
     k_desired, k_initial, k_params, k_run = jax.random.split(key, 4)
     env = make_env(cfg)
     if desired is None:
-        desired = env_reset(env, k_desired)
+        # the env's TASK layout (goals / landmarks / evader start); for
+        # the grid world this is env_reset — bit-for-bit the seed draw
+        desired = env_task(env, k_desired)
     initial = env_reset(env, k_initial)
     if params is None:
         params = init_agent_params(k_params, cfg)
@@ -88,7 +89,8 @@ def init_train_state(
 
 
 def _train_block(
-    cfg: Config, state: TrainState, spec=None, with_diag: bool = False
+    cfg: Config, state: TrainState, spec=None, with_diag: bool = False,
+    graph=None,
 ) -> Tuple[TrainState, EpisodeMetrics]:
     """One block: rollout ``n_ep_fixed`` episodes, update, push to buffer.
 
@@ -99,6 +101,12 @@ def _train_block(
     the fused-matrix path (:mod:`rcmarl_tpu.parallel.matrix`).
     ``with_diag`` (static) additionally returns the block's
     :class:`~rcmarl_tpu.faults.FaultDiag` degradation counters.
+    ``graph`` (optional DATA, an ``(N, degree)`` int32 gather-index
+    array) overrides the static communication topology for this block —
+    the time-varying graph schedule
+    (:func:`rcmarl_tpu.config.scheduled_in_nodes`); indices being data
+    is what makes per-block resampling free of recompiles. ``None``
+    (default) keeps the compiled static topology, bit-for-bit.
 
     Exposed as :data:`train_block` (inputs stay alive) and
     :data:`train_block_donated` (``state`` donated — the host training
@@ -112,10 +120,13 @@ def _train_block(
     batch = update_batch(state.buffer, fresh)
     if with_diag:
         params, diag = update_block(
-            cfg, state.params, batch, fresh, k_upd, spec, with_diag=True
+            cfg, state.params, batch, fresh, k_upd, spec, with_diag=True,
+            graph=graph,
         )
     else:
-        params = update_block(cfg, state.params, batch, fresh, k_upd, spec)
+        params = update_block(
+            cfg, state.params, batch, fresh, k_upd, spec, graph=graph
+        )
     buffer = buffer_push_block(state.buffer, fresh)
     out_state = TrainState(
         params, buffer, state.desired, state.initial, key, state.block + 1
@@ -154,6 +165,13 @@ def train_scanned(
     Returned metrics leaves have shape (n_blocks * n_ep_fixed,) == one row
     per episode, flattened in episode order.
     """
+
+    if cfg.graph_schedule != "static":
+        raise ValueError(
+            "train_scanned cannot run a time-varying graph_schedule: "
+            "the per-block resample is host-side data the device scan "
+            "cannot regenerate — use train() (the host loop)"
+        )
 
     def body(s, _):
         return train_block(cfg, s, spec)
@@ -262,8 +280,22 @@ def train(
     with_diag = cfg.fault_plan is not None and cfg.fault_plan.active
     stats = {"retries": 0, "skipped": 0, "nonfinite": 0, "deficit": 0}
 
+    # Time-varying communication graphs (Config.graph_schedule): the
+    # block's gather indices are regenerated host-side — deterministic
+    # in (graph_seed, GLOBAL block number), so resumed runs replay
+    # their exact graph sequence — and passed to the jitted block as
+    # DATA (same shape every block: one compile, zero steady-state
+    # recompiles, proven by the lint retrace case).
+    dynamic_graph = cfg.graph_schedule != "static"
+    start_block = (
+        int(np.asarray(state.block).reshape(-1)[0]) if dynamic_graph else 0
+    )
+
     all_metrics = []
     for b in range(n_blocks):
+        graph = (
+            scheduled_in_nodes(cfg, start_block + b) if dynamic_graph else None
+        )
         attempt = 0
         while True:
             base = state
@@ -276,9 +308,11 @@ def train(
                 )
             diag = None
             if with_diag:
-                new_state, m, diag = step(cfg, base, with_diag=True)
+                new_state, m, diag = step(
+                    cfg, base, with_diag=True, graph=graph
+                )
             else:
-                new_state, m = step(cfg, base)
+                new_state, m = step(cfg, base, graph=graph)
             if not guard or _block_healthy(new_state, m):
                 state = new_state
                 break

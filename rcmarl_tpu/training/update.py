@@ -51,6 +51,7 @@ from rcmarl_tpu.agents.updates import (
 from rcmarl_tpu.config import Config, Roles
 from rcmarl_tpu.faults import (
     FaultDiag,
+    adaptive_payload_tree,
     apply_link_faults,
     apply_link_faults_flat,
     fault_diagnostics,
@@ -132,7 +133,15 @@ def spec_from_config(cfg: Config) -> CellSpec:
     """The config's static role/H/common_reward knobs as a concrete
     :class:`CellSpec` pytree — the bridge between the solo trainer's
     trace-time specialization and the fused-matrix path (stack these
-    across cells and vmap)."""
+    across cells and vmap). The ADAPTIVE role has no spec mask (its
+    payload crafting is a static-path feature), so adaptive casts are
+    rejected here rather than silently degraded to Faulty."""
+    if cfg.has_role(Roles.ADAPTIVE):
+        raise ValueError(
+            "the fused-matrix path (CellSpec) does not model the "
+            "ADAPTIVE colluding adversary; run adaptive casts through "
+            "the solo trainer / per-cell sweep"
+        )
     return CellSpec(
         coop=_role_mask(cfg, Roles.COOPERATIVE),
         greedy=_role_mask(cfg, Roles.GREEDY),
@@ -142,13 +151,20 @@ def spec_from_config(cfg: Config) -> CellSpec:
     )
 
 
-def gather_neighbor_messages(cfg: Config, tree):
+def gather_neighbor_messages(cfg: Config, tree, in_arr=None):
     """Stack each agent's in-neighborhood of messages: (N, ...) leaves ->
     (N, n_in, ...) leaves, own message at neighbor index 0.
 
+    ``in_arr`` (optional) is a TRACED ``(N, degree)`` int32 index array
+    — the time-varying communication graph
+    (:func:`rcmarl_tpu.config.scheduled_in_nodes`): gather indices are
+    data, not program structure, so per-block resampling re-dispatches
+    one compiled program. ``None`` (default) compiles the static
+    ``cfg.in_nodes`` topology exactly as always.
+
     This is the framework's "communication backend" (reference
     ``train_agents.py:129-130`` — list indexing of weight lists). Two
-    lowerings:
+    static lowerings:
 
     - rotation-symmetric graphs (circulant / fully-connected,
       :attr:`Config.uniform_shifts`): ``n_in`` static rolls. Under an
@@ -163,6 +179,9 @@ def gather_neighbor_messages(cfg: Config, tree):
       max degree for ragged graphs), which XLA lowers to an all-gather
       of the full stacked params when sharded.
     """
+    if in_arr is not None:
+        idx = jnp.asarray(in_arr)
+        return jax.tree.map(lambda l: l[idx], tree)
     shifts = cfg.uniform_shifts
     if shifts is not None:
         return jax.tree.map(
@@ -330,10 +349,15 @@ def critic_tr_epoch(
     ekey: jax.Array,
     spec: CellSpec | None = None,
     with_diag: bool = False,
+    graph=None,
 ):
     """One epoch of phases I+II over stacked params.
 
     carry = (critic, tr, critic_local), each leaf (N, ...).
+    ``graph`` (optional traced ``(N, degree)`` int32) switches the
+    phase-II exchange onto the time-varying communication graph —
+    indices as data, regular by construction (no validity masking),
+    the static topology otherwise untouched.
 
     Without ``spec``, role composition / H / common_reward come from the
     static Config and absent roles are never traced (the solo path).
@@ -353,7 +377,7 @@ def critic_tr_epoch(
     """
     if netstack_enabled(cfg):
         return _critic_tr_epoch_netstack(
-            cfg, carry, batch, r_coop, ekey, spec, with_diag
+            cfg, carry, batch, r_coop, ekey, spec, with_diag, graph
         )
     critic, tr, critic_local = carry
     s, ns, sa, mask = batch.s, batch.ns, batch.sa, batch.mask
@@ -435,15 +459,33 @@ def critic_tr_epoch(
     # ---- Phase II: resilient consensus, cooperative agents only
     diag = zero_diag() if with_diag else None
     if traced or cfg.n_coop:
+        # Adaptive colluding adversaries (Roles.ADAPTIVE) replace their
+        # transmitted messages with a payload crafted from THIS epoch's
+        # cooperative messages against the trimmed mean (omniscient
+        # collusion — rcmarl_tpu.faults.adaptive_payload_tree). Static
+        # path only: the fused-matrix spec has no adaptive mask
+        # (spec_from_config rejects the role).
+        if not traced and cfg.has_role(Roles.ADAPTIVE):
+            amask = _role_mask(cfg, Roles.ADAPTIVE)
+            cmask = _role_mask(cfg, Roles.COOPERATIVE)
+            msg_critic = adaptive_payload_tree(
+                msg_critic, cmask, amask, cfg.adaptive_scale
+            )
+            msg_tr = adaptive_payload_tree(
+                msg_tr, cmask, amask, cfg.adaptive_scale
+            )
         # Heterogeneous in-degree graphs (reference main.py:28 accepts
         # arbitrary adjacency lists): rows padded to max degree with the
         # agent's own index; padded slots masked out of the aggregation.
         # (The fused-matrix path requires a uniform graph: traced H and
-        # the padded-validity mask are mutually exclusive.)
+        # the padded-validity mask are mutually exclusive. A time-
+        # varying graph is regular by construction: no masking.)
         _, valid_pad = cfg.padded_in_nodes()
+        if graph is not None:
+            valid_pad = None
         H = spec.H if traced else None
-        nbr_c = gather_neighbor_messages(cfg, msg_critic)  # (N, n_in, ...)
-        nbr_t = gather_neighbor_messages(cfg, msg_tr)
+        nbr_c = gather_neighbor_messages(cfg, msg_critic, graph)  # (N, n_in, ...)
+        nbr_t = gather_neighbor_messages(cfg, msg_tr, graph)
         plan = cfg.fault_plan
         if plan is not None and plan.active:
             # Transport boundary: fault the gathered blocks. A stale
@@ -456,8 +498,8 @@ def critic_tr_epoch(
             # matrix, and both gather lowerings.
             fkey = jax.random.fold_in(ekey, _FAULT_STREAM)
             if float(plan.stale_p) > 0.0:
-                stale_c = gather_neighbor_messages(cfg, critic)
-                stale_t = gather_neighbor_messages(cfg, tr)
+                stale_c = gather_neighbor_messages(cfg, critic, graph)
+                stale_t = gather_neighbor_messages(cfg, tr, graph)
             else:
                 stale_c, stale_t = nbr_c, nbr_t
             nbr_c = apply_link_faults(
@@ -550,6 +592,7 @@ def _critic_tr_epoch_netstack(
     ekey: jax.Array,
     spec: CellSpec | None,
     with_diag: bool,
+    graph=None,
 ):
     """The netstack twin of :func:`critic_tr_epoch` (``cfg.netstack``;
     on TPU under the default ``'auto'`` policy): identical math and RNG
@@ -659,7 +702,21 @@ def _critic_tr_epoch_netstack(
     # ONE combined (N, n_in, P_critic + P_tr) gathered block
     diag = zero_diag() if with_diag else None
     if traced or cfg.n_coop:
+        # Adaptive colluding payloads — identical math to the dual arm
+        # (applied per tree AFTER the phase-I split, so the arms stay
+        # pinned leaf-for-leaf).
+        if not traced and cfg.has_role(Roles.ADAPTIVE):
+            amask = _role_mask(cfg, Roles.ADAPTIVE)
+            cmask = _role_mask(cfg, Roles.COOPERATIVE)
+            msg_c = adaptive_payload_tree(
+                msg_c, cmask, amask, cfg.adaptive_scale
+            )
+            msg_t = adaptive_payload_tree(
+                msg_t, cmask, amask, cfg.adaptive_scale
+            )
         _, valid_pad = cfg.padded_in_nodes()
+        if graph is not None:
+            valid_pad = None  # time-varying graphs are regular
         if traced and valid_pad is not None:
             raise ValueError(
                 "the fused-matrix path (traced CellSpec) requires a "
@@ -667,7 +724,7 @@ def _critic_tr_epoch_netstack(
                 "neighborhoods"
             )
         H = spec.H if traced else None
-        nbr = gather_neighbor_messages(cfg, _pair_block(msg_c, msg_t))
+        nbr = gather_neighbor_messages(cfg, _pair_block(msg_c, msg_t), graph)
         plan = cfg.fault_plan
         if plan is not None and plan.active:
             # Transport boundary on the combined block: per-tree masks /
@@ -676,7 +733,9 @@ def _critic_tr_epoch_netstack(
             # is live (same gating as the dual arm).
             fkey = jax.random.fold_in(ekey, _FAULT_STREAM)
             if float(plan.stale_p) > 0.0:
-                stale = gather_neighbor_messages(cfg, _pair_block(critic, tr))
+                stale = gather_neighbor_messages(
+                    cfg, _pair_block(critic, tr), graph
+                )
             else:
                 stale = nbr
             nbr = apply_link_faults_flat(
@@ -774,6 +833,7 @@ def _update_block(
     key: jax.Array,
     spec: CellSpec | None = None,
     with_diag: bool = False,
+    graph=None,
 ) -> AgentParams:
     """Full update block: ``n_epochs`` x (phase I + II) then phase III.
 
@@ -790,6 +850,9 @@ def _update_block(
       with_diag: (static) also return a block-summed
         :class:`~rcmarl_tpu.faults.FaultDiag` of transport-degradation
         counters — ``(params, diag)`` instead of ``params``.
+      graph: optional traced (N, degree) int32 gather indices — the
+        block's time-varying communication graph (constant across the
+        block's epochs; data, so resampling never recompiles).
     """
     r_coop = team_average_reward(cfg, batch.r, spec)
     k_epochs, k_actor = jax.random.split(key)
@@ -797,9 +860,13 @@ def _update_block(
     def epoch(carry, ekey):
         if with_diag:
             return critic_tr_epoch(
-                cfg, carry, batch, r_coop, ekey, spec, with_diag=True
+                cfg, carry, batch, r_coop, ekey, spec, with_diag=True,
+                graph=graph,
             )
-        return critic_tr_epoch(cfg, carry, batch, r_coop, ekey, spec), None
+        return (
+            critic_tr_epoch(cfg, carry, batch, r_coop, ekey, spec, graph=graph),
+            None,
+        )
 
     (critic, tr, critic_local), diags = jax.lax.scan(
         epoch,

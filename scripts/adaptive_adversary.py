@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Adaptive colluding adversary vs the trimmed mean — the committed
+QUALITY.md experiment (simulation_results/adaptive_adversary.json).
+
+The ADAPTIVE role (``Roles.ADAPTIVE``, ``Config.adaptive_scale``,
+``rcmarl_tpu.faults.adaptive_payload_tree``) is the omniscient
+colluding adversary the three scripted labels never were: every epoch
+it reads the CURRENT cooperative messages and transmits
+``mean_coop + scale * (max_coop - min_coop)`` on every parameter
+coordinate — the coordinated-placement attack family against a
+clip-and-average consensus. This experiment runs the reference 5-agent
+ring with node 4 adaptive and asks the acceptance question directly:
+
+  does the trimmed mean at sufficient H keep cooperative returns in
+  the clean band where the plain (untrimmed, H=0) mean degrades?
+
+Arms (all seed 300, slow_lr 0.002, the published-run hyperparameters):
+
+  clean_h1   : 5 cooperative, H=1      — the clean band source
+  clean_h0   : 5 cooperative, H=0      — proves H=0 itself learns fine
+  trimmed_h1 : 4 coop + adaptive, H=1  — the defense arm
+  plain_h0   : 4 coop + adaptive, H=0  — the undefended arm
+  inside_h1  : 4 coop + adaptive, H=1, scale=0.3 — the just-inside-
+               the-trim-bounds placement (payload BELOW the healthy
+               max, so clipping never touches it: the pure residual-
+               influence stress test). Note any scale >= ~0.5 lands at
+               or past the healthy max and clips to the SAME bound —
+               the defense saturates, which is the point of trimming.
+
+Usage:  python scripts/adaptive_adversary.py [--episodes 2000]
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--episodes", type=int, default=2000)
+    p.add_argument("--seed", type=int, default=300)
+    p.add_argument("--scale", type=float, default=25.0)
+    p.add_argument("--window", type=int, default=500)
+    p.add_argument("--tol", type=float, default=0.05)
+    p.add_argument(
+        "--out",
+        type=str,
+        default="simulation_results/adaptive_adversary.json",
+    )
+    args = p.parse_args()
+
+    import jax
+
+    from rcmarl_tpu.config import Config, Roles
+    from rcmarl_tpu.training.trainer import train
+
+    coop = (Roles.COOPERATIVE,) * 5
+    adv = (Roles.COOPERATIVE,) * 4 + (Roles.ADAPTIVE,)
+    arms_spec = [
+        ("clean_h1", coop, 1, args.scale),
+        ("clean_h0", coop, 0, args.scale),
+        ("trimmed_h1", adv, 1, args.scale),
+        ("plain_h0", adv, 0, args.scale),
+        ("inside_h1", adv, 1, 0.3),
+    ]
+
+    arms = []
+    for label, cast, H, scale in arms_spec:
+        cfg = Config(
+            agent_roles=cast,
+            H=H,
+            adaptive_scale=scale,
+            n_episodes=args.episodes,
+            slow_lr=0.002,
+            seed=args.seed,
+        )
+        _, df = train(cfg)
+        r = df["True_team_returns"].values
+        finite = np.isfinite(r)
+        collapsed = None if finite.all() else int(np.argmin(finite))
+        tail = r[finite][-args.window :]
+        arms.append(
+            {
+                "label": label,
+                "H": H,
+                "adaptive_scale": scale,
+                "adversaries": int(sum(c == Roles.ADAPTIVE for c in cast)),
+                "final_return": round(float(np.mean(tail)), 4),
+                "collapsed_at_episode": collapsed,
+            }
+        )
+        print(arms[-1], flush=True)
+
+    clean = next(a for a in arms if a["label"] == "clean_h1")["final_return"]
+    for a in arms:
+        # one-sided: DEGRADATION is what the band polices (an arm that
+        # converges better than the control is not a defense failure)
+        a["within_clean_band"] = bool(
+            a["collapsed_at_episode"] is None
+            and a["final_return"] >= clean - args.tol * abs(clean)
+        )
+
+    out = {
+        "generated_by": "python scripts/adaptive_adversary.py",
+        "config": {
+            "scenario": "ref 5-agent ring (in_degree 4), node 4 Adaptive",
+            "episodes": args.episodes,
+            "seed": args.seed,
+            "adaptive_scale": args.scale,
+            "window": args.window,
+            "tol": args.tol,
+        },
+        "platform": jax.devices()[0].platform,
+        "clean_final": clean,
+        "arms": arms,
+    }
+    path = Path(args.out)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(out, indent=1) + "\n")
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -274,6 +274,46 @@ print("pipeline depth-0 bitwise pin OK (ragged+faulted+guarded)")
 PY
 echo "pipeline smoke cell OK"
 
+# Env-zoo smoke cell: every NEW environment of the registry trains end
+# to end through the real CLI (finite return curves, rc=0 — the
+# acceptance wire-up CLI -> Config.env -> registry -> generic rollout
+# -> trainer -> checkpoint), each checkpoint round-trips through the
+# `evaluate` CLI (an evaluate row per env), and one time-varying-graph
+# run under a faulted+sanitize transport plan proves the
+# indices-as-data path composes with the fault/sanitize stack outside
+# the pytest budget (the per-env invariant suites and the graph
+# builder's hypothesis twins stay in tier-1; the expensive train cells
+# ride the slow marker per the PR-8/PR-9 pattern).
+for zoo_env in pursuit coverage congestion; do
+    env_dir="$smoke_dir/env_$zoo_env"
+    env_log="$smoke_dir/env_$zoo_env.log"
+    timeout -k 10 240 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
+        --env "$zoo_env" \
+        --n_agents 3 --in_degree 3 --nrow 3 --ncol 3 \
+        --n_episodes 4 --n_ep_fixed 2 --max_ep_len 4 --n_epochs 2 --H 1 \
+        --summary_dir "$env_dir" --quiet | tee "$env_log"
+    grep -q "done: 4 episodes" "$env_log"
+    timeout -k 10 240 env JAX_PLATFORMS=cpu python -m rcmarl_tpu evaluate \
+        --checkpoint "$env_dir/checkpoint.npz" --episodes 4 | tee "$env_log"
+    grep -q "\"env\": \"$zoo_env\"" "$env_log"
+    grep -q "team_return_mean" "$env_log"
+    echo "env-zoo $zoo_env train+evaluate OK"
+done
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
+    --n_agents 4 --in_degree 4 --nrow 3 --ncol 3 \
+    --n_episodes 4 --n_ep_fixed 2 --max_ep_len 4 --n_epochs 2 --H 1 \
+    --graph_schedule random_geometric --graph_degree 3 --graph_every 1 \
+    --fault_drop_p 0.2 --fault_nan_p 0.2 --sanitize \
+    --summary_dir "$smoke_dir" --quiet
+echo "time-varying-graph faulted+sanitize smoke cell OK"
+# Adaptive colluding adversary: the scenario preset must train rc=0
+# with the trimmed mean (H=1) keeping the cooperative params finite.
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
+    --scenario adaptive --in_degree 4 --nrow 3 --ncol 3 \
+    --n_episodes 4 --n_ep_fixed 2 --max_ep_len 4 --n_epochs 2 --H 1 \
+    --adaptive_scale 100 --summary_dir "$smoke_dir" --quiet
+echo "adaptive-adversary smoke cell OK"
+
 # graftlint cell: the AST passes over the installed package (zero
 # findings is the contract — rcmarl_tpu.lint) plus the retrace audit
 # (tiny guarded+faulted 2-block trains on both netstack arms + a clean

@@ -115,6 +115,15 @@ run_step "7. pipeline shadow refit (sync vs pipelined, on-chip)" \
 run_step "7b. pipeline headline pair (bench.py orchestration)" \
     bash -c 'set -o pipefail; timeout 1800 python bench.py --pipeline | tee -a PERF.jsonl'
 
+# The env zoo (PR 12): the committed per-env rollout/epoch rows are CPU
+# fallbacks (headline:false). On-chip bench arms for every new env at
+# the n16/n64 shapes — rows tagged with the resolved env name +
+# cost_fingerprint, so per-env steps/s claims tie to the exact program.
+run_step "8. env-zoo on-chip bench arms (pursuit/coverage/congestion)" \
+    timeout 3600 python -m rcmarl_tpu bench \
+    --configs n16_ring n64_ring --env pursuit coverage congestion \
+    --n_ep_fixed 10 --blocks 3 --reps 3 --out PERF.jsonl
+
 echo "== session summary =="
 rc=0
 for name in "${step_order[@]}"; do

@@ -1,0 +1,491 @@
+"""Env-zoo tests: the protocol/registry, per-env invariant suites, the
+adaptive colluding adversary's payload, and the graph-as-data gather.
+
+The expensive cross-env train cells ride the slow marker (the PR-8/PR-9
+tier-1 budget pattern); the ci_tier1.sh env-zoo smoke cell trains every
+new env through the real CLI on every run.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from rcmarl_tpu.config import (
+    ENV_NAMES,
+    Config,
+    Roles,
+    circulant_in_nodes,
+    scheduled_in_nodes,
+)
+from rcmarl_tpu.envs import (
+    ENV_REGISTRY,
+    CongestionWorld,
+    CoverageWorld,
+    GridWorld,
+    PursuitWorld,
+    env_obs,
+    env_reset,
+    env_reward_scaled,
+    env_task,
+    env_transition,
+    make_env,
+)
+
+ALL_ENVS = list(ENV_NAMES)
+NEW_ENVS = [n for n in ALL_ENVS if n != "grid_world"]
+
+
+def _cfg(env_name, n_agents=5, **kw):
+    """Config helper: keeps roles/topology consistent with n_agents."""
+    base = dict(
+        env=env_name,
+        n_agents=n_agents,
+        agent_roles=(Roles.COOPERATIVE,) * n_agents,
+        in_nodes=circulant_in_nodes(n_agents, min(n_agents, 4)),
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+# ---------------------------------------------------------------------------
+# registry / protocol
+# ---------------------------------------------------------------------------
+
+
+def test_registry_keys_pinned_to_config():
+    """The registry and the jax-free ENV_NAMES tuple may never drift."""
+    assert tuple(ENV_REGISTRY) == ENV_NAMES
+
+
+def test_grid_only_knobs_rejected_on_other_envs():
+    """collision_physics/reference_clip are grid_world semantics;
+    silently ignoring them on another env would lie to the user."""
+    with pytest.raises(ValueError, match="grid_world-only"):
+        Config(env="pursuit", collision_physics=True)
+    with pytest.raises(ValueError, match="grid_world-only"):
+        Config(env="coverage", reference_clip=True)
+    Config(env="congestion")  # defaults stay legal
+
+
+def test_make_env_dispatch_types():
+    types = {
+        "grid_world": GridWorld,
+        "pursuit": PursuitWorld,
+        "coverage": CoverageWorld,
+        "congestion": CongestionWorld,
+    }
+    for name, t in types.items():
+        env = make_env(Config(env=name, nrow=4, ncol=4))
+        assert isinstance(env, t)
+        assert env.nrow == 4 and env.n_agents == 5
+
+
+def test_default_env_is_the_pinned_grid_world():
+    """Config.env='grid_world' (the default) builds EXACTLY the world
+    the trainer always built — the bitwise env pin's static half (the
+    dynamic half is the golden-trajectory suite, which runs the same
+    compiled rollout this world keys)."""
+    cfg = Config()
+    assert cfg.env == "grid_world"
+    assert make_env(cfg) == GridWorld(
+        nrow=cfg.nrow,
+        ncol=cfg.ncol,
+        n_agents=cfg.n_agents,
+        scaling=cfg.scaling,
+        collision_physics=cfg.collision_physics,
+        reference_clip=cfg.reference_clip,
+    )
+
+
+@pytest.mark.parametrize("name", ALL_ENVS)
+def test_protocol_shapes_and_dtypes(name):
+    cfg = _cfg(name, n_agents=4)
+    env = make_env(cfg)
+    pos = env_reset(env, jax.random.PRNGKey(0))
+    task = env_task(env, jax.random.PRNGKey(1))
+    assert pos.shape == (4, 2) and pos.dtype == jnp.int32
+    assert task.shape == (4, 2) and task.dtype == jnp.int32
+    a = jnp.array([0, 1, 2, 4], jnp.int32)
+    npos, ntask, r = env_transition(env, pos, task, a)
+    assert npos.shape == (4, 2) and npos.dtype == jnp.int32
+    assert ntask.shape == (4, 2) and ntask.dtype == jnp.int32
+    assert r.shape == (4,)
+    # positions stay on the grid
+    hi = np.array([env.nrow - 1, env.ncol - 1])
+    assert (np.asarray(npos) >= 0).all() and (np.asarray(npos) <= hi).all()
+    assert (np.asarray(ntask) >= 0).all() and (np.asarray(ntask) <= hi).all()
+
+
+@pytest.mark.parametrize("name", ALL_ENVS)
+def test_dynamics_deterministic(name):
+    """The step is a pure function: same (pos, task, actions) -> bitwise
+    the same (new_pos, new_task, reward), jitted or not."""
+    env = make_env(Config(env=name))
+    pos = env_reset(env, jax.random.PRNGKey(2))
+    task = env_task(env, jax.random.PRNGKey(3))
+    a = jnp.array([1, 2, 3, 4, 0], jnp.int32)
+    out1 = env_transition(env, pos, task, a)
+    out2 = env_transition(env, pos, task, a)
+    out3 = jax.jit(lambda p, t, x: env_transition(env, p, t, x))(pos, task, a)
+    for x, y, z in zip(out1, out2, out3):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(z))
+
+
+@pytest.mark.parametrize("name", ALL_ENVS)
+def test_reward_bounds(name):
+    """Rewards are finite and bounded by each env's documented range
+    (scaled rewards bounded by range/5) over random rollouts."""
+    n = 5
+    env = make_env(Config(env=name))
+    lo = -(env.nrow + env.ncol - 1) - (
+        1.0 if name == "coverage" else float(n - 1) if name == "congestion" else 0.0
+    )
+    key = jax.random.PRNGKey(0)
+    pos = env_reset(env, jax.random.fold_in(key, 1))
+    task = env_task(env, jax.random.fold_in(key, 2))
+    for t in range(12):
+        a = jax.random.randint(jax.random.fold_in(key, 10 + t), (n,), 0, 5)
+        pos, task, r = env_transition(env, pos, task, a.astype(jnp.int32))
+        r = np.asarray(r)
+        assert np.isfinite(r).all()
+        assert (r <= 0.0).all() and (r >= lo).all(), (name, t, r, lo)
+        rs = np.asarray(env_reward_scaled(env, jnp.asarray(r)))
+        np.testing.assert_allclose(rs, r / 5.0)
+
+
+@pytest.mark.parametrize("name", ALL_ENVS)
+def test_obs_standardization(name):
+    """env_obs is the shared grid standardization: per-axis
+    (pos - mean(arange)) / std(arange); scaling=False is a plain cast."""
+    cfg = Config(env=name, nrow=4, ncol=6)
+    env = make_env(cfg)
+    pos = env_reset(env, jax.random.PRNGKey(5))
+    obs = np.asarray(env_obs(env, pos))
+    x, y = np.arange(4), np.arange(6)
+    mean = np.array([x.mean(), y.mean()], np.float32)
+    std = np.array([x.std(), y.std()], np.float32)
+    np.testing.assert_allclose(
+        obs, (np.asarray(pos).astype(np.float32) - mean) / std, rtol=1e-6
+    )
+    env_raw = make_env(cfg.replace(scaling=False))
+    np.testing.assert_array_equal(
+        np.asarray(env_obs(env_raw, pos)),
+        np.asarray(pos).astype(np.float32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# per-env dynamics
+# ---------------------------------------------------------------------------
+
+
+def test_pursuit_task_rows_identical_and_evader_moves_one_step():
+    env = make_env(Config(env="pursuit"))
+    task = env_task(env, jax.random.PRNGKey(7))
+    t = np.asarray(task)
+    assert (t == t[0]).all()
+    pos = env_reset(env, jax.random.PRNGKey(8))
+    _, ntask, _ = env_transition(
+        env, pos, task, jnp.zeros((5,), jnp.int32)
+    )
+    nt = np.asarray(ntask)
+    assert (nt == nt[0]).all()  # still one broadcast evader
+    assert np.abs(nt[0] - t[0]).sum() <= 1  # at most one L1 step
+
+
+def test_pursuit_capture_pins_evader_and_zeroes_reward():
+    env = make_env(_cfg("pursuit", n_agents=3, nrow=3, ncol=3))
+    # agent 0 stands ON the evader and stays; everyone stays
+    pos = jnp.array([[1, 1], [0, 0], [2, 2]], jnp.int32)
+    task = jnp.broadcast_to(jnp.array([1, 1], jnp.int32), (3, 2))
+    npos, ntask, r = env_transition(
+        env, pos, task, jnp.zeros((3,), jnp.int32)
+    )
+    np.testing.assert_array_equal(np.asarray(ntask), np.asarray(task))
+    np.testing.assert_array_equal(np.asarray(r), np.zeros(3))
+
+
+def test_pursuit_evader_flees_to_max_min_distance():
+    env = make_env(_cfg("pursuit", n_agents=2, nrow=5, ncol=5))
+    # both pursuers at the left edge; evader at center must flee right
+    pos = jnp.array([[0, 2], [0, 1]], jnp.int32)
+    task = jnp.broadcast_to(jnp.array([2, 2], jnp.int32), (2, 2))
+    _, ntask, _ = env_transition(env, pos, task, jnp.zeros((2,), jnp.int32))
+    assert np.asarray(ntask)[0, 0] == 3  # moved away along the row axis
+
+
+def test_coverage_static_task_and_collision_penalty():
+    env = make_env(_cfg("coverage", n_agents=2, nrow=3, ncol=3))
+    task = jnp.array([[0, 0], [2, 2]], jnp.int32)
+    # both agents on the SAME cell: each covers landmark 0 at distance
+    # d, and both pay the collide penalty
+    pos = jnp.array([[0, 0], [0, 0]], jnp.int32)
+    npos, ntask, r = env_transition(
+        env, pos, task, jnp.zeros((2,), jnp.int32)
+    )
+    np.testing.assert_array_equal(np.asarray(ntask), np.asarray(task))
+    np.testing.assert_allclose(np.asarray(r), [0.0 - 1.0, -4.0 - 1.0])
+    # spread out: no penalty, both landmarks covered exactly
+    pos = jnp.array([[0, 0], [2, 2]], jnp.int32)
+    _, _, r = env_transition(env, pos, task, jnp.zeros((2,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(r), [0.0, 0.0])
+
+
+def test_congestion_shaping_and_load_toll():
+    env = make_env(_cfg("congestion", n_agents=3, nrow=3, ncol=3))
+    task = jnp.array([[0, 0], [2, 2], [1, 1]], jnp.int32)
+    # agent 0 at its goal staying and ALONE: reward 0 (the grid-world
+    # shaping rule, bitwise)
+    pos = jnp.array([[0, 0], [2, 0], [0, 2]], jnp.int32)
+    _, ntask, r = env_transition(env, pos, task, jnp.zeros((3,), jnp.int32))
+    np.testing.assert_array_equal(np.asarray(ntask), np.asarray(task))
+    assert np.asarray(r)[0] == 0.0
+    # all three stacked on one cell: everyone pays 2 others' load
+    pos = jnp.array([[1, 1], [1, 1], [1, 1]], jnp.int32)
+    _, _, r = env_transition(env, pos, task, jnp.zeros((3,), jnp.int32))
+    shaping = np.array([-3.0, -3.0, 0.0])  # agent 2 at-goal-stay
+    np.testing.assert_allclose(np.asarray(r), shaping - 2.0 * 1.0)
+
+
+# ---------------------------------------------------------------------------
+# adaptive colluding adversary
+# ---------------------------------------------------------------------------
+
+
+def test_adaptive_payload_formula_and_untouched_rows():
+    from rcmarl_tpu.faults import adaptive_payload_tree
+
+    leaf = jnp.array(
+        [[1.0, 2.0], [3.0, 6.0], [2.0, 4.0], [99.0, -99.0]], jnp.float32
+    )
+    coop = jnp.array([True, True, True, False])
+    adaptive = jnp.array([False, False, False, True])
+    out = np.asarray(
+        adaptive_payload_tree((leaf,), coop, adaptive, 2.0)[0]
+    )
+    # cooperative rows bitwise untouched
+    np.testing.assert_array_equal(out[:3], np.asarray(leaf)[:3])
+    # payload = mean_coop + scale * (max_coop - min_coop), per coordinate
+    np.testing.assert_allclose(out[3], [2.0 + 2.0 * 2.0, 4.0 + 2.0 * 4.0])
+
+
+def test_adaptive_colluders_send_identical_payloads():
+    from rcmarl_tpu.faults import adaptive_payload_tree
+
+    key = jax.random.PRNGKey(0)
+    leaf = jax.random.normal(key, (6, 3, 2))
+    coop = jnp.array([True, True, True, True, False, False])
+    adaptive = ~coop
+    out = np.asarray(adaptive_payload_tree(leaf, coop, adaptive, 0.5))
+    np.testing.assert_array_equal(out[4], out[5])
+    np.testing.assert_array_equal(out[:4], np.asarray(leaf)[:4])
+
+
+def test_adaptive_role_rejected_by_fused_matrix_spec():
+    from rcmarl_tpu.training.update import spec_from_config
+
+    cfg = Config(
+        n_agents=4,
+        in_nodes=circulant_in_nodes(4, 4),
+        agent_roles=(Roles.COOPERATIVE,) * 3 + (Roles.ADAPTIVE,),
+        H=1,
+    )
+    with pytest.raises(ValueError, match="ADAPTIVE"):
+        spec_from_config(cfg)
+
+
+# ---------------------------------------------------------------------------
+# graph-as-data gather
+# ---------------------------------------------------------------------------
+
+
+def test_gather_with_data_indices_matches_static_gather():
+    """Feeding the STATIC topology's indices in as data must reproduce
+    the compiled static gather bitwise (rolls vs advanced indexing are
+    value-equal; this is what makes the time-varying schedule a pure
+    superset of the static path)."""
+    from rcmarl_tpu.training.update import gather_neighbor_messages
+
+    cfg = Config(n_agents=5, in_nodes=circulant_in_nodes(5, 4), H=1)
+    tree = {
+        "w": jax.random.normal(jax.random.PRNGKey(0), (5, 3, 2)),
+        "b": jax.random.normal(jax.random.PRNGKey(1), (5, 4)),
+    }
+    static = gather_neighbor_messages(cfg, tree)
+    in_arr = jnp.asarray(np.array(cfg.in_nodes), jnp.int32)
+    dynamic = gather_neighbor_messages(cfg, tree, in_arr)
+    for a, b in zip(jax.tree.leaves(static), jax.tree.leaves(dynamic)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_scheduled_in_nodes_cadence_and_determinism():
+    cfg = Config(
+        graph_schedule="random_geometric", graph_degree=3, graph_seed=4, H=1
+    )
+    g0 = scheduled_in_nodes(cfg, 0)
+    assert g0.shape == (5, 3) and g0.dtype == np.int32
+    np.testing.assert_array_equal(g0, scheduled_in_nodes(cfg, 0))
+    # graph_every groups consecutive blocks onto one graph
+    cfg2 = cfg.replace(graph_every=3)
+    np.testing.assert_array_equal(
+        scheduled_in_nodes(cfg2, 0), scheduled_in_nodes(cfg2, 2)
+    )
+    assert not np.array_equal(
+        scheduled_in_nodes(cfg2, 2), scheduled_in_nodes(cfg2, 3)
+    )
+    # self-first rows
+    np.testing.assert_array_equal(g0[:, 0], np.arange(5))
+
+
+def test_parallel_trainers_reject_dynamic_graphs():
+    from rcmarl_tpu.parallel.seeds import train_parallel
+    from rcmarl_tpu.training.trainer import (
+        init_train_state,
+        train_scanned,
+    )
+
+    cfg = _cfg(
+        "grid_world",
+        n_agents=3,
+        nrow=3,
+        ncol=3,
+        n_episodes=2,
+        n_ep_fixed=2,
+        max_ep_len=2,
+        n_epochs=1,
+        graph_schedule="random_geometric",
+        graph_degree=3,
+        H=1,
+    )
+    with pytest.raises(ValueError, match="graph_schedule"):
+        train_parallel(cfg, seeds=[0], n_blocks=1)
+    state = init_train_state(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="graph_schedule"):
+        train_scanned(cfg, state, 1)
+    with pytest.raises(ValueError, match="solo-trainer"):
+        cfg.replace(pipeline_depth=2)
+
+
+# ---------------------------------------------------------------------------
+# slow integration cells (the CI env-zoo smoke cell covers the CLI wire-up
+# every run; these are the in-suite twins)
+# ---------------------------------------------------------------------------
+
+
+def _tiny(env_name, **kw):
+    base = dict(
+        n_agents=3,
+        agent_roles=(Roles.COOPERATIVE,) * 3,
+        in_nodes=circulant_in_nodes(3, 3),
+        nrow=3,
+        ncol=3,
+        n_episodes=4,
+        n_ep_fixed=2,
+        max_ep_len=4,
+        n_epochs=2,
+        H=1,
+        env=env_name,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", NEW_ENVS)
+def test_new_envs_train_end_to_end(name):
+    from rcmarl_tpu.training.trainer import train
+
+    state, df = train(_tiny(name))
+    assert np.isfinite(df["True_team_returns"].values).all()
+    for l in jax.tree.leaves(state.params):
+        assert np.isfinite(np.asarray(l)).all()
+
+
+@pytest.mark.slow
+def test_dynamic_graph_train_finite_and_resume_deterministic():
+    """A time-varying-graph run is finite, and resuming from a
+    checkpointed state replays the SAME graph sequence (blocks are keyed
+    on the global block number): 2+2 resumed blocks == 4 straight."""
+    from rcmarl_tpu.training.trainer import train
+
+    cfg = _tiny(
+        "grid_world", graph_schedule="random_geometric", graph_degree=3,
+        n_episodes=8,
+    )
+    s_full, df_full = train(cfg)
+    s_half, _ = train(cfg, n_episodes=4)
+    s_res, df_res = train(cfg, n_episodes=4, state=s_half)
+    for a, b in zip(jax.tree.leaves(s_full.params), jax.tree.leaves(s_res.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(
+        df_full["True_team_returns"].values[4:],
+        df_res["True_team_returns"].values,
+    )
+
+
+@pytest.mark.slow
+def test_adaptive_netstack_dual_arms_bitwise():
+    """The adaptive payload is applied per tree identically on both
+    epoch arms — the netstack-vs-dual leaf-for-leaf pin extended to the
+    new role."""
+    from rcmarl_tpu.training.trainer import train
+
+    cfg = _tiny(
+        "grid_world",
+        n_agents=4,
+        agent_roles=(Roles.COOPERATIVE,) * 3 + (Roles.ADAPTIVE,),
+        in_nodes=circulant_in_nodes(4, 4),
+        adaptive_scale=2.0,
+    )
+    s_dual, _ = train(cfg.replace(netstack=False))
+    s_stack, _ = train(cfg.replace(netstack=True))
+    for a, b in zip(
+        jax.tree.leaves(s_dual.params), jax.tree.leaves(s_stack.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_adaptive_trim_bounds_containment():
+    """One update block under a huge adaptive payload: H=1 trimming
+    keeps the cooperative parameters finite and within a sane envelope,
+    while H=0 (no trimming) lets the colluding payload through — the
+    unit-scale twin of the committed QUALITY.md experiment."""
+    from rcmarl_tpu.training.buffer import update_batch
+    from rcmarl_tpu.training.rollout import rollout_block
+    from rcmarl_tpu.training.trainer import init_train_state, make_env
+    from rcmarl_tpu.training.update import update_block
+
+    def run(H, scale):
+        cfg = _tiny(
+            "grid_world",
+            n_agents=5,
+            agent_roles=(Roles.COOPERATIVE,) * 4 + (Roles.ADAPTIVE,),
+            in_nodes=circulant_in_nodes(5, 4),
+            H=H,
+            adaptive_scale=scale,
+        )
+        state = init_train_state(cfg, jax.random.PRNGKey(0))
+        key, k_roll, k_upd = jax.random.split(state.key, 3)
+        fresh, _ = rollout_block(
+            cfg, make_env(cfg), state.params, state.desired, k_roll,
+            state.initial,
+        )
+        batch = update_batch(state.buffer, fresh)
+        params = update_block(cfg, state.params, batch, fresh, k_upd)
+        coop_norm = max(
+            float(np.abs(np.asarray(l)[:4]).max())
+            for l in jax.tree.leaves((params.critic, params.tr))
+        )
+        return coop_norm
+
+    poisoned_h0 = run(0, 1e6)
+    contained_h1 = run(1, 1e6)
+    # the H=0 clip bounds are the gathered min/max, which the adversary
+    # itself sets: the payload lands in the cooperative nets (and the
+    # next epoch's fits on the poisoned values overflow to non-finite)
+    assert not np.isfinite(poisoned_h0) or poisoned_h0 > 1e3
+    # H=1 trims the single colluding payload back to the healthy range
+    assert np.isfinite(contained_h1) and contained_h1 < 1e2

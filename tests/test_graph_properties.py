@@ -1,0 +1,95 @@
+"""Hypothesis twins for the time-varying communication-graph builder.
+
+``random_geometric_in_nodes`` feeds BOTH levels of the resilience
+stack — the static replica gossip graph and the per-block agent-level
+schedule (``scheduled_in_nodes``) — so its invariants are the safety
+preconditions of the trimmed mean everywhere: self-first rows (slot 0
+is the only positional slot the aggregation treats specially), exact
+regular degree (every neighborhood keeps ``n_in >= 2H+1`` whenever the
+degree does), valid distinct indices, and bit-level determinism in the
+seed (resumed runs must replay their exact graph sequence).
+
+Pure numpy — no jax import, so these cost the tier-1 budget nothing.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from rcmarl_tpu.config import (  # noqa: E402
+    Config,
+    random_geometric_in_nodes,
+    scheduled_in_nodes,
+)
+
+
+@st.composite
+def graph_case(draw):
+    n = draw(st.integers(min_value=1, max_value=24))
+    degree = draw(st.integers(min_value=1, max_value=n))
+    seed = draw(st.integers(min_value=0, max_value=2**31 - 1))
+    return n, degree, seed
+
+
+@given(graph_case())
+@settings(max_examples=60, deadline=None)
+def test_rows_are_self_first_regular_and_valid(case):
+    n, degree, seed = case
+    g = random_geometric_in_nodes(n, degree, seed)
+    assert len(g) == n
+    for i, row in enumerate(g):
+        assert len(row) == degree  # regular: no padding/masking needed
+        assert row[0] == i  # self first (the aggregation's own slot)
+        assert len(set(row)) == degree  # distinct neighbors
+        assert all(0 <= j < n for j in row)
+
+
+@given(graph_case())
+@settings(max_examples=40, deadline=None)
+def test_deterministic_in_seed(case):
+    n, degree, seed = case
+    assert random_geometric_in_nodes(n, degree, seed) == (
+        random_geometric_in_nodes(n, degree, seed)
+    )
+    # tuple seeds (the per-round namespace) are deterministic too
+    assert random_geometric_in_nodes(n, degree, (seed, 3)) == (
+        random_geometric_in_nodes(n, degree, (seed, 3))
+    )
+
+
+@given(
+    st.integers(min_value=0, max_value=3),  # H
+    st.integers(min_value=0, max_value=2**20),  # graph_seed
+    st.integers(min_value=0, max_value=50),  # block
+    st.integers(min_value=1, max_value=5),  # graph_every
+)
+@settings(max_examples=40, deadline=None)
+def test_every_neighborhood_keeps_trim_precondition(H, seed, block, every):
+    """For any legal (H, degree) config, EVERY resampled neighborhood
+    satisfies n_in >= 2H+1 — the trimmed mean's safety precondition —
+    and the self-first layout the consensus kernel keys on survives
+    resampling at every block."""
+    n = 8
+    degree = 2 * H + 1  # the tightest legal degree
+    cfg = Config(
+        n_agents=n,
+        in_nodes=tuple(
+            tuple((i + k) % n for k in range(max(degree, 1)))
+            for i in range(n)
+        ),
+        H=H,
+        graph_schedule="random_geometric",
+        graph_degree=degree,
+        graph_seed=seed,
+        graph_every=every,
+    )
+    g = scheduled_in_nodes(cfg, block)
+    assert g.shape == (n, degree)
+    assert (g[:, 0] == np.arange(n)).all()  # self-first preserved
+    for row in g:
+        assert len(set(row.tolist())) >= 2 * H + 1
+    # cadence: blocks in the same round share the graph bit-for-bit
+    same = scheduled_in_nodes(cfg, (block // every) * every)
+    np.testing.assert_array_equal(g, same)
