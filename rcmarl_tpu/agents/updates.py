@@ -314,12 +314,35 @@ def adv_fit_schedule(cfg: Config) -> FitSchedule:
     )
 
 
+def fitstack_impl(cfg: Config) -> str:
+    """The fitstack scan's execution backend: ``'pallas'`` /
+    ``'pallas_interpret'`` when :attr:`Config.fitstack` names the
+    fit-scan kernel (:mod:`rcmarl_tpu.ops.pallas_fit` — parameters
+    VMEM-resident across the whole schedule), ``'xla'`` for every other
+    truthy fitstack value (the lax.scan arm)."""
+    from rcmarl_tpu.config import FITSTACK_IMPLS
+
+    return cfg.fitstack if cfg.fitstack in FITSTACK_IMPLS else "xla"
+
+
 def fused_fit_rows(keys_rows, params_rows, x_rows, targets_rows, mask,
                    schedule: FitSchedule, cfg: Config):
     """One fused (row, agent)-vmapped fit launch over stacked
     (flavor·net) rows — the fitstack twin of :func:`coop_pair_fit` /
     :func:`adv_pair_fit`, sharing their forward and learning rate.
+    Under ``Config.fitstack in FITSTACK_IMPLS`` the launch is the
+    fit-scan Pallas kernel instead of the XLA scan (fitted rows pinned
+    leaf-for-leaf — tests/test_fused_epoch.py).
     Returns (fitted rows, (R, N) losses)."""
+    impl = fitstack_impl(cfg)
+    if impl != "xla":
+        from rcmarl_tpu.ops.pallas_fit import pallas_fit_scan
+
+        return pallas_fit_scan(
+            keys_rows, params_rows, _fwd(cfg), x_rows, targets_rows,
+            mask, schedule, cfg.fast_lr,
+            interpret=impl == "pallas_interpret",
+        )
     return fused_fit_scan(
         keys_rows, params_rows, _fwd(cfg), x_rows, targets_rows, mask,
         schedule, cfg.fast_lr,
@@ -522,6 +545,7 @@ def consensus_update_pair(
     cfg: Config,
     valid: jnp.ndarray | None = None,
     H=None,
+    impl: str | None = None,
 ) -> Tuple[MLPParams, MLPParams]:
     """Full Phase-II update for ONE agent's critic AND TR nets from one
     COMBINED raveled neighbor block (the netstack mode twin of two
@@ -537,21 +561,25 @@ def consensus_update_pair(
         :func:`~rcmarl_tpu.ops.aggregation.ravel_neighbor_tree`).
       x2: (2, B, sa_dim) stacked flattened net inputs (net 0 = padded
         critic input, net 1 = TR input) — :func:`netstack_pair_inputs`.
+      impl: aggregation backend override (default: the config's). The
+        fused-epoch fallback paths pass ``'xla'`` here so the stacked
+        XLA arm stays the bitwise reference whatever the config names.
 
     Steps b-d of the reference's Phase II, each launched ONCE for both
     trees: (b) one trim/clip/mean over the combined trunk columns, (c)
     one stacked trunk forward + one projection einsum over both head
-    families, (d) one (net,)-vmapped normalized team head step. Bitwise
-    column-equal to the two per-tree aggregations (aggregation is
-    elementwise along the trailing axis).
+    families, (d) one (net,)-vmapped normalized team head step — (c)
+    and (d) shared with the one-kernel epoch as
+    :func:`consensus_pair_tail`. Bitwise column-equal to the two
+    per-tree aggregations (aggregation is elementwise along the
+    trailing axis).
     """
     H = cfg.H if H is None else H
-    impl = cfg.consensus_impl
+    impl = cfg.consensus_impl if impl is None else impl
     sanitize = cfg.consensus_sanitize
     trunk_c, trunk_t = own_c[:-1], own_t[:-1]
     P_c = sum(l.size for l in jax.tree.leaves(trunk_c))
     P_t = sum(l.size for l in jax.tree.leaves(trunk_t))
-    n_in = blk.shape[0]
     # b) hidden consensus: ONE clip-mean over the combined trunk columns
     if P_c + P_t:
         agg = resilient_aggregate(
@@ -562,9 +590,61 @@ def consensus_update_pair(
             n_agents=cfg.n_agents,
             sanitize=sanitize,
         )
-        new_trunk_c = _unravel_cols(agg[:P_c], trunk_c)
-        new_trunk_t = _unravel_cols(agg[P_c:], trunk_t)
     else:  # head-only (hidden=()) nets: nothing to aggregate
+        agg = None
+    return consensus_pair_tail(
+        own_c,
+        own_t,
+        agg,
+        blk[:, P_c + P_t :],
+        x2,
+        mask,
+        cfg,
+        valid=valid,
+        H=H,
+        impl=impl,
+    )
+
+
+def consensus_pair_tail(
+    own_c: MLPParams,
+    own_t: MLPParams,
+    agg_trunk: jnp.ndarray | None,
+    head_blk: jnp.ndarray,
+    x2: jnp.ndarray,
+    mask: jnp.ndarray,
+    cfg: Config,
+    valid: jnp.ndarray | None = None,
+    H=None,
+    impl: str | None = None,
+) -> Tuple[MLPParams, MLPParams]:
+    """Steps c-d of the pair Phase II — the part of the epoch that
+    STAYS XLA under the one-kernel arm (``consensus_impl=
+    'pallas_fused'``): the per-net trunk forward, the projection einsum
+    over both head families, ONE aggregation of the stacked per-sample
+    estimates, and the normalized team head step.
+
+    Args:
+      agg_trunk: (P_critic + P_tr,) post-consensus trunk columns (the
+        XLA aggregation's output, or the fused kernel's emitted tile);
+        None for head-only (hidden=()) nets.
+      head_blk: (n_in, 2(h+1)) gathered (and transport-faulted) head
+        columns — ``[W_c | b_c | W_t | b_t]``, own row at index 0.
+        Slicing them from a separately gathered head block is bitwise
+        slicing them from the full pair block (gather commutes with the
+        column slice), which is how the two arms share this tail.
+    """
+    H = cfg.H if H is None else H
+    impl = cfg.consensus_impl if impl is None else impl
+    sanitize = cfg.consensus_sanitize
+    trunk_c, trunk_t = own_c[:-1], own_t[:-1]
+    P_c = sum(l.size for l in jax.tree.leaves(trunk_c))
+    P_t = sum(l.size for l in jax.tree.leaves(trunk_t))
+    n_in = head_blk.shape[0]
+    if agg_trunk is not None and P_c + P_t:
+        new_trunk_c = _unravel_cols(agg_trunk[:P_c], trunk_c)
+        new_trunk_t = _unravel_cols(agg_trunk[P_c:], trunk_t)
+    else:  # head-only (hidden=()) nets: nothing was aggregated
         new_trunk_c, new_trunk_t = trunk_c, trunk_t
     # c) projection: per-net trunk features (each at its own unpadded
     # first-layer width — bitwise the dual arm's phi, no padding FLOPs),
@@ -581,12 +661,12 @@ def consensus_update_pair(
         ])  # (2, B, h)
     else:  # head-only nets: the flattened inputs ARE the features
         phi2 = jnp.stack([pad_features(x_c, h_max), x2[1]])
-    off = P_c + P_t
-    W_c_nbr = blk[:, off : off + h_c].reshape(n_in, h_c, 1)
-    b_c_nbr = blk[:, off + h_c : off + h_c + 1]
+    off = 0
+    W_c_nbr = head_blk[:, off : off + h_c].reshape(n_in, h_c, 1)
+    b_c_nbr = head_blk[:, off + h_c : off + h_c + 1]
     off += h_c + 1
-    W_t_nbr = blk[:, off : off + h_t].reshape(n_in, h_t, 1)
-    b_t_nbr = blk[:, off + h_t : off + h_t + 1]
+    W_t_nbr = head_blk[:, off : off + h_t].reshape(n_in, h_t, 1)
+    b_t_nbr = head_blk[:, off + h_t : off + h_t + 1]
     W2_nbr = jnp.stack(
         [pad_rows(W_c_nbr, h_max), pad_rows(W_t_nbr, h_max)]
     )  # (2, n_in, h_max, 1)

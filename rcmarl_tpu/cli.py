@@ -168,13 +168,16 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         "--fitstack",
         type=str,
         default="auto",
-        choices=["auto", "on", "off"],
+        choices=["auto", "on", "off", "pallas", "pallas_interpret"],
         help="cross-flavor fused fit scan: on = every phase-I fit flavor "
         "sharing a schedule shape (coop full-batch pair vs the "
         "greedy/malicious minibatch flavors) runs as ONE stacked "
         "(flavor·net, agent) scan; off = the PR-4 per-flavor arms; auto "
         "(default) = the measured backend policy — fused on TPU, "
-        "per-flavor elsewhere (PERF.md 'fitstack / bf16'). Outputs are "
+        "per-flavor elsewhere (PERF.md 'fitstack / bf16'); pallas / "
+        "pallas_interpret = the fused rows through the fit-scan Pallas "
+        "kernel (ops/pallas_fit.py: params VMEM-resident across the "
+        "whole schedule; interpret = CPU test arm). Outputs are "
         "pinned bitwise either way",
     )
     p.add_argument(
@@ -430,7 +433,11 @@ def replica_fault_plan_from_args(args):
 
 def _netstack_value(arm: str):
     """CLI arm string -> Config.netstack / Config.fitstack value (the
-    two gates share the on/off/'auto' vocabulary)."""
+    two gates share the on/off/'auto' vocabulary; fitstack additionally
+    accepts the fit-scan kernel arms 'pallas'/'pallas_interpret', which
+    pass through verbatim — only the fitstack flags list them)."""
+    if arm in ("pallas", "pallas_interpret"):
+        return arm
     return {"on": True, "off": False}.get(arm, "auto")
 
 
@@ -1001,10 +1008,11 @@ def cmd_sweep(argv) -> int:
         "--fitstack",
         type=str,
         default="auto",
-        choices=["auto", "on", "off"],
+        choices=["auto", "on", "off", "pallas", "pallas_interpret"],
         help="cross-flavor fused fit scan (on: every same-scheduled "
         "phase-I flavor in one stacked scan; off: the PR-4 per-flavor "
-        "arms; auto, the default: fused on TPU, per-flavor elsewhere)",
+        "arms; auto, the default: fused on TPU, per-flavor elsewhere; "
+        "pallas/pallas_interpret: the fit-scan Pallas kernel arms)",
     )
     p.add_argument(
         "--compute_dtype",
@@ -1261,12 +1269,15 @@ def _netstack_arm_flag(p: argparse.ArgumentParser) -> None:
         "--fitstack",
         nargs="+",
         default=["auto"],
-        choices=["auto", "on", "off"],
+        choices=["auto", "on", "off", "pallas", "pallas_interpret"],
         help="cross-flavor fused fit scan arm(s) to compare: on = every "
         "same-scheduled phase-I flavor in ONE stacked (flavor·net, "
         "agent) scan, off = the PR-4 per-flavor arms, auto (default) = "
         "the measured backend policy (fused on TPU, per-flavor "
-        "elsewhere); pass 'on off' for the A/B",
+        "elsewhere), pallas/pallas_interpret = the fit-scan Pallas "
+        "kernel arms (params VMEM-resident across the schedule; "
+        "interpret rows are honest headline:false on a CPU host); pass "
+        "'on off' for the A/B",
     )
 
 
@@ -1567,12 +1578,25 @@ def cmd_bench(argv) -> int:
             n_failed += 1
             continue
         steps = args.blocks * cfg.block_steps
+        resolved = resolve_impl(
+            impl, cfg.n_in, n_agents=cfg.n_agents, H=cfg.H
+        )
+        # headline discipline (BENCH_* convention): only an on-chip row
+        # with REAL kernel lowerings is a hardware claim — interpreter
+        # arms (consensus or fit-scan) are honest headline:false rows
+        # wherever they run
+        interp_arm = resolved.endswith("interpret") or (
+            cfg.fitstack == "pallas_interpret"
+        )
         row = json.dumps(
             {
                 "config": name,
                 "env": cfg.env,
                 "impl": impl,
-                "impl_resolved": resolve_impl(impl, cfg.n_in, n_agents=cfg.n_agents, H=cfg.H),
+                "impl_resolved": resolved,
+                "headline": (
+                    jax.devices()[0].platform == "tpu" and not interp_arm
+                ),
                 "layout": cfg.consensus_layout,
                 "netstack": netstack_enabled(cfg),
                 "fitstack": fitstack_enabled(cfg),

@@ -27,14 +27,39 @@ from rcmarl_tpu.faults import FaultPlan, ReplicaFaultPlan
 #: measured-comparison arm, 'pallas_interpret' runs the selection kernel
 #: in the Pallas interpreter (CPU tests), and 'auto' is the 3-way
 #: measured-crossover policy keyed on (H, n_in, volume).
+#: 'pallas_fused' / 'pallas_fused_interpret' are the ONE-KERNEL EPOCH
+#: arms (ops/pallas_consensus.py): phase-II gather -> link-fault
+#: injection -> trim/clip/mean runs as a single VMEM-resident Pallas
+#: program over the combined (n_in, P_critic + P_tr) pair block (the
+#: stacked layout is therefore forced), with the projection einsum and
+#: team head step staying XLA; at the leaf-aggregation level the fused
+#: names alias the plain kernel ('pallas'/'pallas_interpret') — the
+#: extra fusion is an epoch-level property. 'auto' never resolves to
+#: the fused arms until the queued TPU session measures them
+#: (scripts/tpu_session.sh).
 CONSENSUS_IMPLS = (
     "xla",
     "xla_sort",
     "pallas",
     "pallas_sort",
     "pallas_interpret",
+    "pallas_fused",
+    "pallas_fused_interpret",
     "auto",
 )
+
+#: The one-kernel-epoch members of CONSENSUS_IMPLS (the fused phase-II
+#: arms training/update.py routes onto the stacked pair layout).
+FUSED_CONSENSUS_IMPLS = ("pallas_fused", "pallas_fused_interpret")
+
+#: Valid Config.fitstack values beyond the bool/'auto' policy shared
+#: with netstack: the fit-scan Pallas kernel arms (ops/pallas_fit.py)
+#: — phase-I parameters VMEM-resident across the whole minibatch
+#: schedule instead of round-tripping HBM per scan step. 'pallas' is
+#: the real lowering (queued for the TPU session),
+#: 'pallas_interpret' the CPU test arm; both imply the fused
+#: cross-flavor row stacking (fitstack on).
+FITSTACK_IMPLS = ("pallas", "pallas_interpret")
 
 
 #: Valid environment names — the keys of the env-zoo registry
@@ -276,10 +301,21 @@ class Config:
     # TPU. 'pallas_sort': the kernel's sorting-network arm.
     # 'pallas_interpret': selection kernel in interpreter mode (CPU
     # tests only).
+    # 'pallas_fused' / 'pallas_fused_interpret': the ONE-KERNEL EPOCH
+    # (ops/pallas_consensus.py) — phase-II gather + link-fault
+    # injection + trim/clip/mean as a single VMEM-resident Pallas
+    # program over the combined (n_in, P_critic + P_tr) pair block
+    # (forces the stacked netstack layout; the projection einsum +
+    # team head step stay XLA). Bitwise vs the XLA arm across the
+    # sanitize matrix; corrupt_p > 0 plans and time-varying graphs
+    # route back to the XLA reference arm (the former documented in
+    # ops/pallas_consensus.py, the latter rejected here). Gated on the
+    # AUDIT.jsonl bytes_accessed ledger (lint --cost).
     # 'auto': 3-way measured-crossover choice keyed on (H, n_in,
     # volume) — pallas on TPU from volume >= 256 up, xla vs xla_sort by
     # the CPU-measured selection crossover elsewhere (currently: xla
-    # everywhere — SELECT_MAX_N_IN is None)
+    # everywhere — SELECT_MAX_N_IN is None); never the fused arms until
+    # the queued TPU session measures them
     # (ops/aggregation.py:resolve_impl, BENCH_SCALING.md, PERF.md).
     consensus_impl: str = "xla"
     # --- consensus message-tree layout ---
@@ -334,7 +370,12 @@ class Config:
     # rows to sa_dim costs FLOPs a single core cannot hide — PERF.md
     # "fitstack / bf16"). Orthogonal to `netstack`: fitstack owns
     # phase I, netstack then only governs the phase-II consensus
-    # layout.
+    # layout. 'pallas' / 'pallas_interpret' (FITSTACK_IMPLS): the
+    # fit-scan Pallas kernel (ops/pallas_fit.py) — the fused rows'
+    # parameters live VMEM-resident across the whole epochs x batches
+    # schedule instead of round-tripping HBM as the XLA scan's carry
+    # every step; fitted rows pinned leaf-for-leaf vs the XLA scan
+    # (interpret on CPU, real lowering queued for the TPU session).
     fitstack: "bool | str" = "auto"
     # --- transport faults / graceful degradation ---
     # fault_plan: per-link transport-fault injection on the consensus
@@ -484,11 +525,34 @@ class Config:
                 f"netstack={self.netstack!r}: expected True, False, or "
                 "'auto' (the measured backend policy)"
             )
-        if not (isinstance(self.fitstack, bool) or self.fitstack == "auto"):
+        if not (
+            isinstance(self.fitstack, bool)
+            or self.fitstack == "auto"
+            or self.fitstack in FITSTACK_IMPLS
+        ):
             raise ValueError(
-                f"fitstack={self.fitstack!r}: expected True, False, or "
-                "'auto' (the measured backend policy)"
+                f"fitstack={self.fitstack!r}: expected True, False, "
+                f"'auto' (the measured backend policy), or one of "
+                f"{FITSTACK_IMPLS} (the fit-scan Pallas kernel arms)"
             )
+        if self.consensus_impl in FUSED_CONSENSUS_IMPLS:
+            # the one-kernel epoch consumes the stacked pair layout and
+            # unrolls a STATIC gather in-kernel; contradictory knobs are
+            # rejected loudly rather than silently overridden
+            if self.netstack is False:
+                raise ValueError(
+                    f"consensus_impl={self.consensus_impl!r} runs phase II "
+                    "on the combined (n_in, P_critic + P_tr) pair block; "
+                    "netstack=False contradicts it (use True or 'auto' — "
+                    "the fused epoch forces the stacked layout)"
+                )
+            if self.graph_schedule != "static":
+                raise ValueError(
+                    f"consensus_impl={self.consensus_impl!r} unrolls the "
+                    "static in_nodes gather inside the kernel; time-varying "
+                    f"graph_schedule={self.graph_schedule!r} is XLA-only "
+                    "(gather indices are traced data there)"
+                )
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"compute_dtype={self.compute_dtype!r}: expected "

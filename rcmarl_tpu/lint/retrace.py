@@ -208,14 +208,21 @@ def _audit_fitstack_dtypes(
 
 
 def audit_retrace(
-    steady_blocks: int = 2, fitstack_dtypes: bool = True
+    steady_blocks: int = 2,
+    fitstack_dtypes: bool = True,
+    fused_epoch: bool = True,
 ) -> List[Finding]:
     """``lint --retrace``: prove exactly-once compilation on tiny runs.
 
     The cases cover the production paths: a guarded+faulted run on the
     dual arm and on the stacked arms (netstack phase II fed by the
     fused fitstack phase I, mixed cast — the undonated retry-capable
-    entries, diag on), a time-varying-graph run (per-block resampled
+    entries, diag on), the ONE-KERNEL epoch arm
+    (``consensus_impl='pallas_fused_interpret'`` +
+    ``fitstack='pallas_interpret'``, guarded+faulted — the fused epoch
+    compiles exactly once, zero steady-state recompiles; gate with
+    ``fused_epoch=False`` to shed it to the slow twin / CI cell), a
+    time-varying-graph run (per-block resampled
     random-geometric gather indices fed in as data — a resample may
     never be a compile), a clean run (the donated steady-state entries),
     the alternating f32/bf16 fused-fit case (exactly one compile per
@@ -259,6 +266,22 @@ def audit_retrace(
         ),
         ("clean donated, netstack off", _tiny_cfg(False, False)),
     ]
+    if fused_epoch:
+        # the ONE-KERNEL epoch (interpret arm): fused phase-II Pallas
+        # consensus + fit-scan kernel phase I, guarded+faulted+sanitize
+        # — the fused programs must compile exactly once and re-dispatch
+        # across steady blocks like every other arm (``fused_epoch=
+        # False`` lets the tier-1 pytest wrapper shed it to the slow
+        # twin + the CI graftlint cell, the fitstack_dtypes pattern)
+        cases.append(
+            (
+                "faulted+guarded, one-kernel epoch (pallas_fused)",
+                _tiny_cfg(True, True).replace(
+                    consensus_impl="pallas_fused_interpret",
+                    fitstack="pallas_interpret",
+                ),
+            )
+        )
     for label, cfg in cases:
         state, _ = train(cfg, n_episodes=cfg.n_ep_fixed)  # warmup: compiles
         with auditor.expect_no_compiles(context=label):

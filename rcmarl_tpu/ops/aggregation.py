@@ -101,6 +101,12 @@ AUDIT_BACKEND_MODES = (
     ("traced_h", {"impl": "xla", "traced_h": True}),
     ("pallas_select", {"impl": "pallas_interpret"}),
     ("pallas_sort", {"impl": "pallas_sort"}),
+    # the one-kernel-epoch name, audited in its interpreter-traceable
+    # form: at the LEAF level it aliases the selection kernel (the
+    # fused gather+fault chain is an epoch-level property audited via
+    # the consensus_block entry point), but registering the name here
+    # keeps "a new backend cannot ship unaudited" literally true.
+    ("pallas_fused", {"impl": "pallas_fused_interpret"}),
 )
 
 
@@ -551,11 +557,14 @@ def resilient_aggregate(
     if impl not in ("xla", "xla_sort"):
         from rcmarl_tpu.ops.pallas_aggregation import fused_resilient_aggregate
 
+        # the one-kernel-epoch names alias the plain kernel at the leaf
+        # level — the extra fusion (in-kernel gather + fault chain) is
+        # an EPOCH-level property owned by training/update.py
         return fused_resilient_aggregate(
             values,
             H,
             variant="sort" if impl == "pallas_sort" else "select",
-            interpret=impl == "pallas_interpret",
+            interpret=impl in ("pallas_interpret", "pallas_fused_interpret"),
             sanitize=sanitize,
         )
     if sanitize:
@@ -814,15 +823,24 @@ def resilient_aggregate_tree(
     )
     if impl not in ("xla", "xla_sort"):
         from rcmarl_tpu.ops.pallas_aggregation import (
-            fused_resilient_aggregate_tree,
+            fused_resilient_aggregate,
         )
 
-        return fused_resilient_aggregate_tree(
-            tree,
-            H,
-            variant="sort" if impl == "pallas_sort" else "select",
-            interpret=impl == "pallas_interpret",
-            sanitize=sanitize,
+        # ONE ravel path for every backend: the pallas impls go through
+        # the same apply() as the XLA ones, so the flat block enters the
+        # kernel without a second pack, the mixed-dtype guard applies
+        # uniformly, and layout='per_leaf' is an honest per-leaf
+        # comparison arm on the kernel too (bitwise — raveling is
+        # elementwise-neutral, pinned in tests/test_fused_epoch.py).
+        return apply(
+            lambda v: fused_resilient_aggregate(
+                v,
+                H,
+                variant="sort" if impl == "pallas_sort" else "select",
+                interpret=impl
+                in ("pallas_interpret", "pallas_fused_interpret"),
+                sanitize=sanitize,
+            )
         )
     if sanitize:
         return apply(lambda v: _sanitized_aggregate(v, H, impl))

@@ -240,13 +240,18 @@ def fused_resilient_aggregate_tree(
 ):
     """Aggregate every (n_in, ...) leaf of ``tree`` in ONE kernel launch.
 
-    Ravels all leaves along their trailing dims, concatenates into a
-    single (n_in, P) block (``aggregation.ravel_neighbor_tree`` — the
-    same layout the XLA one-launch paths share), runs
+    Ravels all leaves along their trailing dims into a single (n_in, P)
+    block through the ONE shared ravel path
+    (``aggregation.ravel_neighbor_tree`` — the exact layout the XLA
+    one-launch paths and the fused-epoch pair block use, so the flat
+    block enters the kernel without a second pack), runs
     :func:`fused_resilient_aggregate` once, and splits back — the whole
     hidden-layer consensus of an agent's trunk (reference
     ``resilient_CAC_agents.py:142-166``) becomes a single HBM pass
-    instead of one selection per weight array.
+    instead of one selection per weight array. Bitwise the per-leaf
+    dispatch (raveling is elementwise-neutral); mixed-dtype trees must
+    go through :func:`~rcmarl_tpu.ops.aggregation.resilient_aggregate_tree`,
+    whose layout guard falls back to per-leaf kernel launches.
     """
     flat, unravel = ravel_neighbor_tree(tree)
     return unravel(

@@ -1,0 +1,524 @@
+"""One-kernel phase-II consensus: gather -> fault -> trim -> clip/mean
+in a single VMEM-resident Pallas program.
+
+The netstack epoch's phase II is HBM-bandwidth-bound: the XLA arm
+materializes the gathered ``(N, n_in, P_trunk)`` neighbor block in HBM
+(the gather's output), rewrites it through the transport-fault
+transform, and re-reads it for the trim/clip/mean — every intermediate
+is ``n_in`` times the parameter state. This kernel keeps each column
+tile of the COMBINED ``(N, P_critic + P_tr)`` pair block resident in
+VMEM across the whole chain: the neighbor gather happens in-register
+(static row selects from the VMEM-resident agent axis), the per-link
+fault chain applies scalar masks drawn host-side from the exact
+:func:`rcmarl_tpu.faults.apply_link_faults_flat` key structure, and the
+2(H+1)-register trim chain + clip/mean epilogue
+(:mod:`rcmarl_tpu.ops.aggregation`'s register helpers — the strategy
+the Pallas tradition here has always used) write only the aggregated
+``(N, P_trunk)`` tile back. HBM traffic: one read of the stacked
+messages (+ the stale-replay block when ``stale_p > 0``), one write of
+the aggregate —
+vs the two-launch arm's gather write + fault rewrite + aggregation
+re-read, each ``n_in``-fold. ``AUDIT.jsonl``'s
+``consensus_trunk[pallas_fused]`` vs ``consensus_trunk[two_launch]``
+rows carry that claim as a CI-gated ledger fact
+(:func:`rcmarl_tpu.lint.cost.fused_consensus_cost_rows`).
+
+Bitwise contract (the house discipline, tests/test_fused_epoch.py):
+every trim bound is an exact input-value selection (register chain ≡
+tournament ≡ sort), and the SANITIZE epilogue mirrors the XLA
+reference op-for-op — the slot-ordered finite count and clip
+accumulate that the six-backend contract was *designed* around
+(ops/aggregation.py "Sanitized aggregation": an explicit chain of
+binary adds is the one reduction XLA can never reassociate). The
+fused epoch is therefore pinned leaf-for-leaf BITWISE against
+``consensus_impl='xla'`` across the whole sanitize matrix —
+{regular, ragged} x {clean, drop/NaN/stale/flip/inf faulted} x
+{H=0, H>0, traced H} x mixed casts. PLAIN (sanitize-off) cells keep
+the historical kernel contract instead — allclose at f32 rounding —
+because their ``jnp.mean`` epilogue is reassociated freely by XLA's
+fusion pass (measured: the same gathered block means to 1-2 ULP
+different bits in different fusion contexts), exactly the tolerance
+``tests/test_pallas_aggregation.py`` has always pinned the leaf
+kernel with. Two documented fallbacks to the XLA arm: ``corrupt_p >
+0`` plans (the additive-noise draw's erfinv tail gets FMA-fused into
+whatever consumes it, so its BITS are fusion-context-dependent — and
+the ``(N, n_in, P)`` noise is n_in-fold the block, structurally
+halving the kernel's traffic win anyway) and time-varying (traced)
+communication graphs (the in-kernel gather unrolls static rows).
+
+What stays XLA (by design, documented in README "One-kernel epoch"):
+the tiny head-column gather+fault (``P_head = 2(h+1)`` floats per
+agent), the projection einsum + per-sample estimate aggregation
+(MXU matmuls over the batch, already fused well by XLA), and the
+normalized team head step. The kernel emits the post-consensus trunk
+block; ``training/update.py`` runs the tail.
+
+Real lowering rides the queued TPU session (scripts/tpu_session.sh);
+on this host the kernel runs in interpreter mode
+(``consensus_impl='pallas_fused_interpret'``), and the lint cost arm
+records real-Pallas-on-CPU as notes, never passes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from rcmarl_tpu.faults import FaultPlan, _link_masks
+from rcmarl_tpu.ops.aggregation import _running_large, _running_small
+
+_LANES = 128
+
+#: Default sublane rows per grid step: the register-chain trim keeps
+#: only ~2(H+1) live (rows, 128) arrays per agent, but the whole agent
+#: axis is VMEM-resident (the in-kernel gather reads it), so the tile
+#: is kept a notch under the leaf kernel's 64.
+_DEFAULT_BLOCK_ROWS = 8
+
+
+class FaultFields(NamedTuple):
+    """The per-epoch transport-fault draw, precomputed XLA-side so the
+    kernel's fault chain is BITWISE the two-launch arm's.
+
+    masks: ``(2, 4, N, n_in)`` f32 0/1 — per tree (0 = critic, 1 = TR),
+    the stale / flip / bomb(drop|nan) / inf link masks of
+    :func:`rcmarl_tpu.faults._link_masks` (bomb pre-ORed exactly as
+    ``_fault_payload`` does). inf_sign: ``(2, N, n_in)`` f32 ±inf.
+    Corruption noise never reaches the kernel: ``corrupt_p > 0`` plans
+    take the XLA reference arm (module docstring).
+    """
+
+    masks: jnp.ndarray
+    inf_sign: jnp.ndarray
+
+
+_MASK_ORDER = ("stale", "flip", "bomb", "inf")
+
+
+def draw_fault_fields(
+    fkey: jax.Array,
+    plan: FaultPlan,
+    n_agents: int,
+    n_in: int,
+    segments,
+) -> FaultFields:
+    """Draw the per-link fault fields for one epoch's combined block.
+
+    ``fkey`` is the epoch fault key (pre per-tree fold_in), ``segments``
+    the :func:`training.update._pair_segments` rows; the key structure
+    mirrors :func:`rcmarl_tpu.faults.apply_link_faults_flat` draw for
+    draw, so a mask plane here is bitwise the flat transform's. Masks
+    are bernoulli threshold compares on threefry bits — integer-exact,
+    immune to the fusion-context rounding that rules the corruption
+    noise out of the kernel.
+    """
+    shape = (n_agents, n_in)
+    tree_ids = sorted({t for t, *_ in segments})
+    keys = {
+        t: jax.random.fold_in(jax.random.fold_in(fkey, t), plan.seed)
+        for t in tree_ids
+    }
+    raw = {t: _link_masks(keys[t], plan, shape) for t in tree_ids}
+    masks = jnp.stack(
+        [
+            jnp.stack(
+                [
+                    (
+                        (raw[t]["drop"] | raw[t]["nan"])
+                        if kind == "bomb"
+                        else raw[t][kind]
+                    ).astype(jnp.float32)
+                    for kind in _MASK_ORDER
+                ]
+            )
+            for t in tree_ids
+        ]
+    )  # (2, 4, N, n_in)
+    inf_sign = jnp.stack([raw[t]["inf_sign"] for t in tree_ids])
+    return FaultFields(masks=masks, inf_sign=inf_sign)
+
+
+def kernel_compatible_plan(plan: Optional[FaultPlan]) -> bool:
+    """True when the fused kernel can carry ``plan`` in-kernel with the
+    bitwise contract intact: any plan without additive corruption
+    (``corrupt_p > 0`` routes the epoch to the XLA reference arm —
+    module docstring)."""
+    return plan is None or not plan.active or float(plan.corrupt_p) <= 0.0
+
+
+def head_segments(segments, n_trunk: int):
+    """The head-column rows of a ``_pair_segments`` tuple, re-offset to
+    the sliced head block — what the XLA-side head fault transform
+    consumes (``leaf_idx`` preserved, so the per-leaf noise streams stay
+    bitwise the full-block transform's)."""
+    return tuple(
+        (t, leaf_idx, off - n_trunk, size)
+        for t, leaf_idx, off, size in segments
+        if off >= n_trunk
+    )
+
+
+# --------------------------------------------------------------------------
+# In-kernel aggregation epilogues — each mirrors its XLA twin op-for-op
+# --------------------------------------------------------------------------
+
+
+def _plain_agg(rows, H):
+    """Twin of the static-H ``resilient_aggregate`` xla branch."""
+    vals = jnp.stack(rows)
+    if H == 0:
+        return jnp.mean(vals, axis=0)
+    lo = _running_small(rows, H + 1)[H]
+    hi = _running_large(rows, H + 1)[0]
+    lower = jnp.minimum(lo, rows[0])
+    upper = jnp.maximum(hi, rows[0])
+    return jnp.mean(jnp.clip(vals, lower, upper), axis=0)
+
+
+def _dynamic_agg(rows, H):
+    """Twin of ``_dynamic_h_aggregate`` (traced H, plain): the full
+    legal-range k_max register chain, traced trim index into the
+    stacked selections."""
+    n_in = len(rows)
+    k_max = (n_in - 1) // 2 + 1
+    small = jnp.stack(_running_small(rows, k_max))
+    large = jnp.stack(_running_large(rows, k_max))
+    lower_raw = jnp.take(small, H, axis=0)
+    upper_raw = jnp.take(large, k_max - 1 - H, axis=0)
+    lower = jnp.minimum(lower_raw, rows[0])
+    upper = jnp.maximum(upper_raw, rows[0])
+    return jnp.mean(jnp.clip(jnp.stack(rows), lower, upper), axis=0)
+
+
+def _masked_agg(rows, H, va):
+    """Twin of ``_masked_aggregate`` with the agent's STATIC validity
+    row ``va`` (padded ragged graphs): identical value content — a
+    where() under a compile-time mask is the select it lowers to."""
+    count = jnp.float32(sum(va))  # static valid-slot count (exact in f32)
+    zeros = jnp.zeros_like(rows[0])
+    if H == 0:
+        kept = [r if va[k] else zeros for k, r in enumerate(rows)]
+        return jnp.sum(jnp.stack(kept), axis=0) / count
+    inf = jnp.full_like(rows[0], jnp.inf)
+    sink_lo = [r if va[k] else inf for k, r in enumerate(rows)]
+    sink_hi = [r if va[k] else -inf for k, r in enumerate(rows)]
+    lower = jnp.minimum(_running_small(sink_lo, H + 1)[H], rows[0])
+    upper = jnp.maximum(_running_large(sink_hi, H + 1)[0], rows[0])
+    clipped = [
+        jnp.clip(r, lower, upper) if va[k] else zeros
+        for k, r in enumerate(rows)
+    ]
+    return jnp.sum(jnp.stack(clipped), axis=0) / count
+
+
+def _sanitized_agg(rows, H, va, traced_h: bool):
+    """Twin of ``_sanitized_aggregate`` / ``_sanitized_dynamic``: the
+    slot-ordered finite count, ±inf sentinel sinks, exact-selection
+    bounds, own-anchoring via the sunk own row, slot-ordered clip
+    accumulate, and the 2H+1 degree-deficit fallback — the op sequence
+    every backend's bitwise contract pins (tests/test_faults.py)."""
+    n_in = len(rows)
+    own = rows[0]
+    finite = [jnp.isfinite(r) for r in rows]
+    if va is not None:
+        false = jnp.zeros_like(finite[0])
+        finite = [f if va[k] else false for k, f in enumerate(finite)]
+    count = finite[0].astype(jnp.float32)
+    for f in finite[1:]:
+        count = count + f.astype(jnp.float32)
+    sink_lo = [jnp.where(f, r, jnp.inf) for f, r in zip(finite, rows)]
+    sink_hi = [jnp.where(f, r, -jnp.inf) for f, r in zip(finite, rows)]
+    if traced_h:
+        k_max = (n_in - 1) // 2 + 1
+        lower_raw = jnp.take(
+            jnp.stack(_running_small(sink_lo, k_max)), H, axis=0
+        )
+        upper_raw = jnp.take(
+            jnp.stack(_running_large(sink_hi, k_max)), k_max - 1 - H, axis=0
+        )
+    else:
+        lower_raw = _running_small(sink_lo, H + 1)[H]
+        upper_raw = _running_large(sink_hi, H + 1)[0]
+    lower = jnp.minimum(lower_raw, jnp.where(finite[0], own, jnp.inf))
+    upper = jnp.maximum(upper_raw, jnp.where(finite[0], own, -jnp.inf))
+    acc = jnp.where(finite[0], jnp.clip(rows[0], lower, upper), 0.0)
+    for r, f in zip(rows[1:], finite[1:]):
+        acc = acc + jnp.where(f, jnp.clip(r, lower, upper), 0.0)
+    return jnp.where(count >= 2 * H + 1, acc / count, own)
+
+
+# --------------------------------------------------------------------------
+# The kernel
+# --------------------------------------------------------------------------
+
+
+def _fault_chain(v, stale_row, masks, inf_sign, tree0, plan, a, k):
+    """In-kernel twin of :func:`rcmarl_tpu.faults._fault_payload` for
+    one (agent ``a``, slot ``k``) payload row: the per-tree scalar link
+    masks are broadcast per element through the static column->tree
+    select ``tree0`` (the combined block carries both trees)."""
+
+    def m(kind):
+        i = _MASK_ORDER.index(kind)
+        return jnp.where(tree0, masks[0, i, a, k], masks[1, i, a, k]) > 0
+
+    if float(plan.stale_p) > 0.0:
+        v = jnp.where(m("stale"), stale_row, v)
+    if float(plan.flip_p) > 0.0:
+        v = jnp.where(m("flip"), -v, v)
+    if float(plan.drop_p) > 0.0 or float(plan.nan_p) > 0.0:
+        v = jnp.where(m("bomb"), jnp.nan, v)
+    if float(plan.inf_p) > 0.0:
+        sign = jnp.where(tree0, inf_sign[0, a, k], inf_sign[1, a, k])
+        v = jnp.where(m("inf"), sign, v)
+    return v
+
+
+def _consensus_kernel(
+    *refs,
+    n_agents: int,
+    n_in: int,
+    in_arr,
+    H,
+    traced_h: bool,
+    sanitize: bool,
+    valid,
+    plan,
+    tree_split: int,
+    block_rows: int,
+    has_stale: bool,
+):
+    """One (N, block_rows, LANES) column tile: in-register gather of
+    every agent's neighborhood, the per-link fault chain, and the
+    agent's trim/clip/mean epilogue — nothing but the aggregate leaves
+    the tile."""
+    it = iter(refs)
+    msgs_ref = next(it)
+    stale_ref = next(it) if has_stale else None
+    masks_ref = next(it) if plan is not None else None
+    sign_ref = next(it) if plan is not None else None
+    h_ref = next(it) if traced_h else None
+    out_ref = next(it)
+
+    blk = msgs_ref[...]  # (N, block_rows, LANES) — the VMEM residents
+    stale_blk = stale_ref[...] if has_stale else None
+    masks = masks_ref[...] if plan is not None else None
+    inf_sign = sign_ref[...] if plan is not None else None
+    h_val = h_ref[0, 0] if traced_h else H
+
+    tree0 = None
+    if plan is not None:
+        # global flat column index of each tile element -> tree select
+        base = pl.program_id(0) * block_rows * _LANES
+        col = (
+            base
+            + jax.lax.broadcasted_iota(jnp.int32, (block_rows, _LANES), 0)
+            * _LANES
+            + jax.lax.broadcasted_iota(jnp.int32, (block_rows, _LANES), 1)
+        )
+        tree0 = col < tree_split
+
+    out_rows = []
+    for a in range(n_agents):
+        rows = []
+        for k in range(n_in):
+            v = blk[in_arr[a][k]]
+            if plan is not None:
+                rows.append(
+                    _fault_chain(
+                        v,
+                        stale_blk[in_arr[a][k]] if has_stale else None,
+                        masks,
+                        inf_sign,
+                        tree0,
+                        plan,
+                        a,
+                        k,
+                    )
+                )
+            else:
+                rows.append(v)
+        va = None if valid is None else valid[a]
+        if sanitize:
+            agg = _sanitized_agg(rows, h_val, va, traced_h)
+        elif va is not None:
+            agg = _masked_agg(rows, H, va)
+        elif traced_h:
+            agg = _dynamic_agg(rows, h_val)
+        else:
+            agg = _plain_agg(rows, H)
+        out_rows.append(agg)
+    out_ref[...] = jnp.stack(out_rows)
+
+
+def _pad_cols(x, tile):
+    m = x.shape[-1]
+    padded = ((m + tile - 1) // tile) * tile
+    if padded != m:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, padded - m)])
+    return x, padded
+
+
+def fused_pair_consensus(
+    msgs: jnp.ndarray,
+    H,
+    *,
+    in_nodes,
+    tree_split: int,
+    valid=None,
+    sanitize: bool = False,
+    plan: Optional[FaultPlan] = None,
+    stale: Optional[jnp.ndarray] = None,
+    fields: Optional[FaultFields] = None,
+    block_rows: int = _DEFAULT_BLOCK_ROWS,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Gather + fault + trim/clip/mean over the trunk columns of the
+    combined pair block, as ONE Pallas launch.
+
+    Args:
+      msgs: ``(N, P_trunk)`` f32 — the trunk columns of the raveled
+        critic+TR pair block (``training.update._pair_block``).
+      H: trim parameter — a Python int traces the specialized kernel
+        (H=0 short-circuits to the plain mean); a traced int32 scalar
+        runs the k_max-register dynamic-trim chain (the fused-matrix
+        path), fed to the kernel as a scalar input.
+      in_nodes: STATIC padded gather rows (``cfg.padded_in_nodes()[0]``)
+        — the in-kernel gather unrolls these row selects, which is what
+        keeps the gathered block out of HBM. Time-varying (traced)
+        graphs are rejected at Config level.
+      tree_split: static column index where the TR trunk begins (the
+        per-tree fault masks select on it).
+      valid: STATIC ``cfg.padded_in_nodes()[1]`` rows (ragged graphs)
+        or None.
+      sanitize: the non-finite-hardened epilogue (bitwise the XLA
+        backends' sanitize mode).
+      plan / stale / fields: the active FaultPlan with its stale-replay
+        trunk block (``stale_p > 0`` only) and the precomputed
+        :class:`FaultFields`; all None for clean transport.
+      block_rows / interpret: tile height and the Pallas interpreter
+        flag (CPU tests; real lowering rides the TPU session).
+
+    Returns the ``(N, P_trunk)`` post-consensus trunk block.
+    """
+    N, P = msgs.shape
+    # static host tuples (cfg.padded_in_nodes rows) — kept as-is for the
+    # unrolled in-kernel row selects
+    in_arr = tuple(tuple(row) for row in in_nodes)
+    n_in = len(in_arr[0])
+    traced_h = not isinstance(H, (int, np.integer))
+    if traced_h and valid is not None:
+        raise ValueError(
+            "traced H is not supported together with a padded-graph "
+            "validity mask (matrix cells must share one uniform graph)"
+        )
+    active = plan is not None and plan.active
+    if active and not kernel_compatible_plan(plan):
+        raise ValueError(
+            "corrupt_p > 0 plans take the XLA reference arm (the noise "
+            "draw's bits are fusion-context-dependent — module docstring); "
+            "the epoch routes them there before reaching the kernel"
+        )
+    has_stale = active and float(plan.stale_p) > 0.0
+    if active and fields is None:
+        raise ValueError("an active FaultPlan needs precomputed FaultFields")
+
+    tile = block_rows * _LANES
+    flat, padded = _pad_cols(msgs.astype(jnp.float32), tile)
+    rows_total = padded // _LANES
+    v3 = flat.reshape(N, rows_total, _LANES)
+    grid = (rows_total // block_rows,)
+
+    inputs = [v3]
+    in_specs = [pl.BlockSpec((N, block_rows, _LANES), lambda i: (0, i, 0))]
+    if has_stale:
+        s3 = _pad_cols(stale.astype(jnp.float32), tile)[0].reshape(
+            N, rows_total, _LANES
+        )
+        inputs.append(s3)
+        in_specs.append(
+            pl.BlockSpec((N, block_rows, _LANES), lambda i: (0, i, 0))
+        )
+    if active:
+        inputs.append(fields.masks)
+        in_specs.append(
+            pl.BlockSpec(fields.masks.shape, lambda i: (0, 0, 0, 0))
+        )
+        inputs.append(fields.inf_sign)
+        in_specs.append(
+            pl.BlockSpec(fields.inf_sign.shape, lambda i: (0, 0, 0))
+        )
+    if traced_h:
+        inputs.append(jnp.asarray(H, jnp.int32).reshape(1, 1))
+        in_specs.append(pl.BlockSpec((1, 1), lambda i: (0, 0)))
+
+    valid_rows = (
+        None
+        if valid is None
+        else tuple(tuple(v > 0 for v in row) for row in valid)
+    )
+    kernel = functools.partial(
+        _consensus_kernel,
+        n_agents=N,
+        n_in=n_in,
+        in_arr=in_arr,
+        H=None if traced_h else int(H),
+        traced_h=traced_h,
+        sanitize=sanitize,
+        valid=valid_rows,
+        plan=plan if active else None,
+        tree_split=tree_split,
+        block_rows=block_rows,
+        has_stale=has_stale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((N, rows_total, _LANES), jnp.float32),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((N, block_rows, _LANES), lambda i: (0, i, 0)),
+        grid=grid,
+        interpret=interpret,
+    )(*inputs)
+    return out.reshape(N, -1)[:, :P]
+
+
+# --------------------------------------------------------------------------
+# Cost model — the ledger rows' programs and the kernel's DMA arithmetic
+# --------------------------------------------------------------------------
+
+
+def fused_consensus_dma_bytes(
+    n_agents: int,
+    n_in: int,
+    n_trunk: int,
+    plan: Optional[FaultPlan],
+    block_rows: int = _DEFAULT_BLOCK_ROWS,
+) -> float:
+    """The kernel's exact HBM traffic in bytes, from its BlockSpecs:
+    every input tile is DMAd once per grid step and the output written
+    once — deterministic arithmetic, not an estimate (the honesty tag
+    on the ledger row is ``bytes_model: 'pallas-blockspec-dma'``).
+    Broadcast inputs (masks, sign planes, the traced-H scalar) are
+    counted once PER GRID STEP — the conservative reading."""
+    tile = block_rows * _LANES
+    padded = ((n_trunk + tile - 1) // tile) * tile
+    n_tiles = padded // tile
+    bytes_total = n_agents * padded * 4.0  # messages read
+    bytes_total += n_agents * padded * 4.0  # aggregate written
+    if plan is not None and plan.active:
+        if float(plan.stale_p) > 0.0:
+            bytes_total += n_agents * padded * 4.0  # stale-replay read
+        masks_bytes = (2 * 4 * n_agents * n_in + 2 * n_agents * n_in) * 4.0
+        bytes_total += masks_bytes * n_tiles  # re-DMAd per tile
+    return bytes_total
+
+
+# The two-launch/math-twin comparison programs behind the
+# ``consensus_trunk`` ledger rows live with the audit that compiles
+# them (:func:`rcmarl_tpu.lint.cost.consensus_cost_programs`) — this
+# module only owns the deterministic DMA arithmetic above.

@@ -1,0 +1,251 @@
+"""Fit-scan Pallas kernel: VMEM-resident netstacked params across the
+whole minibatch schedule.
+
+The fitstack scan (:func:`rcmarl_tpu.ops.fit.fused_fit_scan`) runs
+``epochs x n_batches`` SGD steps per (flavor-row, agent) cell as a
+``lax.scan`` whose carry — the stacked parameter block — round-trips
+HBM every step: XLA double-buffers while-loop carries, so each of the
+~600 steps of the adversary schedule reads and writes the full
+parameter state. This kernel gives each (row, agent) grid cell its
+parameters ONCE as VMEM residents, runs the entire schedule as an
+in-kernel ``fori_loop`` over the precomputed shuffle plans, and writes
+the fitted parameters back at the end: parameter HBM traffic drops
+from ``2 * steps * P`` to ``2 * P`` (the ``fit_scan[...]`` AUDIT.jsonl
+rows carry the model; the fit data and plans are read once either
+way).
+
+Bitwise discipline (the fitstack contract,
+tests/test_fitstack_properties.py): the shuffle plans are drawn
+XLA-side with :func:`~rcmarl_tpu.ops.fit.valid_first_shuffle` /
+:func:`~rcmarl_tpu.ops.fit.identity_plan` under the EXACT per-epoch
+key structure ``fit_minibatch`` draws (uniform bits + argsort are
+integer-exact, immune to fusion-context rounding), and each kernel
+step traces the same ``value_and_grad(weighted_mse(forward(p,
+x[idx]), target[idx], mask=bval))`` + ``sgd_update`` +
+skip-empty-batch select op sequence as the scan body. Fitted
+parameters are pinned against the XLA scan leaf-for-leaf
+(tests/test_fused_epoch.py); the returned first-epoch loss is a
+logging value whose weighted-mean reduction may differ by f32
+rounding across fusion contexts and is pinned at allclose.
+
+Lands as ``Config.fitstack='pallas'`` (real lowering — queued for the
+TPU session) and ``'pallas_interpret'`` (the CPU test arm). VMEM
+budget: one cell holds its parameter leaves + the (B, width) fit data
++ the (epochs, n_batches, batch) plans — ~2.5 MB at the BASELINE
+256-wide scale, inside a v5e core's 128 MB.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from rcmarl_tpu.ops.fit import (
+    FitSchedule,
+    identity_plan,
+    valid_first_shuffle,
+)
+from rcmarl_tpu.ops.losses import weighted_mse
+from rcmarl_tpu.ops.optim import sgd_update
+
+
+def _fit_plans(keys, mask, schedule: FitSchedule, n_batches: int):
+    """(idx, bvalid) of shape (R, N, epochs, n_batches, batch_size) —
+    the exact per-(row, agent, epoch) batch plans ``fit_minibatch``
+    would draw, precomputed XLA-side (threefry + argsort: bit-exact in
+    any fusion context)."""
+    R, N = keys.shape[0], keys.shape[1]
+    bs = schedule.batch_size
+    if not schedule.shuffle:
+        idx1, bv1 = identity_plan(mask, n_batches, bs)
+        shape = (R, N, schedule.epochs, n_batches, bs)
+        return (
+            jnp.broadcast_to(idx1, shape),
+            jnp.broadcast_to(bv1, shape),
+        )
+
+    def plans_one(key):
+        ekeys = jax.random.split(key, schedule.epochs)
+        if schedule.assume_valid:
+            f = lambda ek: valid_first_shuffle(
+                ek, mask, n_batches, bs, assume_valid=True
+            )
+        else:
+            # positional call, no flag: mirrors fit_minibatch's hook
+            f = lambda ek: valid_first_shuffle(ek, mask, n_batches, bs)
+        return jax.vmap(f)(ekeys)
+
+    return jax.vmap(jax.vmap(plans_one))(keys)
+
+
+def _fit_kernel(
+    *refs,
+    treedef,
+    n_leaves: int,
+    forward,
+    lr: float,
+    epochs: int,
+    n_batches: int,
+    shuffle: bool,
+):
+    """One (row, agent) cell: params live in registers/VMEM across the
+    whole ``epochs x n_batches`` schedule; each step is the scan body's
+    exact op sequence on the precomputed plan row."""
+    leaf_refs = refs[:n_leaves]
+    x_ref, tgt_ref, idx_ref, bval_ref = refs[n_leaves : n_leaves + 4]
+    out_leaf_refs = refs[n_leaves + 4 : n_leaves + 4 + n_leaves]
+    loss_ref = refs[-1]
+
+    params = jax.tree.unflatten(
+        treedef, [r[...][0, 0] for r in leaf_refs]
+    )
+    x = x_ref[...][0]  # (B, W)
+    tgt = tgt_ref[...][0, 0]  # (B, 1)
+    idx_all = idx_ref[...][0, 0]  # (epochs, n_batches, bs)
+    bval_all = bval_ref[...][0, 0]
+
+    def step(s, carry):
+        p, losses0, counts0 = carry
+        e = s // n_batches
+        b = s % n_batches
+        bidx = idx_all[e, b]
+        bval = bval_all[e, b]
+
+        def batch_loss(p):
+            return weighted_mse(forward(p, x[bidx]), tgt[bidx], mask=bval)
+
+        loss, g = jax.value_and_grad(batch_loss)(p)
+        nonempty = jnp.sum(bval) > 0
+        newp = sgd_update(p, g, lr)
+        p = jax.tree.map(lambda a, b_: jnp.where(nonempty, b_, a), p, newp)
+        # epoch-0 per-batch (loss, count) rows for the returned
+        # first-epoch loss (a (1, n_batches) select — no scatter)
+        slot = (
+            jax.lax.broadcasted_iota(jnp.int32, (1, n_batches), 1) == b
+        ) & (e == 0)
+        losses0 = jnp.where(slot, loss, losses0)
+        counts0 = jnp.where(slot, jnp.sum(bval), counts0)
+        return p, losses0, counts0
+
+    zeros = jnp.zeros((1, n_batches), jnp.float32)
+    params, losses0, counts0 = jax.lax.fori_loop(
+        0, epochs * n_batches, step, (params, zeros, zeros)
+    )
+    if not shuffle and n_batches == 1:
+        # the full-batch flavor: "epoch loss" IS the one batch loss
+        first_loss = losses0[0, 0]
+    else:
+        first_loss = jnp.sum(losses0 * counts0) / jnp.maximum(
+            jnp.sum(counts0), 1.0
+        )
+    for r, leaf in zip(out_leaf_refs, jax.tree.leaves(params)):
+        r[...] = leaf[None, None]
+    loss_ref[...] = first_loss.reshape(1, 1)
+
+
+def pallas_fit_scan(
+    keys,
+    params_rows,
+    forward,
+    x_rows: jnp.ndarray,
+    targets_rows: jnp.ndarray,
+    mask: jnp.ndarray,
+    schedule: FitSchedule,
+    lr: float,
+    *,
+    interpret: bool = False,
+):
+    """Drop-in Pallas twin of :func:`rcmarl_tpu.ops.fit.fused_fit_scan`
+    (same arguments + ``interpret``): one grid cell per (flavor-row,
+    agent), parameters VMEM-resident across the whole schedule.
+
+    Returns ``(fitted rows, (R, N) first-epoch losses)`` — fitted rows
+    leaf-for-leaf the XLA scan's, losses allclose (module docstring).
+    """
+    R, N = keys.shape[0], keys.shape[1]
+    cap = x_rows.shape[1]
+    n_batches = math.ceil(cap / schedule.batch_size)
+    idx, bvalid = _fit_plans(keys, mask, schedule, n_batches)
+    targets_rows = jax.lax.stop_gradient(targets_rows)
+
+    leaves, treedef = jax.tree.flatten(params_rows)
+    n_leaves = len(leaves)
+
+    def leaf_spec(leaf):
+        block = (1, 1) + leaf.shape[2:]
+        nd = len(leaf.shape) - 2
+        return pl.BlockSpec(
+            block, lambda r, n, nd=nd: (r, n) + (0,) * nd
+        )
+
+    in_specs = [leaf_spec(l) for l in leaves]
+    in_specs.append(
+        pl.BlockSpec((1,) + x_rows.shape[1:], lambda r, n: (r, 0, 0))
+    )
+    in_specs.append(
+        pl.BlockSpec(
+            (1, 1) + targets_rows.shape[2:], lambda r, n: (r, n, 0, 0)
+        )
+    )
+    for arr in (idx, bvalid):
+        in_specs.append(
+            pl.BlockSpec(
+                (1, 1) + arr.shape[2:], lambda r, n: (r, n, 0, 0, 0)
+            )
+        )
+    out_specs = [leaf_spec(l) for l in leaves]
+    out_specs.append(pl.BlockSpec((1, 1), lambda r, n: (r, n)))
+    out_shape = [
+        jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves
+    ] + [jax.ShapeDtypeStruct((R, N), jnp.float32)]
+
+    kernel = functools.partial(
+        _fit_kernel,
+        treedef=treedef,
+        n_leaves=n_leaves,
+        forward=forward,
+        lr=lr,
+        epochs=schedule.epochs,
+        n_batches=n_batches,
+        shuffle=schedule.shuffle,
+    )
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=tuple(out_shape),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        grid=(R, N),
+        interpret=interpret,
+    )(*leaves, x_rows, targets_rows, idx, bvalid)
+    fitted = jax.tree.unflatten(treedef, list(outs[:-1]))
+    return fitted, outs[-1]
+
+
+def fit_scan_hbm_bytes(
+    params_rows, x_rows, targets_rows, schedule: FitSchedule, resident: bool
+) -> float:
+    """The analytic parameter-traffic model behind the ``fit_scan``
+    ledger rows: an XLA ``lax.scan`` round-trips its carry — the full
+    stacked parameter block — through HBM every step
+    (``resident=False``: ``2 * steps * P`` bytes), while the kernel
+    reads and writes it once per cell (``resident=True``: ``2 * P``).
+    Fit data, targets, and the shuffle plans are counted once for both
+    arms. Deterministic shape arithmetic, tagged ``bytes_model:
+    'analytic-scan-carry'`` on the rows — a model of the structural
+    difference, not a compiled measurement.
+    """
+    cap = x_rows.shape[1]
+    n_batches = math.ceil(cap / schedule.batch_size)
+    steps = schedule.epochs * n_batches
+    p_bytes = sum(
+        l.size * l.dtype.itemsize for l in jax.tree.leaves(params_rows)
+    )
+    R, N = jax.tree.leaves(params_rows)[0].shape[:2]
+    plan_bytes = 2 * R * N * schedule.epochs * n_batches * schedule.batch_size * 4
+    data_bytes = x_rows.size * 4 + targets_rows.size * 4 + plan_bytes
+    carries = 2.0 if resident else 2.0 * steps
+    return carries * p_bytes + data_bytes

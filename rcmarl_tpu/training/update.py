@@ -36,6 +36,7 @@ from rcmarl_tpu.agents.updates import (
     adv_fused_row_block,
     adv_pair_fit,
     adv_tr_fit,
+    consensus_pair_tail,
     consensus_update_one,
     consensus_update_pair,
     coop_actor_update,
@@ -48,7 +49,7 @@ from rcmarl_tpu.agents.updates import (
     pair_bootstrap_targets,
     select_tree,
 )
-from rcmarl_tpu.config import Config, Roles
+from rcmarl_tpu.config import FUSED_CONSENSUS_IMPLS, Config, Roles
 from rcmarl_tpu.faults import (
     FaultDiag,
     adaptive_payload_tree,
@@ -106,10 +107,38 @@ def netstack_enabled(cfg: Config) -> bool:
     one-block epoch on TPU (the batching win the stacking buys), the
     dual-launch arm elsewhere (measured slower on a serial CPU host:
     the zero-padding FLOPs have no parallel headroom to hide in —
-    PERF.md "netstack")."""
+    PERF.md "netstack"). The one-kernel consensus arms consume the
+    combined pair block, so they force the stacked epoch whatever the
+    policy resolves to (Config rejects an explicit netstack=False with
+    them) — bench/profile rows then honestly report the layout that
+    actually ran."""
+    if cfg.consensus_impl in FUSED_CONSENSUS_IMPLS:
+        return True
     if cfg.netstack == "auto":
         return jax.default_backend() == "tpu"
     return bool(cfg.netstack)
+
+
+def consensus_fused_impl(cfg: Config) -> "str | None":
+    """Resolve the one-kernel-epoch arm at trace time: the concrete
+    fused impl name when :attr:`Config.consensus_impl` names it AND the
+    fault plan is kernel-compatible, else None.
+
+    ``corrupt_p > 0`` plans return None — the documented fallback to
+    the stacked XLA reference arm: the corruption noise draw's bits are
+    fusion-context-dependent (the erfinv tail FMA-fuses into whatever
+    consumes it) and the ``(N, n_in, P)`` noise is n_in-fold the block,
+    so the kernel's traffic win is structurally halved there anyway
+    (ops/pallas_consensus.py). Time-varying graphs never reach here
+    (Config rejects them with the fused impls).
+    """
+    if cfg.consensus_impl not in FUSED_CONSENSUS_IMPLS:
+        return None
+    from rcmarl_tpu.ops.pallas_consensus import kernel_compatible_plan
+
+    if not kernel_compatible_plan(cfg.fault_plan):
+        return None
+    return cfg.consensus_impl
 
 
 def fitstack_enabled(cfg: Config) -> bool:
@@ -123,7 +152,10 @@ def fitstack_enabled(cfg: Config) -> bool:
     costs FLOPs a serial core cannot hide — PERF.md "fitstack /
     bf16"). Outputs are pinned leaf-for-leaf bitwise either way
     (tests/test_fitstack_properties.py), so the policy is purely a
-    speed choice."""
+    speed choice. The fit-scan kernel values ('pallas' /
+    'pallas_interpret', config.FITSTACK_IMPLS) are truthy — they imply
+    the fused row stacking and additionally route the scan through
+    ops/pallas_fit (``agents.updates.fitstack_impl``)."""
     if cfg.fitstack == "auto":
         return jax.default_backend() == "tpu"
     return bool(cfg.fitstack)
@@ -341,6 +373,29 @@ def _fit_block(cfg: Config, carry, batch: Batch, r_coop, ekey,
 fit_block = partial(jax.jit, static_argnums=0)(_fit_block)
 
 
+def _consensus_block(cfg: Config, carry, batch: Batch, ekey: jax.Array):
+    """The phase-II consensus as a standalone jitted program on the
+    stacked pair layout: the carry nets double as the transmitted
+    messages AND the stale-replay source (message content never changes
+    the compiled program, so the cost/retrace view is exact). Runs
+    whichever arm the config resolves — the one-kernel Pallas program
+    or the stacked XLA reference — through the same
+    :func:`_pair_phase2` the epoch inlines; registered in
+    ``utils/profiling.py:jit_entry_points`` so the lint cost/retrace
+    audits and ``profile --consensus_micro`` drive the fused phase II
+    standalone (the one-kernel analogue of :data:`fit_block`)."""
+    critic, tr, _ = carry
+    x2 = netstack_pair_inputs(cfg, batch.s, batch.sa)
+    cons_c, cons_t, _ = _pair_phase2(
+        cfg, critic, tr, critic, tr, critic, tr, x2, batch.mask, ekey
+    )
+    return cons_c, cons_t
+
+
+#: Standalone jitted phase-II entry point (fused or XLA per config).
+consensus_block = partial(jax.jit, static_argnums=0)(_consensus_block)
+
+
 def critic_tr_epoch(
     cfg: Config,
     carry,
@@ -376,6 +431,10 @@ def critic_tr_epoch(
     this epoch.
     """
     if netstack_enabled(cfg):
+        # True for the one-kernel consensus impls regardless of the
+        # netstack policy: the fused epoch consumes the combined pair
+        # block (netstack_enabled docstring; Config rejects
+        # netstack=False with them)
         return _critic_tr_epoch_netstack(
             cfg, carry, batch, r_coop, ekey, spec, with_diag, graph
         )
@@ -575,6 +634,22 @@ def _pair_segments(msg_c, msg_t):
     return tuple(segs)
 
 
+def _pair_trunk_split(segments):
+    """(n_trunk, tree_split) of a :func:`_pair_segments` tuple: the
+    column where the four head rows begin (the kernel/tail boundary)
+    and the column where the TR trunk begins (the per-tree fault-mask
+    boundary; equals ``n_trunk`` for head-only nets). THE one place
+    that owns the 'four head segments last' layout invariant — the
+    fused epoch, the cost-gate programs, and the tests all read it
+    here."""
+    n_trunk = segments[-4][2]
+    split = next(
+        (off for t, _, off, _ in segments[:-4] if t == _FAULT_TREE_TR),
+        n_trunk,
+    )
+    return n_trunk, split
+
+
 def _pair_block(msg_c, msg_t):
     """Ravel the two message trees into ONE (N, P_critic + P_tr) block,
     columns trunks-first (the layout
@@ -582,6 +657,179 @@ def _pair_block(msg_c, msg_t):
     pair = ((msg_c[:-1], msg_t[:-1]), (msg_c[-1], msg_t[-1]))
     flat, _ = ravel_neighbor_tree(pair)
     return flat
+
+
+def _pair_phase2(
+    cfg: Config,
+    own_c,
+    own_t,
+    msg_c,
+    msg_t,
+    carry_c,
+    carry_t,
+    x2,
+    mask,
+    ekey: jax.Array,
+    spec: CellSpec | None = None,
+    with_diag: bool = False,
+    graph=None,
+):
+    """Phase II on the combined pair layout, for ALL agents: gather ->
+    transport faults -> trunk consensus -> projection -> team head
+    step, returning ``(cons_c, cons_t, diag)`` (role masking stays with
+    the caller). TWO arms share this entry:
+
+    - the stacked XLA arm (the bitwise reference): one combined
+      ``(N, n_in, P_critic + P_tr)`` gathered block through
+      ``apply_link_faults_flat`` and the vmapped
+      :func:`~rcmarl_tpu.agents.updates.consensus_update_pair`;
+    - the ONE-KERNEL arm (``consensus_impl='pallas_fused'`` /
+      ``'..._interpret'``, resolved by :func:`consensus_fused_impl`):
+      the trunk columns never materialize a gathered block — the
+      VMEM-resident kernel
+      (:func:`rcmarl_tpu.ops.pallas_consensus.fused_pair_consensus`)
+      reads the stacked messages once and emits the post-consensus
+      trunk tile; only the tiny ``2(h+1)``-column head block is
+      gathered and faulted XLA-side (bitwise: per-segment fault streams
+      are independent, and gather commutes with the column slice), and
+      the projection/head tail runs as XLA with ``impl='xla'``
+      (:func:`~rcmarl_tpu.agents.updates.consensus_pair_tail`).
+
+    ``with_diag`` on the fused arm materializes the gathered block ONCE
+    for the fault counters alone — the guarded trainer is a diagnostic
+    mode and pays the reference arm's gather traffic for its per-link
+    view; the hot path never does.
+
+    Also the body of the standalone :data:`consensus_block` entry point
+    (the lint cost/retrace arms and ``profile --consensus_micro`` drive
+    the exact phase-II program of the active arm through it).
+    """
+    traced = spec is not None
+    _, valid_pad = cfg.padded_in_nodes()
+    if graph is not None:
+        valid_pad = None  # time-varying graphs are regular
+    if traced and valid_pad is not None:
+        raise ValueError(
+            "the fused-matrix path (traced CellSpec) requires a "
+            "uniform-degree graph; this config pads ragged "
+            "neighborhoods"
+        )
+    H = spec.H if traced else None
+    plan = cfg.fault_plan
+    active = plan is not None and plan.active
+    diag = zero_diag() if with_diag else None
+    fused = consensus_fused_impl(cfg)
+    fused_family = cfg.consensus_impl in FUSED_CONSENSUS_IMPLS
+    valid_arr = (
+        None if valid_pad is None else jnp.asarray(np.array(valid_pad))
+    )
+
+    def xla_gathered_block():
+        """The reference arm's faulted gathered block (also the fused
+        arm's diagnostics-only view)."""
+        nbr = gather_neighbor_messages(cfg, _pair_block(msg_c, msg_t), graph)
+        if active:
+            fkey = jax.random.fold_in(ekey, _FAULT_STREAM)
+            if float(plan.stale_p) > 0.0:
+                stale = gather_neighbor_messages(
+                    cfg, _pair_block(carry_c, carry_t), graph
+                )
+            else:
+                stale = nbr
+            nbr = apply_link_faults_flat(
+                fkey, nbr, stale, plan, _pair_segments(msg_c, msg_t)
+            )
+        return nbr
+
+    if fused is not None and graph is None:
+        from rcmarl_tpu.ops.pallas_consensus import (
+            draw_fault_fields,
+            fused_pair_consensus,
+            head_segments,
+        )
+
+        segs = _pair_segments(msg_c, msg_t)
+        n_trunk, split = _pair_trunk_split(segs)
+        pair = _pair_block(msg_c, msg_t)
+        in_pad, _ = cfg.padded_in_nodes()
+        fkey = fields = stale_pair = None
+        if active:
+            fkey = jax.random.fold_in(ekey, _FAULT_STREAM)
+            fields = draw_fault_fields(
+                fkey, plan, cfg.n_agents, cfg.n_in, segs
+            )
+            if float(plan.stale_p) > 0.0:
+                stale_pair = _pair_block(carry_c, carry_t)
+        H_k = H if traced else cfg.H
+        agg = None
+        if n_trunk:
+            agg = fused_pair_consensus(
+                pair[:, :n_trunk],
+                H_k,
+                in_nodes=in_pad,
+                tree_split=split,
+                valid=valid_pad,
+                sanitize=cfg.consensus_sanitize,
+                plan=plan if active else None,
+                stale=None if stale_pair is None else stale_pair[:, :n_trunk],
+                fields=fields,
+                interpret=fused == "pallas_fused_interpret",
+            )
+        head = gather_neighbor_messages(cfg, pair[:, n_trunk:])
+        if active:
+            stale_head = (
+                head
+                if stale_pair is None
+                else gather_neighbor_messages(cfg, stale_pair[:, n_trunk:])
+            )
+            head = apply_link_faults_flat(
+                fkey, head, stale_head, plan, head_segments(segs, n_trunk)
+            )
+        if with_diag:
+            diag = fault_diagnostics(
+                xla_gathered_block(), H if traced else cfg.H, valid_arr
+            )
+        if valid_pad is None:
+            cons = jax.vmap(
+                lambda oc, ot, at, hb: consensus_pair_tail(
+                    oc, ot, at, hb, x2, mask, cfg, H=H, impl="xla"
+                ),
+                in_axes=(0, 0, None if agg is None else 0, 0),
+            )
+        else:
+            cons_v = jax.vmap(
+                lambda oc, ot, at, hb, va: consensus_pair_tail(
+                    oc, ot, at, hb, x2, mask, cfg, valid=va, H=H, impl="xla"
+                ),
+                in_axes=(0, 0, None if agg is None else 0, 0, 0),
+            )
+            cons = lambda oc, ot, at, hb: cons_v(oc, ot, at, hb, valid_arr)
+        cons_c, cons_t = cons(own_c, own_t, agg, head)
+        return cons_c, cons_t, diag
+
+    nbr = xla_gathered_block()
+    if with_diag:
+        diag = fault_diagnostics(nbr, H if traced else cfg.H, valid_arr)
+    # the fused-family fallback (corrupt_p > 0) stays on the stacked
+    # XLA reference arm explicitly, whatever name the config carries
+    impl_override = "xla" if fused_family else None
+    if valid_pad is None:
+        cons = jax.vmap(
+            lambda oc, ot, blk: consensus_update_pair(
+                oc, ot, blk, x2, mask, cfg, H=H, impl=impl_override
+            ),
+            in_axes=(0, 0, 0),
+        )
+    else:
+        cons_v = jax.vmap(
+            lambda oc, ot, blk, v: consensus_update_pair(
+                oc, ot, blk, x2, mask, cfg, valid=v, H=H, impl=impl_override
+            ),
+            in_axes=(0, 0, 0, 0),
+        )
+        cons = lambda oc, ot, blk: cons_v(oc, ot, blk, valid_arr)
+    cons_c, cons_t = cons(own_c, own_t, nbr)
+    return cons_c, cons_t, diag
 
 
 def _critic_tr_epoch_netstack(
@@ -714,56 +962,17 @@ def _critic_tr_epoch_netstack(
             msg_t = adaptive_payload_tree(
                 msg_t, cmask, amask, cfg.adaptive_scale
             )
-        _, valid_pad = cfg.padded_in_nodes()
-        if graph is not None:
-            valid_pad = None  # time-varying graphs are regular
-        if traced and valid_pad is not None:
-            raise ValueError(
-                "the fused-matrix path (traced CellSpec) requires a "
-                "uniform-degree graph; this config pads ragged "
-                "neighborhoods"
-            )
-        H = spec.H if traced else None
-        nbr = gather_neighbor_messages(cfg, _pair_block(msg_c, msg_t), graph)
-        plan = cfg.fault_plan
-        if plan is not None and plan.active:
-            # Transport boundary on the combined block: per-tree masks /
-            # noise streams identical to the dual arm's two calls, and
-            # the stale-replay gather only happens when the stale branch
-            # is live (same gating as the dual arm).
-            fkey = jax.random.fold_in(ekey, _FAULT_STREAM)
-            if float(plan.stale_p) > 0.0:
-                stale = gather_neighbor_messages(
-                    cfg, _pair_block(critic, tr), graph
-                )
-            else:
-                stale = nbr
-            nbr = apply_link_faults_flat(
-                fkey, nbr, stale, plan, _pair_segments(msg_c, msg_t)
-            )
+        # Transport boundary + consensus on the combined block, shared
+        # with the standalone ``consensus_block`` entry — the stacked
+        # XLA arm or the one-kernel Pallas arm per the resolved impl
+        # (:func:`_pair_phase2`; per-tree fault streams identical to
+        # the dual arm's two calls either way).
+        cons_c, cons_t, diag2 = _pair_phase2(
+            cfg, new_critic, new_tr, msg_c, msg_t, critic, tr,
+            x2, mask, ekey, spec, with_diag, graph,
+        )
         if with_diag:
-            H_diag = H if traced else cfg.H
-            valid_diag = (
-                None if valid_pad is None else jnp.asarray(np.array(valid_pad))
-            )
-            diag = fault_diagnostics(nbr, H_diag, valid_diag)
-        if valid_pad is None:
-            cons = jax.vmap(
-                lambda oc, ot, blk: consensus_update_pair(
-                    oc, ot, blk, x2, mask, cfg, H=H
-                ),
-                in_axes=(0, 0, 0),
-            )
-        else:
-            valid_arr = jnp.asarray(np.array(valid_pad))  # (N, n_in)
-            cons_v = jax.vmap(
-                lambda oc, ot, blk, v: consensus_update_pair(
-                    oc, ot, blk, x2, mask, cfg, valid=v
-                ),
-                in_axes=(0, 0, 0, 0),
-            )
-            cons = lambda oc, ot, blk: cons_v(oc, ot, blk, valid_arr)
-        cons_c, cons_t = cons(new_critic, new_tr, nbr)
+            diag = diag2
         m = spec.coop if traced else _role_mask(cfg, Roles.COOPERATIVE)
         new_critic = select_tree(m, cons_c, new_critic)
         new_tr = select_tree(m, cons_t, new_tr)

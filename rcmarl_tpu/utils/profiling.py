@@ -54,6 +54,7 @@ def jit_entry_points() -> Dict[str, object]:
     from rcmarl_tpu.serve.engine import actor_block, eval_block, serve_block
     from rcmarl_tpu.training.trainer import train_block, train_block_donated
     from rcmarl_tpu.training.update import (
+        consensus_block,
         fit_block,
         update_block,
         update_block_donated,
@@ -66,6 +67,7 @@ def jit_entry_points() -> Dict[str, object]:
         "train_block_donated": train_block_donated,
         "gossip_mix_block": gossip_mix_block,
         "fit_block": fit_block,
+        "consensus_block": consensus_block,
         "serve_block": serve_block,
         "eval_block": eval_block,
         "actor_block": actor_block,
@@ -293,6 +295,11 @@ def lowered_entry_points(
                         team_average_reward(cfg, batch.r),
                         key,
                     )
+                elif name == "consensus_block":
+                    p = state.params
+                    lowered = fn.lower(
+                        cfg, (p.critic, p.tr, p.critic_local), batch, key
+                    )
                 elif name.startswith("update_block"):
                     lowered = fn.lower(
                         cfg,
@@ -387,6 +394,12 @@ def _traced_entry(cfg, with_diag: bool, name: str):
                 team_average_reward(cfg, batch.r),
                 key,
             )
+        elif name == "consensus_block":
+            p = state.params
+            closed, out_shape = jax.make_jaxpr(
+                lambda c, b, k: fn(cfg, c, b, k),
+                return_shape=True,
+            )((p.critic, p.tr, p.critic_local), batch, key)
         elif name.startswith("update_block"):
             closed, out_shape = jax.make_jaxpr(
                 lambda p, b, f, k: fn(cfg, p, b, f, k, with_diag=with_diag),
@@ -569,7 +582,12 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
     - ``consensus`` — the full phase-II update of BOTH nets as the
       epoch runs it: with ``cfg.netstack`` one fused
       critic+TR pair update on the combined block, otherwise the two
-      per-tree vmapped updates back to back.
+      per-tree vmapped updates back to back. Under the ONE-KERNEL arm
+      (``consensus_impl='pallas_fused*'``) this is the standalone
+      ``consensus_block`` program — fault-field draw + VMEM-resident
+      kernel + XLA tail — and ``gather`` is an honest 0.0 (the gather
+      happens in-register inside this number), so the fused arm's rows
+      attribute per phase exactly as it launches.
     - ``fit_coop`` / ``fit_adv`` — the phase-I local fits that produce
       the messages, PER FLAVOR FAMILY and as the active fit arm runs
       them (``cfg.fitstack`` fused scans, the netstack pair fits, or
@@ -622,6 +640,9 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
         team_average_reward,
     )
 
+    from rcmarl_tpu.config import FUSED_CONSENSUS_IMPLS
+    from rcmarl_tpu.training.update import consensus_block
+
     if state is None:
         state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
     env = make_env(cfg)
@@ -633,15 +654,23 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
     critic, tr = state.params.critic, state.params.tr
     out: Dict[str, float] = {}
 
-    stacked = netstack_enabled(cfg)
+    fused_family = cfg.consensus_impl in FUSED_CONSENSUS_IMPLS
+    stacked = netstack_enabled(cfg)  # True whenever fused_family is
     # the neighbor-message gather AS THE ARM PAYS IT: one combined
     # (N, n_in, P_c + P_t) block gather on the netstack arm, the two
     # per-tree gathers on the dual arm — so epoch_other below is a true
-    # residual rather than silently holding half the gather traffic
-    if stacked:
+    # residual rather than silently holding half the gather traffic.
+    # Under the ONE-KERNEL arm there is no separate gather launch at
+    # all (the kernel reads the stacked messages in-register), so the
+    # key is an honest 0.0 and the whole gather+fault+trim chain is
+    # attributed to ``consensus`` below.
+    if fused_family:
+        out["gather"] = 0.0
+    elif stacked:
         gather_arm = jax.jit(
             lambda c, t: gather_neighbor_messages(cfg, _pair_block(c, t))
         )
+        out["gather"] = _timeit(gather_arm, critic, tr, reps=reps)
     else:
         gather_arm = jax.jit(
             lambda c, t: (
@@ -649,7 +678,7 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
                 gather_neighbor_messages(cfg, t),
             )
         )
-    out["gather"] = _timeit(gather_arm, critic, tr, reps=reps)
+        out["gather"] = _timeit(gather_arm, critic, tr, reps=reps)
     gather = jax.jit(lambda t: gather_neighbor_messages(cfg, t))
     nbr = gather(
         critic
@@ -687,7 +716,18 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
 
     mask = batch.mask
     x2 = netstack_pair_inputs(cfg, batch.s, batch.sa)
-    if stacked:
+    if fused_family:
+        # phase II as the ONE-KERNEL arm runs it: the standalone
+        # consensus_block entry (fault-field draw + VMEM-resident
+        # kernel + XLA projection/head tail) — gather and fault
+        # injection live INSIDE this number, matching the arm's real
+        # launch structure, so epoch_other stays a true residual
+        loc = state.params.critic_local
+        out["consensus"] = _timeit(
+            lambda c, t, l: consensus_block(cfg, (c, t, l), batch, key),
+            critic, tr, loc, reps=reps,
+        )
+    elif stacked:
         # phase II as the netstack epoch runs it: ONE fused pair update
         # over the combined (N, n_in, P_c + P_t) gathered block
         pair_nbr = gather(_pair_block(critic, tr))

@@ -141,6 +141,49 @@ print("finite bf16 curves OK")
 PY
 echo "fused-fit + bf16 smoke cell OK"
 
+# One-kernel-epoch smoke cell: the fused Pallas phase II
+# (consensus_impl=pallas_fused_interpret) + the fit-scan kernel
+# (fitstack=pallas_interpret) must stay BITWISE the stacked XLA arm
+# through the real trainer on the ragged+faulted+sanitize mixed cell —
+# the acceptance wire-up (Config -> epoch -> kernel -> tail ->
+# trainer), carried here EVERY CI run while the wider equivalence
+# matrix rides the slow marker (tests/test_fused_epoch.py) per the
+# tier-1 budget pattern — plus the CLI flag plumbing end to end.
+timeout -k 10 420 env JAX_PLATFORMS=cpu python - <<'PY'
+import numpy as np, jax
+from rcmarl_tpu.config import Config, Roles
+from rcmarl_tpu.faults import FaultPlan
+from rcmarl_tpu.training.trainer import train
+
+kw = dict(
+    n_agents=4,
+    agent_roles=(Roles.COOPERATIVE,) * 2 + (Roles.GREEDY, Roles.MALICIOUS),
+    in_nodes=((0, 1, 2, 3), (1, 2, 3, 0), (2, 3, 0), (3, 0, 1)),
+    nrow=3, ncol=3,
+    n_episodes=4, n_ep_fixed=2, max_ep_len=4, n_epochs=2, H=1,
+    netstack=True, consensus_sanitize=True,
+    fault_plan=FaultPlan(drop_p=0.2, nan_p=0.2, stale_p=0.1),
+)
+s_x, df_x = train(Config(**kw, consensus_impl="xla", fitstack=True))
+s_f, df_f = train(Config(
+    **kw, consensus_impl="pallas_fused_interpret",
+    fitstack="pallas_interpret",
+))
+for a, b in zip(jax.tree.leaves(s_x.params), jax.tree.leaves(s_f.params)):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+np.testing.assert_array_equal(
+    df_x["True_team_returns"].values, df_f["True_team_returns"].values
+)
+print("one-kernel epoch bitwise pin OK (ragged+faulted+sanitize)")
+PY
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
+    --n_agents 3 --in_degree 3 --nrow 3 --ncol 3 \
+    --n_episodes 4 --n_ep_fixed 2 --max_ep_len 4 --n_epochs 2 --H 1 \
+    --consensus_impl pallas_fused_interpret --fitstack pallas_interpret \
+    --netstack on --fault_drop_p 0.2 --fault_nan_p 0.2 --sanitize \
+    --summary_dir "$smoke_dir" --quiet
+echo "one-kernel epoch smoke cell OK"
+
 # Gossip chaos cell: 4 learner replicas, one ALWAYS-NaN-bombing
 # Byzantine replica (replica 3) under trimmed-mean gossip (gossip_H=1)
 # with the per-replica guard — the replica-level resilience wire-up end

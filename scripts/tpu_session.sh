@@ -124,6 +124,25 @@ run_step "8. env-zoo on-chip bench arms (pursuit/coverage/congestion)" \
     --configs n16_ring n64_ring --env pursuit coverage congestion \
     --n_ep_fixed 10 --blocks 3 --reps 3 --out PERF.jsonl
 
+# The one-kernel epoch (PR 13): the committed pins are interpret-mode
+# (headline:false) and the AUDIT.jsonl bytes gate is the BlockSpec DMA
+# model — this is the REAL-LOWERING refit: (9) fused-vs-two-launch
+# epoch A/B (consensus_impl pallas_fused vs xla/pallas at the dense
+# shapes, rows tagged with the resolved impl + cost_fingerprint), and
+# (9b) the fit-scan kernel arm vs the XLA scan (fitstack pallas vs on).
+# These rows are what lets 'auto' adopt the fused arms with a measured
+# crossover instead of a CPU guess.
+run_step "9. one-kernel epoch refit (pallas_fused vs two-launch, on-chip)" \
+    timeout 3600 python -m rcmarl_tpu bench \
+    --configs n16_full n64_full n64_large_h2 \
+    --impl xla pallas pallas_fused \
+    --n_ep_fixed 10 --blocks 3 --reps 3 --out BENCH_SCALING.jsonl
+
+run_step "9b. fit-scan kernel refit (fitstack pallas vs scan, on-chip)" \
+    timeout 3600 python -m rcmarl_tpu profile \
+    --configs n16_mixed n64_full \
+    --fitstack on pallas --consensus_micro --out PERF.jsonl
+
 echo "== session summary =="
 rc=0
 for name in "${step_order[@]}"; do

@@ -1,0 +1,634 @@
+"""One-kernel epoch (ISSUE 13): the fused phase-II Pallas consensus and
+the fit-scan kernel vs their XLA reference arms.
+
+The bitwise contract (interpret mode on this CPU host): the SANITIZE
+matrix — {regular, ragged} x {clean, drop/NaN/stale/flip/inf faulted} x
+{H=0, H>0, traced H} x mixed casts — is pinned leaf-for-leaf BITWISE
+against ``consensus_impl='xla'``; plain (sanitize-off) cells keep the
+leaf kernel's historical allclose-at-f32-rounding contract (the
+``jnp.mean`` epilogue's bits are XLA-fusion-context-dependent — see
+ops/pallas_consensus.py). ``corrupt_p > 0`` plans are the documented
+fallback to the stacked XLA arm and must be bitwise trivially. The
+fit-scan kernel's fitted rows are pinned bitwise against the XLA scan
+for every schedule shape. Real lowerings ride the queued TPU session;
+the HBM-traffic claim is carried by the AUDIT.jsonl
+``consensus_trunk``/``fit_scan`` rows (tests below + ``lint --cost``).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+from rcmarl_tpu.faults import FaultPlan, apply_link_faults_flat
+from rcmarl_tpu.models.mlp import init_stacked_mlp
+from rcmarl_tpu.ops.aggregation import resilient_aggregate
+from rcmarl_tpu.ops.pallas_consensus import (
+    draw_fault_fields,
+    fused_pair_consensus,
+    kernel_compatible_plan,
+)
+from rcmarl_tpu.training.update import (
+    _pair_block,
+    _pair_segments,
+    _pair_trunk_split,
+)
+
+RAGGED = ((0, 1, 2, 3), (1, 2, 3, 0), (2, 3, 0), (3, 0, 1))
+
+
+def _setup(n_agents=3, in_nodes=None, hidden=(4, 4)):
+    cfg = Config(
+        n_agents=n_agents,
+        agent_roles=(Roles.COOPERATIVE,) * n_agents,
+        in_nodes=in_nodes or circulant_in_nodes(n_agents, n_agents),
+        nrow=3,
+        ncol=3,
+        H=0,
+    )
+    critic = init_stacked_mlp(
+        jax.random.PRNGKey(0), n_agents, cfg.obs_dim, hidden, 1
+    )
+    tr = init_stacked_mlp(
+        jax.random.PRNGKey(1), n_agents, cfg.sa_dim, hidden, 1
+    )
+    return cfg, critic, tr
+
+
+def _run_pair(cfg, critic, tr, plan, sanitize, H):
+    """(xla reference, fused kernel) trunk aggregates, both jitted —
+    the pin target is jitted-program vs jitted-program (the epoch's
+    real comparison), not eager dispatch."""
+    segs = _pair_segments(critic, tr)
+    n_trunk, split = _pair_trunk_split(segs)
+    pair = _pair_block(critic, tr)
+    carry = _pair_block(
+        jax.tree.map(lambda l: l * 0.7, critic),
+        jax.tree.map(lambda l: l * 0.7, tr),
+    )
+    in_arr, valid = cfg.padded_in_nodes()
+    in_np = jnp.asarray(np.asarray(in_arr))
+    valid_np = None if valid is None else jnp.asarray(np.asarray(valid))
+    fkey = jax.random.PRNGKey(99)
+    N, n_in = cfg.n_agents, cfg.n_in
+    active = plan is not None and plan.active
+    stale_live = active and float(plan.stale_p) > 0.0
+
+    @jax.jit
+    def ref(pair, carry, fkey):
+        nbr = pair[in_np][:, :, :n_trunk]
+        if active:
+            snbr = carry[in_np][:, :, :n_trunk] if stale_live else nbr
+            tsegs = tuple(s for s in segs if s[2] < n_trunk)
+            nbr = apply_link_faults_flat(fkey, nbr, snbr, plan, tsegs)
+        if valid_np is None:
+            return jax.vmap(
+                lambda v: resilient_aggregate(
+                    v, H, "xla", n_agents=N, sanitize=sanitize
+                )
+            )(nbr)
+        return jax.vmap(
+            lambda v, va: resilient_aggregate(
+                v, H, "xla", valid=va, n_agents=N, sanitize=sanitize
+            )
+        )(nbr, valid_np)
+
+    @jax.jit
+    def fused(pair, carry, fkey):
+        fields = (
+            draw_fault_fields(fkey, plan, N, n_in, segs) if active else None
+        )
+        return fused_pair_consensus(
+            pair[:, :n_trunk],
+            H,
+            in_nodes=in_arr,
+            tree_split=split,
+            valid=valid,
+            sanitize=sanitize,
+            plan=plan if active else None,
+            stale=carry[:, :n_trunk] if stale_live else None,
+            fields=fields,
+            interpret=True,
+        )
+
+    return np.asarray(ref(pair, carry, fkey)), np.asarray(
+        fused(pair, carry, fkey)
+    )
+
+
+FAULTED = FaultPlan(drop_p=0.3, nan_p=0.2, stale_p=0.2, flip_p=0.2, inf_p=0.2)
+
+
+class TestFusedConsensusKernel:
+    @pytest.mark.parametrize(
+        "plan,H",
+        [
+            (None, 0),
+            (None, 1),
+            (FAULTED, 1),
+            (FaultPlan(stale_p=0.5), 0),
+        ],
+    )
+    def test_sanitize_matrix_bitwise_regular(self, plan, H):
+        cfg, critic, tr = _setup()
+        want, got = _run_pair(cfg, critic, tr, plan, True, H)
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("plan", [None, FAULTED])
+    def test_sanitize_matrix_bitwise_ragged(self, plan):
+        cfg, critic, tr = _setup(4, RAGGED)
+        want, got = _run_pair(cfg, critic, tr, plan, True, 1)
+        np.testing.assert_array_equal(got, want)
+
+    def test_traced_h_bitwise(self):
+        cfg, critic, tr = _setup()
+        want, got = _run_pair(
+            cfg, critic, tr, FaultPlan(drop_p=0.3), True,
+            jnp.asarray(1, jnp.int32),
+        )
+        np.testing.assert_array_equal(got, want)
+        want, got = _run_pair(
+            cfg, critic, tr, None, False, jnp.asarray(1, jnp.int32)
+        )
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    @pytest.mark.parametrize("H", [0, 1])
+    def test_plain_cells_allclose(self, H):
+        """The sanitize-off contract is the leaf kernel's historical
+        one: allclose at f32 rounding (the jnp.mean epilogue's bits are
+        fusion-context-dependent), never bitwise-required."""
+        cfg, critic, tr = _setup()
+        want, got = _run_pair(cfg, critic, tr, None, False, H)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_masked_plain_allclose(self):
+        cfg, critic, tr = _setup(4, RAGGED)
+        want, got = _run_pair(cfg, critic, tr, None, False, 1)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    @pytest.mark.slow
+    def test_multi_tile_wide_block(self):
+        """> 1 grid tile (wide trunks) under faults + sanitize + H=2."""
+        cfg, critic, tr = _setup(5, circulant_in_nodes(5, 5), hidden=(32, 32))
+        want, got = _run_pair(
+            cfg, critic, tr, FaultPlan(drop_p=0.2, stale_p=0.3, inf_p=0.1),
+            True, 2,
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_corrupt_plan_rejected_by_kernel(self):
+        cfg, critic, tr = _setup()
+        with pytest.raises(ValueError, match="corrupt_p"):
+            _run_pair(cfg, critic, tr, FaultPlan(corrupt_p=0.5), True, 1)
+        assert not kernel_compatible_plan(FaultPlan(corrupt_p=0.5))
+        assert kernel_compatible_plan(FAULTED)
+        assert kernel_compatible_plan(None)
+
+
+class TestFusedEpoch:
+    """Epoch-level pins: consensus_impl='pallas_fused_interpret' vs
+    'xla' through the REAL epoch program (phase I + II), leaf for
+    leaf."""
+
+    KW = dict(
+        n_agents=3,
+        agent_roles=(Roles.COOPERATIVE, Roles.COOPERATIVE, Roles.GREEDY),
+        in_nodes=circulant_in_nodes(3, 3),
+        H=1,
+        nrow=3,
+        ncol=3,
+        max_ep_len=4,
+        n_ep_fixed=2,
+        n_epochs=2,
+        buffer_size=16,
+        hidden=(8, 8),
+        coop_fit_steps=1,
+        adv_fit_epochs=2,
+        adv_fit_batch=4,
+        batch_size=4,
+        n_episodes=4,
+        netstack=True,
+        consensus_sanitize=True,
+        fault_plan=FaultPlan(drop_p=0.2, nan_p=0.1, stale_p=0.2),
+    )
+
+    @staticmethod
+    def _epoch_inputs(cfg):
+        from rcmarl_tpu.training.buffer import update_batch
+        from rcmarl_tpu.training.rollout import rollout_block
+        from rcmarl_tpu.training.trainer import init_train_state, make_env
+        from rcmarl_tpu.training.update import team_average_reward
+
+        state = init_train_state(cfg, jax.random.PRNGKey(0))
+        env = make_env(cfg)
+        key = jax.random.PRNGKey(3)
+        fresh, _ = jax.jit(
+            lambda s, k: rollout_block(
+                cfg, env, s.params, s.desired, k, s.initial
+            )
+        )(state, key)
+        batch = jax.jit(update_batch)(state.buffer, fresh)
+        return state, batch, team_average_reward(cfg, batch.r), key
+
+    def _pin_epoch(self, kw, spec_from=None):
+        from rcmarl_tpu.training.update import critic_tr_epoch, spec_from_config
+
+        cfg_x = Config(**kw, consensus_impl="xla")
+        cfg_f = Config(**kw, consensus_impl="pallas_fused_interpret")
+        state, batch, r_coop, key = self._epoch_inputs(cfg_x)
+        carry = (
+            state.params.critic,
+            state.params.tr,
+            state.params.critic_local,
+        )
+        outs = []
+        for cfg in (cfg_x, cfg_f):
+            spec = spec_from_config(cfg) if spec_from else None
+            outs.append(
+                jax.jit(
+                    lambda c, b, rc, k, cfg=cfg, spec=spec: critic_tr_epoch(
+                        cfg, c, b, rc, k, spec
+                    )
+                )(carry, batch, r_coop, key)
+            )
+        for a, b in zip(jax.tree.leaves(outs[0]), jax.tree.leaves(outs[1])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.slow
+    def test_epoch_bitwise_faulted_sanitize_mixed(self):
+        self._pin_epoch(self.KW)
+
+    def test_epoch_bitwise_ragged(self):
+        kw = dict(self.KW)
+        kw.update(
+            n_agents=4,
+            agent_roles=(Roles.COOPERATIVE,) * 2
+            + (Roles.GREEDY, Roles.MALICIOUS),
+            in_nodes=RAGGED,
+        )
+        self._pin_epoch(kw)
+
+    @pytest.mark.slow
+    def test_epoch_bitwise_traced_spec(self):
+        self._pin_epoch(self.KW, spec_from=True)
+
+    @pytest.mark.slow
+    def test_epoch_bitwise_h0(self):
+        kw = dict(self.KW)
+        kw["H"] = 0
+        self._pin_epoch(kw)
+
+    @pytest.mark.slow
+    def test_corrupt_plan_falls_back_to_stacked_xla_bitwise(self):
+        kw = dict(self.KW)
+        kw["fault_plan"] = FaultPlan(corrupt_p=0.5, drop_p=0.2)
+        self._pin_epoch(kw)
+
+    def test_consensus_block_entry_bitwise(self):
+        from rcmarl_tpu.training.update import consensus_block
+
+        cfg_x = Config(**self.KW, consensus_impl="xla")
+        cfg_f = Config(**self.KW, consensus_impl="pallas_fused_interpret")
+        state, batch, _, key = self._epoch_inputs(cfg_x)
+        carry = (
+            state.params.critic,
+            state.params.tr,
+            state.params.critic_local,
+        )
+        a = consensus_block(cfg_x, carry, batch, key)
+        b = consensus_block(cfg_f, carry, batch, key)
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    @pytest.mark.slow
+    def test_train_block_bitwise_and_guarded_diag(self):
+        """Whole train blocks (rollout + epochs + actor + buffer) on
+        the fused arm, including the guarded with_diag path whose fault
+        counters come from the diagnostics-only gathered view."""
+        from rcmarl_tpu.training.trainer import init_train_state, train_block
+
+        cfg_x = Config(**self.KW, consensus_impl="xla")
+        cfg_f = Config(**self.KW, consensus_impl="pallas_fused_interpret")
+        s0 = init_train_state(cfg_x, jax.random.PRNGKey(0))
+        sx, mx = train_block(cfg_x, s0)
+        sf, mf = train_block(cfg_f, s0)
+        for a, b in zip(
+            jax.tree.leaves(sx.params), jax.tree.leaves(sf.params)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(
+            np.asarray(mx.true_team_returns), np.asarray(mf.true_team_returns)
+        )
+        from rcmarl_tpu.training.update import update_block
+
+        _, batch, _, key = self._epoch_inputs(cfg_x)
+        px, dx = update_block(
+            cfg_x, s0.params, batch, batch, key, with_diag=True
+        )
+        pf, df = update_block(
+            cfg_f, s0.params, batch, batch, key, with_diag=True
+        )
+        assert int(dx.nonfinite) == int(df.nonfinite)
+        assert int(dx.deficit) == int(df.deficit)
+
+
+class TestPallasFitScan:
+    def _rows(self, B=48):
+        from rcmarl_tpu.models.mlp import netstack_stack
+
+        W = 9
+        critic = init_stacked_mlp(jax.random.PRNGKey(0), 3, W, (6, 6), 1)
+        tr = init_stacked_mlp(jax.random.PRNGKey(1), 3, W, (6, 6), 1)
+        rows = netstack_stack(critic, tr)
+        keys = jnp.stack(
+            [
+                jax.random.split(jax.random.PRNGKey(5), 3),
+                jax.random.split(jax.random.PRNGKey(6), 3),
+            ]
+        )
+        x_rows = jax.random.normal(jax.random.PRNGKey(2), (2, B, W))
+        tgt = jax.random.normal(jax.random.PRNGKey(3), (2, 3, B, 1))
+        mask = (jnp.arange(B) < B - 10).astype(jnp.float32)
+        return rows, keys, x_rows, tgt, mask
+
+    @pytest.mark.parametrize(
+        "epochs,bs,shuffle,assume_valid",
+        [(3, 16, True, False), (4, 48, False, False), (2, 16, True, True)],
+    )
+    def test_fitted_rows_bitwise_vs_xla_scan(
+        self, epochs, bs, shuffle, assume_valid
+    ):
+        from rcmarl_tpu.models.mlp import mlp_forward
+        from rcmarl_tpu.ops.fit import FitSchedule, fused_fit_scan
+        from rcmarl_tpu.ops.pallas_fit import pallas_fit_scan
+
+        rows, keys, x_rows, tgt, mask = self._rows()
+        if assume_valid:
+            mask = jnp.ones_like(mask)
+        sched = FitSchedule(
+            epochs=epochs,
+            batch_size=bs,
+            shuffle=shuffle,
+            assume_valid=assume_valid,
+        )
+        fwd = lambda p, x: mlp_forward(p, x)
+        w_p, w_l = jax.jit(
+            lambda k, p, x, t, m: fused_fit_scan(
+                k, p, fwd, x, t, m, sched, 0.01
+            )
+        )(keys, rows, x_rows, tgt, mask)
+        g_p, g_l = jax.jit(
+            lambda k, p, x, t, m: pallas_fit_scan(
+                k, p, fwd, x, t, m, sched, 0.01, interpret=True
+            )
+        )(keys, rows, x_rows, tgt, mask)
+        for a, b in zip(jax.tree.leaves(w_p), jax.tree.leaves(g_p)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # the first-epoch loss is a logging value: allclose contract
+        np.testing.assert_allclose(
+            np.asarray(g_l), np.asarray(w_l), atol=1e-6
+        )
+
+    @pytest.mark.slow
+    def test_fitstack_pallas_epoch_bitwise(self):
+        """Config.fitstack='pallas_interpret' through the real trainer
+        (every adversary flavor live) vs the XLA fused scan."""
+        from rcmarl_tpu.training.trainer import init_train_state, train_block
+
+        kw = dict(TestFusedEpoch.KW)
+        kw.pop("fault_plan")
+        kw.pop("consensus_sanitize")
+        kw.update(
+            n_agents=4,
+            agent_roles=(Roles.COOPERATIVE,) * 2
+            + (Roles.GREEDY, Roles.MALICIOUS),
+            in_nodes=RAGGED,
+        )
+        cfg_x = Config(**kw, fitstack=True)
+        cfg_p = Config(**kw, fitstack="pallas_interpret")
+        s0 = init_train_state(cfg_x, jax.random.PRNGKey(1))
+        sx, _ = train_block(cfg_x, s0)
+        sp, _ = train_block(cfg_p, s0)
+        for a, b in zip(
+            jax.tree.leaves(sx.params), jax.tree.leaves(sp.params)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestOneRavelPath:
+    """Satellite: the pallas tree aggregation rides the ONE shared
+    ravel path of resilient_aggregate_tree (apply/one_block), so
+    per_leaf is an honest kernel comparison arm and mixed dtypes fall
+    back instead of crashing."""
+
+    def _tree(self, n_in=5):
+        ks = jax.random.split(jax.random.PRNGKey(3), 4)
+        return (
+            (
+                jax.random.normal(ks[0], (n_in, 6, 8)),
+                jax.random.normal(ks[1], (n_in, 8)),
+            ),
+            (
+                jax.random.normal(ks[2], (n_in, 8, 8)),
+                jax.random.normal(ks[3], (n_in, 8)),
+            ),
+        )
+
+    def test_flat_vs_per_leaf_bitwise_on_kernel(self):
+        from rcmarl_tpu.ops.aggregation import resilient_aggregate_tree
+
+        tree = self._tree()
+        flat = resilient_aggregate_tree(
+            tree, 1, impl="pallas_interpret", layout="flat"
+        )
+        per_leaf = resilient_aggregate_tree(
+            tree, 1, impl="pallas_interpret", layout="per_leaf"
+        )
+        for a, b in zip(jax.tree.leaves(flat), jax.tree.leaves(per_leaf)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_tree_entry_matches_shared_path(self):
+        from rcmarl_tpu.ops.aggregation import resilient_aggregate_tree
+        from rcmarl_tpu.ops.pallas_aggregation import (
+            fused_resilient_aggregate_tree,
+        )
+
+        tree = self._tree()
+        a = fused_resilient_aggregate_tree(tree, 1, interpret=True)
+        b = resilient_aggregate_tree(tree, 1, impl="pallas_interpret")
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_fused_alias_impls_accepted_at_leaf_level(self):
+        vals = jax.random.normal(jax.random.PRNGKey(0), (5, 40))
+        a = resilient_aggregate(vals, 1, impl="pallas_fused_interpret")
+        b = resilient_aggregate(vals, 1, impl="pallas_interpret")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestConfigSurface:
+    def test_fused_rejects_netstack_off(self):
+        with pytest.raises(ValueError, match="netstack"):
+            Config(consensus_impl="pallas_fused", netstack=False)
+
+    def test_fused_rejects_time_varying_graph(self):
+        with pytest.raises(ValueError, match="graph_schedule"):
+            Config(
+                consensus_impl="pallas_fused",
+                graph_schedule="random_geometric",
+                graph_degree=3,
+            )
+
+    def test_fitstack_kernel_values_accepted(self):
+        for v in ("pallas", "pallas_interpret"):
+            assert Config(fitstack=v).fitstack == v
+        with pytest.raises(ValueError, match="fitstack"):
+            Config(fitstack="pallas_nope")
+
+    def test_cli_fitstack_passthrough(self):
+        from rcmarl_tpu.cli import _netstack_value
+
+        assert _netstack_value("pallas") == "pallas"
+        assert _netstack_value("pallas_interpret") == "pallas_interpret"
+        assert _netstack_value("on") is True
+        assert _netstack_value("auto") == "auto"
+
+    def test_corrupt_plan_resolves_to_fallback(self):
+        from rcmarl_tpu.training.update import consensus_fused_impl
+
+        cfg = Config(
+            consensus_impl="pallas_fused_interpret",
+            fault_plan=FaultPlan(corrupt_p=0.5),
+        )
+        assert consensus_fused_impl(cfg) is None
+        assert (
+            consensus_fused_impl(
+                cfg.replace(fault_plan=FaultPlan(drop_p=0.5))
+            )
+            == "pallas_fused_interpret"
+        )
+
+
+@pytest.mark.slow
+class TestHBMLedgerGate:
+    """The ISSUE-13 acceptance invariant, runnable standalone: the
+    fused consensus entry's bytes_accessed strictly below the
+    two-launch arm's sum at equal (±1%) FLOPs (lint --cost re-derives
+    and gates this in CI every run)."""
+
+    def test_fused_gate_holds(self):
+        from rcmarl_tpu.lint.cost import (
+            FUSED_GATE_PAIRS,
+            fused_consensus_cost_rows,
+            fused_gate_findings,
+        )
+
+        rows, notes, skipped = fused_consensus_cost_rows()
+        assert fused_gate_findings(rows, skipped) == []
+        by = {r["entry"]: r for r in rows}
+        fused = by["consensus_trunk[pallas_fused]"]["metrics"]
+        two = by["consensus_trunk[two_launch]"]["metrics"]
+        assert fused["bytes_accessed"] < two["bytes_accessed"]
+        assert abs(fused["flops"] - two["flops"]) <= 0.01 * two["flops"]
+        assert by["consensus_trunk[pallas_fused]"]["bytes_model"] == (
+            "pallas-blockspec-dma"
+        )
+
+    def test_gate_fires_on_planted_regression(self):
+        from rcmarl_tpu.lint.cost import (
+            fused_consensus_cost_rows,
+            fused_gate_findings,
+        )
+
+        rows, _, skipped = fused_consensus_cost_rows()
+        for r in rows:
+            if r["entry"] == "consensus_trunk[pallas_fused]":
+                r["metrics"]["bytes_accessed"] = (
+                    1e12  # the kernel "lost" its traffic claim
+                )
+        findings = fused_gate_findings(rows, skipped)
+        assert any(f.rule == "cost-fused-gate" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# Hypothesis twins for the in-kernel trim/sanitize chain
+# ---------------------------------------------------------------------------
+
+try:
+    import hypothesis  # noqa: F401
+
+    _HAS_HYPOTHESIS = True
+except Exception:  # pragma: no cover
+    _HAS_HYPOTHESIS = False
+
+if _HAS_HYPOTHESIS:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+    from hypothesis.extra.numpy import arrays
+
+    finite = st.floats(-1e6, 1e6, allow_nan=False, width=32)
+
+    @st.composite
+    def poisoned_block_and_h(draw, n=3, m=24):
+        """A (N=3, P) message block + a per-link poison pattern applied
+        POST-GATHER semantics via an inf/nan fault... here: poison the
+        senders' columns directly (arbitrary NaN/±Inf payload content —
+        the diverged-neighbor case the sanitize kernel must absorb)."""
+        vals = draw(arrays(np.float32, (n, m), elements=finite))
+        poison = draw(arrays(np.int8, (n, m), elements=st.integers(0, 3)))
+        bombs = np.asarray([0.0, np.nan, np.inf, -np.inf], np.float32)
+        vals = np.where(poison > 0, bombs[poison], vals).astype(np.float32)
+        H = draw(st.integers(0, 1))
+        return vals, H
+
+    @settings(max_examples=25, deadline=None)
+    @given(poisoned_block_and_h())
+    def test_in_kernel_sanitize_chain_bitwise(case):
+        """±Inf sentinels, NaN payloads, and the degree-deficit
+        fallback: arbitrary non-finite message content through the
+        in-kernel gather + sanitize chain agrees BITWISE with the XLA
+        reference composition, and deficits keep the own value."""
+        vals, H = case
+        n, m = vals.shape
+        cfg = Config(
+            n_agents=n,
+            agent_roles=(Roles.COOPERATIVE,) * n,
+            in_nodes=circulant_in_nodes(n, n),
+            nrow=3,
+            ncol=3,
+            H=0,
+        )
+        in_arr, _ = cfg.padded_in_nodes()
+        in_np = jnp.asarray(np.asarray(in_arr))
+        msgs = jnp.asarray(vals)
+
+        @jax.jit
+        def ref(msgs):
+            nbr = msgs[in_np]
+            return jax.vmap(
+                lambda v: resilient_aggregate(
+                    v, H, "xla", n_agents=n, sanitize=True
+                )
+            )(nbr)
+
+        @jax.jit
+        def fused(msgs):
+            return fused_pair_consensus(
+                msgs,
+                H,
+                in_nodes=in_arr,
+                tree_split=m,
+                sanitize=True,
+                interpret=True,
+            )
+
+        want, got = np.asarray(ref(msgs)), np.asarray(fused(msgs))
+        np.testing.assert_array_equal(got, want)
+        # degree-deficit: where fewer than 2H+1 finite survive, the
+        # aggregate must BE the agent's own value (bit for bit)
+        gathered = vals[np.asarray(in_np)]
+        survivors = np.isfinite(gathered).sum(axis=1)
+        deficit = survivors < 2 * H + 1
+        own = vals
+        np.testing.assert_array_equal(got[deficit], own[deficit])

@@ -133,18 +133,21 @@ class TestRetraceAuditor:
         """The `lint --retrace` mode: guarded+faulted tiny runs on the
         dual and stacked (netstack+fitstack) arms plus a clean donated
         run compile nothing after their warmup block. The alternating
-        f32/bf16 fused-fit case rides the slow twin below and the CI
-        graftlint cell (tier-1 wall budget)."""
+        f32/bf16 fused-fit case AND the one-kernel-epoch case ride the
+        slow twin below and the CI graftlint cell (tier-1 wall
+        budget)."""
         from rcmarl_tpu.lint.retrace import audit_retrace
 
-        findings = audit_retrace(fitstack_dtypes=False)
+        findings = audit_retrace(fitstack_dtypes=False, fused_epoch=False)
         assert findings == [], "\n".join(str(f) for f in findings)
 
     @pytest.mark.slow
     def test_exactly_once_compilation_alternating_dtypes(self):
         """The full audit incl. the alternating f32/bf16 fused-fit
-        case: exactly one compile per compute_dtype, zero steady-state
-        recompiles across alternation."""
+        case (exactly one compile per compute_dtype, zero steady-state
+        recompiles across alternation) and the one-kernel-epoch case
+        (the fused Pallas phase II + fit-scan kernel compile exactly
+        once)."""
         from rcmarl_tpu.lint.retrace import audit_retrace
 
         findings = audit_retrace()
@@ -769,11 +772,11 @@ class TestBackendAudit:
 
     def test_audit_table_is_the_contract(self):
         """The audit iterates ops.aggregation.AUDIT_BACKEND_MODES —
-        pin the six-backend shape so a new backend must register."""
+        pin the backend-table shape so a new backend must register."""
         from rcmarl_tpu.ops.aggregation import AUDIT_BACKEND_MODES
 
         names = [name for name, _ in AUDIT_BACKEND_MODES]
         assert names == [
             "xla", "xla_sort", "masked", "traced_h",
-            "pallas_select", "pallas_sort",
+            "pallas_select", "pallas_sort", "pallas_fused",
         ]
