@@ -301,6 +301,80 @@ def _measure_pipeline(depth: int, blocks: int, reps: int) -> None:
     )
 
 
+def _probe_tpu(attempts: list) -> bool:
+    """THE probe: bounded-retry TPU contact with backoff, shared by
+    every orchestrated headline (train, serve, serve_load, pipeline).
+    Appends per-attempt records to ``attempts``; True only on a real
+    non-CPU backend (JAX can silently fall back to CPU instead of
+    raising, and a CPU "probe ok" must never trigger a full-size
+    measurement)."""
+    for i in range(PROBE_ATTEMPTS):
+        res = _run_child(["--probe"], {}, PROBE_TIMEOUT_S)
+        attempts.append({"stage": f"probe{i}", **res})
+        if res.get("probe") == "ok" and res.get("platform") != "cpu":
+            return True
+        if i + 1 < PROBE_ATTEMPTS:
+            time.sleep(BACKOFF_S * (2**i))
+    return False
+
+
+def _orchestrate_serve(
+    tpu_children, cpu_child, metric: str, unit: str, fallback_note: str
+) -> int:
+    """The ONE serve-family orchestration path (PR-10's discipline,
+    deduplicated): probe the TPU with bounded retries; on success run
+    each ``(stage, argv)`` TPU child isolated with a hard timeout and
+    print the best candidate (``headline: true``, full candidate list
+    attached); otherwise — or when every TPU child failed — run the
+    smaller CPU fallback child and print its row tagged
+    ``"headline": false`` with ``fallback_note`` (an honest number,
+    never a fake on-chip claim); total failure emits a structured error
+    record. Shared by ``--serve`` and ``--serve_load`` so the fallback
+    rows of both axes stay honest by construction."""
+    attempts = []
+    if _probe_tpu(attempts):
+        candidates = []
+        for stage, argv in tpu_children:
+            res = _run_child(argv, {}, TPU_TIMEOUT_S)
+            attempts.append({"stage": stage, **res})
+            # a null value is NOT a measurement (e.g. a load sweep whose
+            # lightest point was already saturated): it must fall through
+            # to the honest fallback, never print as a headline row
+            if res.get("value") is not None:
+                candidates.append(res)
+        if candidates:
+            best = max(candidates, key=lambda c: c["value"])
+            best["candidates"] = [
+                {"value": c["value"], "workload": c["workload"]}
+                for c in candidates
+            ]
+            best["attempts"] = len(attempts)
+            best["headline"] = True
+            print(json.dumps(best))
+            return 0
+    res = _run_child(
+        cpu_child,
+        {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+        CPU_TIMEOUT_S,
+    )
+    attempts.append({"stage": "cpu_fallback", **res})
+    # same null-is-not-a-measurement rule as the TPU candidates: a
+    # fallback row without a real value (e.g. a load sweep saturated at
+    # its lightest point) must become the structured error record below
+    if res.get("value") is not None:
+        res["attempts"] = len(attempts)
+        res["headline"] = False
+        res["note"] = fallback_note
+        print(json.dumps(res))
+        return 0
+    print(
+        json.dumps(
+            {"metric": metric, "value": None, "unit": unit, "error": attempts}
+        )
+    )
+    return 1
+
+
 def main_pipeline() -> int:
     """`python bench.py --pipeline`: the shadow-overlap headline —
     sync (depth 0) vs pipelined (depth 2) block wall time, with the
@@ -310,15 +384,7 @@ def main_pipeline() -> int:
     core has no overlap to measure — see PERF.md round 12) when the
     tunnel is down."""
     attempts = []
-    tpu_ok = False
-    for i in range(PROBE_ATTEMPTS):
-        res = _run_child(["--probe"], {}, PROBE_TIMEOUT_S)
-        attempts.append({"stage": f"probe{i}", **res})
-        if res.get("probe") == "ok" and res.get("platform") != "cpu":
-            tpu_ok = True
-            break
-        if i + 1 < PROBE_ATTEMPTS:
-            time.sleep(BACKOFF_S * (2**i))
+    tpu_ok = _probe_tpu(attempts)
 
     def arm_pair(blocks: int, reps: int, env, timeout_s, stage: str):
         arms = []
@@ -431,74 +497,160 @@ def _run_child(argv, env_overrides, timeout_s):
 
 
 def main_serve() -> int:
-    """`python bench.py --serve`: the SERVING headline (actions/sec),
-    with the train headline's exact orchestration discipline — probe
-    the TPU with bounded retries, sweep batch-size candidates one
-    isolated child each, fall back to a smaller honest CPU measurement
-    tagged ``"headline": false`` when the tunnel is down."""
-    attempts = []
-    tpu_ok = False
-    for i in range(PROBE_ATTEMPTS):
-        res = _run_child(["--probe"], {}, PROBE_TIMEOUT_S)
-        attempts.append({"stage": f"probe{i}", **res})
-        if res.get("probe") == "ok" and res.get("platform") != "cpu":
-            tpu_ok = True
-            break
-        if i + 1 < PROBE_ATTEMPTS:
-            time.sleep(BACKOFF_S * (2**i))
-
-    if tpu_ok:
-        # batch sweep, one child each: serving throughput grows with
-        # the request batch until the chip saturates
-        candidates = []
-        for batch in (4096, 32768, 131072):
-            res = _run_child(
+    """`python bench.py --serve`: the SERVING headline (actions/sec)
+    through the shared :func:`_orchestrate_serve` path — a TPU batch
+    sweep one isolated child each (throughput grows with the request
+    batch until the chip saturates), or the smaller honest CPU
+    fallback."""
+    return _orchestrate_serve(
+        tpu_children=[
+            (
+                f"tpu_serve_{batch}",
                 ["--serve_child", "--batch", str(batch), "--steps", "50",
                  "--reps", "3"],
-                {},
-                TPU_TIMEOUT_S,
             )
-            attempts.append({"stage": f"tpu_serve_{batch}", **res})
-            if "value" in res:
-                candidates.append(res)
-        if candidates:
-            best = max(candidates, key=lambda c: c["value"])
-            best["candidates"] = [
-                {"value": c["value"], "workload": c["workload"]}
-                for c in candidates
-            ]
-            best["attempts"] = len(attempts)
-            best["headline"] = True
-            print(json.dumps(best))
-            return 0
-
-    res = _run_child(
-        ["--serve_child", "--batch", "1024", "--steps", "20", "--reps", "2"],
-        {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
-        CPU_TIMEOUT_S,
-    )
-    attempts.append({"stage": "cpu_serve", **res})
-    if "value" in res:
-        res["attempts"] = len(attempts)
-        res["headline"] = False
-        res["note"] = (
+            for batch in (4096, 32768, 131072)
+        ],
+        cpu_child=["--serve_child", "--batch", "1024", "--steps", "20",
+                   "--reps", "2"],
+        metric="serve_actions_per_sec",
+        unit="actions/s",
+        fallback_note=(
             "TPU backend unavailable; CPU fallback serving measurement "
             "— an honest actions/sec number, NOT an on-chip serving "
             "claim (BENCH_SERVE.jsonl headline discipline)"
+        ),
+    )
+
+
+def _measure_serve_load(
+    max_batch: int,
+    max_wait_ms: float,
+    loads,
+    requests: int,
+    mode: str = "sample",
+    arrival: str = "poisson",
+) -> None:
+    """Child: the latency-under-load measurement — a deterministic
+    arrival sweep through the micro-batching queue in front of the
+    compiled ``serve_block`` program at the published reference shape
+    (rcmarl_tpu.serve.load). Every launch is the PADDED ``max_batch``
+    shape (one compile for the whole sweep — the fleet retrace case's
+    shape discipline), service times are REAL timed launches on this
+    backend, and the queue/arrival clock is simulated and replayable.
+    Emits ONE JSON line: per-load p50/p95/p99 latency + queue depth +
+    utilization points, and the saturation knee as the headline
+    "value" (the highest offered load still under the knee)."""
+    import jax
+
+    from rcmarl_tpu.config import Config
+    from rcmarl_tpu.serve.engine import serve_block, serve_keys, stack_actor_rows
+    from rcmarl_tpu.serve.load import (
+        saturation_knee,
+        serve_service_fn,
+        sweep_load,
+    )
+    from rcmarl_tpu.training.trainer import init_train_state
+    from rcmarl_tpu.utils.profiling import program_fingerprint
+
+    cfg = Config(slow_lr=0.002, fast_lr=0.01, seed=100)
+    state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
+    block = stack_actor_rows(state.params, cfg)
+    obs_shape = (max_batch, cfg.n_agents, cfg.obs_dim)
+    fingerprint = program_fingerprint(
+        serve_block.lower(
+            cfg,
+            block,
+            jax.ShapeDtypeStruct(obs_shape, "float32"),
+            serve_keys(0, 0),
+            mode=mode,
         )
-        print(json.dumps(res))
-        return 0
+    )
+    service = serve_service_fn(cfg, block, max_batch, mode=mode, seed=0)
+    max_wait = max_wait_ms / 1000.0
+    points = sweep_load(
+        service, loads, requests, max_batch, max_wait, seed=0,
+        arrival=arrival,
+    )
+    for p in points:
+        # humane units for the committed rows: latency in ms
+        for k in ("p50", "p95", "p99", "mean_latency", "service_mean"):
+            p[k + "_ms"] = round(p.pop(k) * 1000.0, 3)
+        p["utilization"] = round(p["utilization"], 4)
+        p["fill_mean"] = round(p["fill_mean"], 1)
+        p["queue_depth_mean"] = round(p["queue_depth_mean"], 1)
+    knee = saturation_knee(
+        [
+            dict(p, p99=p["p99_ms"], utilization=p["utilization"])
+            for p in points
+        ]
+    )
     print(
         json.dumps(
             {
-                "metric": "serve_actions_per_sec",
-                "value": None,
-                "unit": "actions/s",
-                "error": attempts,
+                "metric": "serve_load_knee",
+                "value": knee,
+                "unit": "req/s",
+                "platform": jax.devices()[0].platform,
+                "cost_fingerprint": fingerprint,
+                "points": points,
+                "workload": {
+                    "max_batch": max_batch,
+                    "max_wait_ms": max_wait_ms,
+                    "loads": list(loads),
+                    "requests": requests,
+                    "mode": mode,
+                    "arrival": arrival,
+                    "n_agents": cfg.n_agents,
+                    "hidden": list(cfg.hidden),
+                },
             }
         )
     )
-    return 1
+
+
+def main_serve_load() -> int:
+    """`python bench.py --serve_load`: latency vs offered load through
+    the micro-batching queue (p50/p99 + the saturation knee), on the
+    SAME orchestration path as `--serve`: the TPU sweep spans loads up
+    past the chip's expected knee; the CPU fallback sweeps a smaller
+    load range sized to this host's measured serving capacity — an
+    honest latency curve, not an on-chip SLO claim. Rows land in
+    BENCH_SERVE.jsonl (tpu_session.sh tees them)."""
+    return _orchestrate_serve(
+        tpu_children=[
+            (
+                "tpu_serve_load",
+                ["--serve_load_child", "--max_batch", "4096",
+                 "--max_wait_ms", "5",
+                 "--loads", "1e5,1e6,5e6,2e7,8e7",
+                 "--requests", "100000"],
+            ),
+            (
+                "tpu_serve_load_bursty",
+                ["--serve_load_child", "--max_batch", "4096",
+                 "--max_wait_ms", "5",
+                 "--loads", "1e5,1e6,5e6,2e7,8e7",
+                 "--requests", "100000", "--arrival", "bursty"],
+            ),
+        ],
+        # the CPU fallback sweep MUST cross this host's capacity (~2e5
+        # req/s at B=256 on the measured serve rows) or the "knee" is a
+        # truncation artifact: the top loads sit well past it and the
+        # request count is sized so overload backlog dominates max_wait
+        cpu_child=["--serve_load_child", "--max_batch", "256",
+                   "--max_wait_ms", "10",
+                   "--loads", "2e4,8e4,2e5,5e5,1.5e6",
+                   "--requests", "20000"],
+        metric="serve_load_knee",
+        unit="req/s",
+        fallback_note=(
+            "TPU backend unavailable; CPU fallback latency-vs-load "
+            "sweep — honest p50/p99 + knee for THIS host's serving "
+            "capacity, NOT an on-chip SLO claim (BENCH_SERVE.jsonl "
+            "headline discipline)"
+        ),
+    )
 
 
 def main() -> int:
@@ -538,18 +690,7 @@ def main() -> int:
     attempts = []
     # 1-3: probe the TPU, with bounded retries + backoff on any failure
     # (covers both the fast RuntimeError and the silent-hang mode).
-    tpu_ok = False
-    for i in range(PROBE_ATTEMPTS):
-        res = _run_child(["--probe"], {}, PROBE_TIMEOUT_S)
-        attempts.append({"stage": f"probe{i}", **res})
-        # Require a non-CPU platform: JAX can silently fall back to CPU
-        # instead of raising, and a CPU "probe ok" must not trigger the
-        # full-size measurement.
-        if res.get("probe") == "ok" and res.get("platform") != "cpu":
-            tpu_ok = True
-            break
-        if i + 1 < PROBE_ATTEMPTS:
-            time.sleep(BACKOFF_S * (2**i))
+    tpu_ok = _probe_tpu(attempts)
 
     if tpu_ok:
         # Replica-count sweep, ONE CHILD EACH: aggregate throughput grows
@@ -624,6 +765,29 @@ def main() -> int:
 if __name__ == "__main__":
     if "--probe" in sys.argv:
         _probe()
+    elif "--serve_load_child" in sys.argv:
+        args = sys.argv
+        _measure_serve_load(
+            max_batch=int(args[args.index("--max_batch") + 1]),
+            max_wait_ms=float(args[args.index("--max_wait_ms") + 1]),
+            loads=[
+                float(x)
+                for x in args[args.index("--loads") + 1].split(",")
+            ],
+            requests=int(args[args.index("--requests") + 1]),
+            mode=(
+                _arm_arg(args, "--mode", ("sample", "greedy"))
+                if "--mode" in args
+                else "sample"
+            ),
+            arrival=(
+                _arm_arg(args, "--arrival", ("poisson", "bursty"))
+                if "--arrival" in args
+                else "poisson"
+            ),
+        )
+    elif "--serve_load" in sys.argv:
+        sys.exit(main_serve_load())
     elif "--serve_child" in sys.argv:
         args = sys.argv
         _measure_serve(

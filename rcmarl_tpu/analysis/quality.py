@@ -625,6 +625,82 @@ def env_zoo_section(artifact_path) -> list:
     return lines
 
 
+def canary_section(artifact_path) -> list:
+    """QUALITY.md lines for the canary-gated deployment experiment,
+    rendered from the committed ``scripts/canary_experiment.py``
+    artifact (``simulation_results/canary_gate.json``) — same
+    byte-stable render-from-evidence contract as the
+    gossip/bf16/staleness sections. Empty when the artifact does not
+    exist."""
+    p = Path(artifact_path)
+    if not p.exists():
+        return []
+    d = json.loads(p.read_text())
+    cfg = d["config"]
+    lines = [
+        "",
+        "## Canary-gated deployment",
+        "",
+        "The reject/last-good machinery guards two fault classes — a "
+        "bad FILE (checksum chain, `.prev` fallback) and a poisoned "
+        "TREE (`params_finite`); the canary gate "
+        "(`rcmarl_tpu.serve.canary`, README \"Serving at production "
+        "scale\") extends it to the one that actually ships: a "
+        "checksum-valid, fully finite checkpoint whose POLICY "
+        "regressed. Every publish is measured by its FROZEN-policy "
+        "return (the deterministic `eval_block` stream) against the "
+        "serving incumbent's own band — below "
+        "`incumbent - band*|incumbent|` the candidate is REJECTED and "
+        "the incumbent keeps serving. The committed experiment "
+        f"(`{p.name}`, `scripts/canary_experiment.py`: "
+        f"{cfg['scenario']}, incumbent at "
+        f"{cfg['episodes_incumbent']} episodes, band {cfg['band']:.0%}, "
+        f"{cfg['eval_blocks']} eval blocks per measurement, measured "
+        f"on {d['platform']}) drives the REAL file-watcher deployment "
+        "loop — after every rejection the engine's serving block is "
+        "verified BITWISE against the last promoted policy:",
+        "",
+        "| publish | candidate frozen return | band floor | verdict |",
+        "|---|---|---|---|",
+    ]
+    for a in d["arms"]:
+        cand = (
+            a["candidate_return"]
+            if a["candidate_return"] is not None
+            else "— (guard reject, no eval paid)"
+        )
+        verdict = (
+            "promoted"
+            if a["promoted"]
+            else f"**REJECTED** ({a['reason']})"
+        )
+        lines.append(f"| {a['label']} | {cand} | {a['floor']} | {verdict} |")
+    g = d["gate_counters"]
+    lines += [
+        "",
+        f"Reading: the incumbent's own frozen return "
+        f"({d['incumbent_return']}) sets the bar, exactly how every "
+        "other QUALITY cell reads its clean band. The healthy publish "
+        "(a genuinely newer policy) clears it and BECOMES the "
+        "incumbent reference — which is why the stale snapshot is then "
+        "judged against the promoted policy's floor, the production "
+        "semantics (you canary against what is serving, not against "
+        "history). The stale publish is the case no file/finiteness "
+        "guard can catch: a perfectly valid checkpoint that is simply "
+        "a worse policy — caught by the band alone. The poisoned "
+        "publish never reaches an eval (the shared `params_finite` "
+        "guard runs first), and the re-publish proves the gate does "
+        f"not wedge after rejections ({g['accepts']} accepted / "
+        f"{g['rejects']} band-rejected over {g['evals']} evals; the "
+        "engine's degradation counters carry the same history on the "
+        "serve row). The same gate binds to the in-memory pipeline "
+        "chain as `PolicyPublisher(..., canary=gate.admit)` — a "
+        "pipelined learner's degraded candidate never reaches the "
+        "acting tier either.",
+    ]
+    return lines
+
+
 def adaptive_adversary_section(artifact_path) -> list:
     """QUALITY.md lines for the adaptive colluding-adversary
     experiment, rendered from the committed
@@ -906,6 +982,10 @@ def write_quality_md(
         Path(out_path).parent / "simulation_results/adaptive_adversary.json"
     )
     lines += adaptive_adversary_section(adaptive_artifact)
+    canary_artifact = (
+        Path(out_path).parent / "simulation_results/canary_gate.json"
+    )
+    lines += canary_section(canary_artifact)
     lines += [
         "",
         "## Related artifacts",
@@ -947,6 +1027,12 @@ def write_quality_md(
             "- `simulation_results/adaptive_adversary.json` — the "
             "adaptive colluding-adversary sweep behind the trimmed-"
             "mean stress-test section (`scripts/adaptive_adversary.py`)"
+        )
+    if canary_artifact.exists():
+        lines.append(
+            "- `simulation_results/canary_gate.json` — the deployment-"
+            "loop experiment behind the canary-gate section "
+            "(`scripts/canary_experiment.py`)"
         )
     # like cmd_parity's related-artifacts list: only link the robustness
     # companion when it exists, and never from itself

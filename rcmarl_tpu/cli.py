@@ -1869,7 +1869,10 @@ def cmd_serve(argv) -> int:
         "batched inference (ONE launch per request batch) with optional "
         "checkpoint hot-swap and guarded degradation — the 'heavy "
         "traffic' benchmark axis, distinct from train steps/sec "
-        "(rcmarl_tpu.serve)",
+        "(rcmarl_tpu.serve). --fleet serves F checkpoints in ONE "
+        "jitted launch with routing as data (per-member bitwise parity "
+        "verified); --canary_band gates hot-swaps on the candidate's "
+        "frozen-policy return vs the serving incumbent",
     )
     p.add_argument(
         "--checkpoint",
@@ -1877,6 +1880,36 @@ def cmd_serve(argv) -> int:
         default="./simulation_results/checkpoint.npz",
         help="trained checkpoint .npz (the checksummed format; a "
         "corrupted primary falls back to <path>.prev)",
+    )
+    p.add_argument(
+        "--fleet",
+        nargs="+",
+        type=str,
+        default=None,
+        help="serve a FLEET: the full member checkpoint list (overrides "
+        "--checkpoint) — F policy versions/tenants stacked along a "
+        "leading fleet axis and served by ONE jitted launch with "
+        "per-request round-robin routing as data "
+        "(rcmarl_tpu.serve.fleet); per-member probs are verified "
+        "BITWISE against solo serving before the timed loop, and each "
+        "member hot-swaps/degrades independently under --watch_every",
+    )
+    p.add_argument(
+        "--canary_band",
+        type=float,
+        default=None,
+        help="enable the canary deployment gate in front of hot-swaps "
+        "(solo path, needs --watch_every): a candidate whose "
+        "frozen-policy return falls below incumbent - band*|incumbent| "
+        "is REJECTED and the incumbent keeps serving "
+        "(rcmarl_tpu.serve.canary)",
+    )
+    p.add_argument(
+        "--canary_blocks",
+        type=int,
+        default=1,
+        help="eval blocks (n_ep_fixed episodes each) averaged per "
+        "canary measurement",
     )
     p.add_argument(
         "--batch",
@@ -1929,21 +1962,62 @@ def cmd_serve(argv) -> int:
         raise SystemExit(
             "--batch, --steps, --reps, and --obs_buffers must be >= 1"
         )
+    if args.canary_band is not None and args.fleet:
+        raise SystemExit(
+            "--canary_band gates the SOLO serving path (one incumbent, "
+            "one candidate stream); fleet members are independent "
+            "deployments — gate each member's publish pipeline instead"
+        )
+    if args.canary_band is not None and not args.watch_every:
+        raise SystemExit(
+            "--canary_band needs --watch_every: the gate sits in front "
+            "of the hot-swap poll"
+        )
 
     import jax
     import jax.numpy as jnp
 
     from rcmarl_tpu.envs.api import env_obs, env_reset
     from rcmarl_tpu.serve.engine import ServeEngine, serve_block, serve_keys
+    from rcmarl_tpu.serve.fleet import FleetEngine, fleet_block
     from rcmarl_tpu.serve.swap import CheckpointWatcher
     from rcmarl_tpu.training.trainer import make_env
     from rcmarl_tpu.utils.profiling import Timer, program_fingerprint
 
-    engine = ServeEngine(
-        args.checkpoint, mode=args.mode, eval_seed=args.eval_seed
-    )
+    if args.fleet:
+        engine = FleetEngine(
+            args.fleet, mode=args.mode, eval_seed=args.eval_seed
+        )
+        watcher = None  # FleetEngine.poll drives the per-member watchers
+    else:
+        engine = ServeEngine(
+            args.checkpoint, mode=args.mode, eval_seed=args.eval_seed
+        )
+        if args.watch_every and args.canary_band is not None:
+            from rcmarl_tpu.serve.canary import CanaryGate, CanaryWatcher
+            from rcmarl_tpu.utils.checkpoint import load_checkpoint_with_meta
+
+            inc_state, _, _, _ = load_checkpoint_with_meta(
+                engine.checkpoint_path, engine.cfg
+            )
+            gate = CanaryGate(
+                engine.cfg,
+                inc_state.desired,
+                inc_state.initial,
+                band=args.canary_band,
+                blocks=args.canary_blocks,
+                eval_seed=args.eval_seed,
+            )
+            # pin the incumbent from the state already in hand — the
+            # watcher then skips its own (third) checksummed load of
+            # the same file
+            gate.set_incumbent(inc_state.params)
+            watcher = CanaryWatcher(engine, gate)
+        elif args.watch_every:
+            watcher = CheckpointWatcher(engine)
+        else:
+            watcher = None
     cfg = engine.cfg
-    watcher = CheckpointWatcher(engine) if args.watch_every else None
     env = make_env(cfg)
 
     def obs_batch(i: int) -> jnp.ndarray:
@@ -1960,29 +2034,97 @@ def cmd_serve(argv) -> int:
         )
 
     buffers = [obs_batch(i) for i in range(args.obs_buffers)]
-    # tie the row to the EXACT program being timed (ledger convention)
-    fingerprint = program_fingerprint(
-        serve_block.lower(
-            cfg, engine.block, buffers[0], serve_keys(args.eval_seed, 0),
-            mode=args.mode,
+    fleet_fields = {}
+    if args.fleet:
+        F = engine.n_members
+        # distinct per-launch routes, cycled as DATA through the timed
+        # loop (a re-route is never a recompile — the retrace-audited
+        # fleet contract)
+        routes = [
+            (jnp.arange(args.batch, dtype=jnp.int32) + r) % F
+            for r in range(min(F, 4))
+        ]
+        # tie the row to the EXACT program being timed (ledger convention)
+        fingerprint = program_fingerprint(
+            fleet_block.lower(
+                cfg, engine.fleet, buffers[0],
+                serve_keys(args.eval_seed, 0), routes[0], mode=args.mode,
+            )
         )
-    )
-    # warmup: compile + one execution
-    jax.device_get(engine.serve(buffers[0])[0])
+        # per-member BITWISE parity vs solo serving, verified on the
+        # real batch BEFORE anything is timed: the emitted fleet row
+        # carries a parity claim the run itself proved (a mismatch is a
+        # hard error, so the row can never lie)
+        key0 = serve_keys(args.eval_seed, 0)
+        _, fleet_probs = fleet_block(
+            cfg, engine.fleet, buffers[0], key0, routes[0], mode=args.mode
+        )
+        r0 = np.asarray(routes[0])
+        for f, member in enumerate(engine.members):
+            _, solo_probs = serve_block(
+                cfg, member.block, buffers[0], key0, mode=args.mode
+            )
+            idx = np.nonzero(r0 == f)[0]
+            np.testing.assert_array_equal(
+                np.asarray(fleet_probs)[idx], np.asarray(solo_probs)[idx]
+            )
+        fleet_fields = {
+            "fleet": F,
+            "fleet_members": [str(p) for p in args.fleet],
+            "member_parity": "bitwise",
+            "route": "round_robin(rotating)",
+        }
+
+        def launch(s: int):
+            return engine.serve(
+                buffers[s % len(buffers)], route=routes[s % len(routes)]
+            )
+
+        poll = engine.poll if args.watch_every else None
+    else:
+        # tie the row to the EXACT program being timed (ledger convention)
+        fingerprint = program_fingerprint(
+            serve_block.lower(
+                cfg, engine.block, buffers[0], serve_keys(args.eval_seed, 0),
+                mode=args.mode,
+            )
+        )
+
+        def launch(s: int):
+            return engine.serve(buffers[s % len(buffers)])
+
+        poll = watcher.poll if watcher is not None else None
+    # ONE timing discipline for both arms: warmup (compile + one
+    # execution), then best-of-reps over the steps loop with the
+    # hot-swap poll riding the same cadence
+    jax.device_get(launch(0)[0])
     best = float("inf")
     for _ in range(args.reps):
         t = Timer().start()
         actions = None
         for s in range(args.steps):
-            actions, _ = engine.serve(buffers[s % len(buffers)])
-            if watcher is not None and (s + 1) % args.watch_every == 0:
-                watcher.poll()
+            actions, _ = launch(s)
+            if poll is not None and (s + 1) % args.watch_every == 0:
+                poll()
         best = min(best, t.stop(actions))
     actions_per_launch = args.batch * cfg.n_agents
+    canary_fields = {}
+    if args.canary_band is not None:
+        canary_fields = {
+            "canary": {
+                "band": args.canary_band,
+                "blocks": args.canary_blocks,
+                **watcher.gate.counters,
+                "incumbent_return": watcher.gate.incumbent_return,
+                "last": watcher.gate.last,
+            }
+        }
     row = json.dumps(
         {
             "kind": "serve",
-            "checkpoint": str(args.checkpoint),
+            "checkpoint": (
+                str(args.fleet[0]) if args.fleet else str(args.checkpoint)
+            ),
             "env": cfg.env,
             "mode": args.mode,
             "n_agents": cfg.n_agents,
@@ -1994,6 +2136,8 @@ def cmd_serve(argv) -> int:
             "sec_per_launch": round(best / args.steps, 6),
             "cost_fingerprint": fingerprint,
             "degradation": engine.summary(),
+            **fleet_fields,
+            **canary_fields,
             "workload": {
                 "steps": args.steps,
                 "reps": args.reps,
@@ -2009,6 +2153,8 @@ def cmd_serve(argv) -> int:
     )
     _emit(row, args.out)
     print(engine.summary_line())
+    if args.canary_band is not None:
+        print(watcher.gate.summary_line())
     return 0
 
 

@@ -68,6 +68,7 @@ _ANCHORS = {
     "consensus_trunk": "rcmarl_tpu/ops/pallas_consensus.py",
     "fit_scan": "rcmarl_tpu/ops/pallas_fit.py",
     "serve_block": "rcmarl_tpu/serve/engine.py",
+    "fleet_block": "rcmarl_tpu/serve/fleet.py",
     "eval_block": "rcmarl_tpu/serve/engine.py",
     "actor_block": "rcmarl_tpu/serve/engine.py",
     "learner_block": "rcmarl_tpu/pipeline/trainer.py",
@@ -235,6 +236,17 @@ def cost_arms() -> Dict[str, tuple]:
             tiny_cfg(netstack=False),
             False,
             ("serve_block", "eval_block"),
+        ),
+        # fleet serving (ROADMAP item 4b): the F=2 stacked multi-policy
+        # launch on the same shared-inputs config — the ledger is what
+        # makes "F members cost F x one member plus a routing gather, no
+        # more" a CI fact: fleet_block@fleet's flops vs
+        # serve_block@serve's at the same batch pin the linear-in-F
+        # scaling, and any silently quadratic re-route would trip here
+        "fleet": (
+            tiny_cfg(netstack=False),
+            False,
+            ("fleet_block",),
         ),
         # the async pipeline's two tiers: the actor-tier rollout
         # program and the learner block (undonated + donated twins) at

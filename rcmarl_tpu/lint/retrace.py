@@ -307,6 +307,7 @@ def audit_retrace(
             start_round=df.attrs["gossip"]["gossip_round"],
         )
     auditor.findings.extend(_audit_serve(auditor, steady_blocks))
+    auditor.findings.extend(_audit_fleet(auditor, steady_blocks))
     _audit_pipeline(auditor, steady_blocks)
     return auditor.findings
 
@@ -334,6 +335,82 @@ def _audit_pipeline(auditor: "RetraceAuditor", steady_blocks: int) -> None:
             n_episodes=cfg.n_ep_fixed * (steady_blocks + 1),
             state=state,
         )
+
+
+def _audit_fleet(
+    auditor: "RetraceAuditor", steady_blocks: int
+) -> List[Finding]:
+    """The fleet-serving compile-once case: ``fleet_block`` warmed once
+    per static arm (sample / greedy), then driven across ROUTE CHANGES
+    (the per-request member map is data — an A/B re-split or tenant
+    re-route may never be a compile), across MEMBER HOT-SWAPS (a fleet
+    with one member's slice replaced by fresh same-shaped params — the
+    FleetEngine poll path), and across the LOAD-HARNESS batch
+    discipline (every micro-batching-queue launch is the PADDED
+    ``max_batch`` shape whatever the fill, so distinct fills share one
+    program) — zero recompiles throughout, the production-serving
+    acceptance contract."""
+    import jax
+    import jax.numpy as jnp
+
+    from rcmarl_tpu.lint.configs import tiny_cfg
+    from rcmarl_tpu.serve.engine import stack_actor_rows
+    from rcmarl_tpu.serve.fleet import fleet_block, fleet_set_member, fleet_stack
+    from rcmarl_tpu.training.trainer import init_train_state
+
+    cfg = tiny_cfg()
+    blocks = [
+        stack_actor_rows(
+            init_train_state(cfg, jax.random.PRNGKey(s)).params, cfg
+        )
+        for s in (0, 1, 2)
+    ]
+    fleet = fleet_stack(blocks[:2])
+    # the member hot-swap: member 1's slice replaced wholesale by fresh
+    # same-shaped params (the FleetEngine.poll discipline)
+    swapped = fleet_set_member(fleet, 1, blocks[2])
+    max_batch = 8  # the load harness's one padded launch shape
+    obs = [
+        jax.random.normal(
+            jax.random.PRNGKey(20 + i), (max_batch, cfg.n_agents, cfg.obs_dim)
+        )
+        for i in range(2)  # distinct fills land on the SAME padded shape
+    ]
+    routes = [
+        jnp.zeros((max_batch,), jnp.int32),
+        jnp.arange(max_batch, dtype=jnp.int32) % 2,
+        jnp.ones((max_batch,), jnp.int32),
+    ]
+    key = jax.random.PRNGKey(11)
+    findings: List[Finding] = []
+    before = int(fleet_block._cache_size())
+    fleet_block(cfg, fleet, obs[0], key, routes[0])
+    fleet_block(cfg, fleet, obs[0], key, routes[0], mode="greedy")
+    grew = int(fleet_block._cache_size()) - before
+    if grew != 2:
+        path, line = _anchor(fleet_block)
+        findings.append(
+            Finding(
+                "retrace",
+                path,
+                line,
+                f"fleet_block compiled {grew} program(s) for the "
+                "sample/greedy warmup pair — expected exactly one per "
+                "static mode arm",
+            )
+        )
+    with auditor.expect_no_compiles(
+        context="fleet re-routes + member hot-swap + padded load batches"
+    ):
+        for i in range(steady_blocks):
+            for fl in (fleet, swapped):  # the member hot-swap boundary
+                for route in routes:  # routing is DATA
+                    for o in obs:  # distinct fills, one padded shape
+                        fleet_block(
+                            cfg, fl, o, jax.random.fold_in(key, i), route
+                        )
+                        fleet_block(cfg, fl, o, key, route, mode="greedy")
+    return findings
 
 
 def _audit_serve(

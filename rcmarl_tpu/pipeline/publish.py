@@ -23,6 +23,17 @@ Two knobs mirror the two trainer regimes:
   poison the acting tier; rejects are counted, the actor keeps the last
   good parameters. Validation host-syncs, which guarded runs already do
   per block; unguarded runs skip it to keep the pipeline free-running.
+
+A third, opt-in guard closes the deployment loop (ROADMAP item 4c):
+``canary=`` takes a policy-level admission callable — canonically
+:meth:`rcmarl_tpu.serve.canary.CanaryGate.admit`, the frozen-policy
+return gate — run AFTER the finiteness guard and before the swap. A
+candidate whose frozen return degrades beyond the gate's band is
+rejected (``canary_rejects`` counted) and the actor tier keeps acting
+on the last published parameters: "bad policy" gets the same
+reject/last-good treatment "corrupt file" and "poisoned tree" always
+had. The canary host-syncs an eval rollout per publish boundary, so it
+is a deployment-cadence knob, not a per-block one.
 """
 
 from __future__ import annotations
@@ -47,6 +58,7 @@ class PolicyPublisher:
         *,
         copy: bool = False,
         validate: bool = False,
+        canary: Any = None,
         learner_block: int = 0,
     ) -> None:
         if publish_every < 1:
@@ -56,9 +68,10 @@ class PolicyPublisher:
         self.publish_every = publish_every
         self.copy = copy
         self.validate = validate
+        self.canary = canary
         self.acting = self._prepare(params)
         self.published_block = learner_block
-        self.counters = {"publishes": 0, "rejects": 0}
+        self.counters = {"publishes": 0, "rejects": 0, "canary_rejects": 0}
 
     def _prepare(self, params: Any) -> Any:
         if not self.copy:
@@ -89,6 +102,14 @@ class PolicyPublisher:
             if not params_finite(params):
                 self.counters["rejects"] += 1
                 return False
+        if self.canary is not None and not self.canary(params):
+            # bad POLICY (a finite, checksum-clean candidate whose
+            # frozen return fell out of the gate's band): same
+            # reject/keep-last-good outcome as the finiteness guard,
+            # ledgered separately so deployment dashboards can tell
+            # "learner diverged" from "learner published a regression"
+            self.counters["canary_rejects"] += 1
+            return False
         # validate fully, then swap the single reference wholesale: an
         # actor dispatched before this line acts on the old tree, one
         # dispatched after acts on the new tree, and no dispatch can

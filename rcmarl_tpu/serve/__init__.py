@@ -1,7 +1,12 @@
 """Policy serving: compile-once batched inference with checkpoint
 hot-swap and guarded degradation (ROADMAP item 5 — the "heavy traffic"
-half of the north star, distinct from the training benchmark axis)."""
+half of the north star, distinct from the training benchmark axis),
+plus the production tier (ROADMAP item 4): the request-arrival latency
+harness (:mod:`rcmarl_tpu.serve.load`), fleet-stacked multi-policy
+serving (:mod:`rcmarl_tpu.serve.fleet`), and the canary-gated
+deployment loop (:mod:`rcmarl_tpu.serve.canary`)."""
 
+from rcmarl_tpu.serve.canary import CanaryGate, CanaryWatcher  # noqa: F401
 from rcmarl_tpu.serve.engine import (  # noqa: F401
     SERVE_MODES,
     ServeEngine,
@@ -11,5 +16,20 @@ from rcmarl_tpu.serve.engine import (  # noqa: F401
     serve_keys,
     serve_request_keys,
     stack_actor_rows,
+)
+from rcmarl_tpu.serve.fleet import (  # noqa: F401
+    FleetEngine,
+    fleet_block,
+    fleet_set_member,
+    fleet_stack,
+)
+from rcmarl_tpu.serve.load import (  # noqa: F401
+    bursty_arrivals,
+    fleet_service_fn,
+    poisson_arrivals,
+    run_load,
+    saturation_knee,
+    serve_service_fn,
+    sweep_load,
 )
 from rcmarl_tpu.serve.swap import CheckpointWatcher  # noqa: F401

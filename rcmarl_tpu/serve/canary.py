@@ -1,0 +1,240 @@
+"""Canary-gated deployment — "bad policy" joins "corrupt file".
+
+The reject/last-good machinery guards two fault classes already: a bad
+FILE (checksum chain, ``.prev`` fallback) and a poisoned TREE
+(``params_finite``). Neither catches the production failure that
+actually ships: a checksum-valid, fully finite checkpoint whose POLICY
+regressed — a stale publish, a diverged learner, a bad hyperparameter
+push. This module closes the learner → publish → canary → accept/reject
+loop:
+
+- :class:`CanaryGate` — the decision: a candidate's FROZEN-policy
+  return (:func:`~rcmarl_tpu.serve.engine.eval_block`, deterministic
+  eval stream — no exploration, no updates) must stay within a
+  configurable band of the serving INCUMBENT's return. Below the floor
+  (or non-finite): REJECTED, the incumbent keeps serving. At or above:
+  promoted, and the candidate's return becomes the new incumbent
+  reference. Counters + the last decision ride the serve rows.
+- :class:`CanaryWatcher` — the deployment loop on files: the
+  :class:`~rcmarl_tpu.serve.swap.CheckpointWatcher` discipline with the
+  gate spliced between candidate validation and the atomic swap — a
+  published checkpoint that fails the canary never reaches the engine.
+- ``PolicyPublisher(..., canary=gate.admit)`` — the same gate bound to
+  the in-memory publish chain (:mod:`rcmarl_tpu.pipeline.publish`), so
+  a pipelined learner's degraded candidate never reaches the acting
+  tier either.
+
+The committed experiment (``scripts/canary_experiment.py`` →
+``simulation_results/canary_gate.json``, QUALITY.md "Canary-gated
+deployment") drives a healthy publish to promotion and a
+poisoned/stale/band-violating publish to rejection through this exact
+code.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from rcmarl_tpu.serve.swap import CheckpointWatcher
+
+
+class CanaryGate:
+    """Frozen-policy return gate over the evaluate program.
+
+    ``band`` is relative: a candidate is rejected when its mean frozen
+    return falls below ``incumbent - band * |incumbent|`` (the
+    QUALITY.md tolerance recipe — 0.05 is "within 5% of the serving
+    policy's own quality"). ``blocks`` eval blocks of ``n_ep_fixed``
+    episodes each are averaged per measurement; the eval stream is
+    deterministic in ``(eval_seed, block)``, so the same candidate
+    always measures the same return (a gate decision is replayable).
+
+    ``counters``: ``evals`` (gate measurements), ``accepts``,
+    ``rejects``; ``last`` holds the most recent decision record
+    (candidate/incumbent returns, floor, reason).
+    """
+
+    def __init__(
+        self,
+        cfg,
+        desired,
+        initial,
+        band: float = 0.05,
+        blocks: int = 1,
+        eval_seed: int = 0,
+    ) -> None:
+        if band < 0.0:
+            raise ValueError(f"band={band} must be >= 0")
+        if blocks < 1:
+            raise ValueError(f"blocks={blocks} must be >= 1")
+        self.cfg = cfg
+        self.desired = desired
+        self.initial = initial
+        self.band = float(band)
+        self.blocks = int(blocks)
+        self.eval_seed = int(eval_seed)
+        self.incumbent_return: Optional[float] = None
+        self.counters = {"evals": 0, "accepts": 0, "rejects": 0}
+        self.last: Optional[dict] = None
+
+    # -- measurement -------------------------------------------------------
+
+    def frozen_return(self, params) -> float:
+        """Mean team return of ``params`` under the frozen-policy eval
+        program: ``blocks`` launches of
+        :func:`~rcmarl_tpu.serve.engine.eval_block` on the
+        deterministic ``fold_in(PRNGKey(eval_seed), block)`` stream."""
+        import jax
+
+        from rcmarl_tpu.serve.engine import eval_block
+
+        key = jax.random.PRNGKey(self.eval_seed)
+        vals = []
+        for b in range(self.blocks):
+            metrics, _ = eval_block(
+                self.cfg,
+                params,
+                self.desired,
+                jax.random.fold_in(key, b),
+                self.initial,
+            )
+            vals.append(np.asarray(metrics.true_team_returns))
+        return float(np.mean(np.concatenate(vals)))
+
+    def set_incumbent(self, params) -> float:
+        """Measure ``params`` and pin it as the serving incumbent the
+        next candidates are judged against; returns its frozen
+        return."""
+        self.incumbent_return = self.frozen_return(params)
+        return self.incumbent_return
+
+    # -- the decision ------------------------------------------------------
+
+    def floor(self) -> float:
+        """The acceptance floor: ``incumbent - band * |incumbent|``."""
+        if self.incumbent_return is None:
+            raise RuntimeError(
+                "canary gate has no incumbent; call set_incumbent() "
+                "with the serving policy's params first"
+            )
+        return self.incumbent_return - self.band * abs(self.incumbent_return)
+
+    def admit(self, params) -> bool:
+        """Gate one candidate: measure its frozen return against the
+        incumbent's floor. Accept -> the candidate's return becomes the
+        new incumbent reference (it is about to serve); reject -> the
+        incumbent reference is untouched (it keeps serving). Non-finite
+        params are rejected WITHOUT paying an eval (the shared
+        publish-candidate guard runs first)."""
+        from rcmarl_tpu.faults import params_finite
+
+        floor = self.floor()
+        if not params_finite(params):
+            # poisoned but maybe checksum-valid: the file guards can
+            # miss it on the in-memory publish chain — reject before
+            # the eval could propagate NaNs into a return
+            self.counters["rejects"] += 1
+            self.last = {
+                "accepted": False,
+                "reason": "non-finite candidate params",
+                "candidate_return": None,
+                "incumbent_return": self.incumbent_return,
+                "floor": floor,
+            }
+            return False
+        self.counters["evals"] += 1
+        cand = self.frozen_return(params)
+        ok = bool(np.isfinite(cand)) and cand >= floor
+        self.last = {
+            "accepted": ok,
+            "reason": (
+                "within band"
+                if ok
+                else (
+                    "non-finite frozen return"
+                    if not np.isfinite(cand)
+                    else "frozen return below the band floor"
+                )
+            ),
+            "candidate_return": cand if np.isfinite(cand) else None,
+            "incumbent_return": self.incumbent_return,
+            "floor": floor,
+            "degradation": (
+                round(self.incumbent_return - cand, 6)
+                if np.isfinite(cand)
+                else None
+            ),
+        }
+        if ok:
+            self.counters["accepts"] += 1
+            self.incumbent_return = cand
+        else:
+            self.counters["rejects"] += 1
+        return ok
+
+    def summary_line(self) -> str:
+        """One line the CI cell greps: accept/reject counters + the
+        last decision ('... rejected (frozen return below the band
+        floor)')."""
+        c = self.counters
+        tail = ""
+        if self.last is not None:
+            verdict = "promoted" if self.last["accepted"] else "rejected"
+            tail = f" — last candidate {verdict} ({self.last['reason']})"
+        inc = (
+            f"{self.incumbent_return:.4f}"
+            if self.incumbent_return is not None
+            else "unset"
+        )
+        return (
+            f"canary: {c['accepts']} accepted, {c['rejects']} rejected "
+            f"over {c['evals']} evals (band {self.band:g}, incumbent "
+            f"return {inc}){tail}"
+        )
+
+
+class CanaryWatcher(CheckpointWatcher):
+    """The closed deployment loop on checkpoint files: poll → validate
+    (the full CheckpointWatcher chain: checksum, ``.prev`` fallback,
+    replica/finite guards) → CANARY eval → atomic swap or
+    keep-incumbent.
+
+    A candidate rejected by the GATE counts on both ledgers: the gate's
+    ``rejects`` (with the return/floor record in ``gate.last``) and the
+    engine's ``rejects`` (the serving row's degradation counter — the
+    summary line reads ``served: last-good``, exactly like a corrupt
+    file, because operationally it is the same outcome: the newest
+    publish is not serving). The gate's incumbent reference is pinned
+    from the engine's initial checkpoint at construction.
+    """
+
+    def __init__(self, engine, gate: CanaryGate, path=None) -> None:
+        super().__init__(engine, path)
+        self.gate = gate
+        if gate.incumbent_return is None:
+            # the serving policy at watcher construction IS the
+            # incumbent: re-load it through the same discovery chain
+            # the engine used (the engine keeps only the stacked actor
+            # block; the gate needs the full params tree to roll out)
+            from rcmarl_tpu.utils.checkpoint import load_checkpoint_with_meta
+
+            state, _, _, _ = load_checkpoint_with_meta(
+                engine.checkpoint_path, engine.cfg
+            )
+            gate.set_incumbent(state.params)
+
+    def _try_swap(self) -> bool:
+        candidate = self._load_candidate()
+        if candidate is None:
+            return False  # file/finite rejection — already counted
+        state, loaded = candidate
+        if not self.gate.admit(state.params):
+            # bad POLICY: same degradation outcome as a bad file — the
+            # incumbent keeps serving, the reject is on the ledger
+            eng = self.engine
+            eng.counters["rejects"] += 1
+            eng.degraded = True
+            return False
+        return self._apply(state, loaded)

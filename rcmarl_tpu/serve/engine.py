@@ -102,6 +102,21 @@ def serve_keys(eval_seed: int, step) -> jax.Array:
     return jax.random.fold_in(jax.random.PRNGKey(eval_seed), step)
 
 
+def batch_probs(cfg: Config, block: MLPParams, x: jnp.ndarray) -> jnp.ndarray:
+    """THE batched policy core: ``(B, N, padded_obs)`` features through
+    one row-stacked actor block -> ``(B, N, n_actions)`` probabilities
+    (vmapped :func:`~rcmarl_tpu.models.mlp.actor_probs`, row n = agent
+    n). The SINGLE implementation both :func:`serve_block` and the
+    fleet program (:func:`rcmarl_tpu.serve.fleet.fleet_block`) compute
+    probabilities with — the per-member bitwise-parity contract holds
+    by construction because there is exactly one copy to drift."""
+    return jax.vmap(
+        lambda p, xn: actor_probs(p, xn, cfg.leaky_alpha, cfg.dot_dtype),
+        in_axes=(0, 1),
+        out_axes=1,
+    )(block, x)
+
+
 def _serve_block(
     cfg: Config,
     block: MLPParams,
@@ -133,11 +148,7 @@ def _serve_block(
     # width of the stacked first layer (== obs_dim for the homogeneous
     # actor family; pad_features is the identity then)
     x = pad_features(obs, block[0][0].shape[-2])
-    probs = jax.vmap(
-        lambda p, xn: actor_probs(p, xn, cfg.leaky_alpha, cfg.dot_dtype),
-        in_axes=(0, 1),
-        out_axes=1,
-    )(block, x)  # (B, N, n_actions)
+    probs = batch_probs(cfg, block, x)  # (B, N, n_actions)
     if mode == "greedy":
         actions = jnp.argmax(probs, axis=-1).astype(jnp.int32)
     else:

@@ -1,0 +1,259 @@
+"""Fleet serving — many policy versions in ONE jitted launch.
+
+PR 10 netstacked AGENTS: all actor heads row-stacked so one compiled
+program serves every agent of one policy. This module applies the same
+move one level up, to CHECKPOINTS: F policy versions / tenants /
+per-scenario policies stacked along a new leading fleet axis and served
+by ONE jitted program (:func:`fleet_block`), with per-request routing as
+DATA — an A/B split, a tenant map, or a scenario router changes the
+route array between launches and the SAME executable re-dispatches
+(retrace-certified, like every hot path here). The cost ledger's
+``fleet_block@fleet`` row pins the stacked program's FLOPs: each member
+computes the full batch (the Podracer one-program discipline,
+PAPERS.md 2104.06272), so cost scales linearly in F and the routing
+gather adds selection, not arithmetic.
+
+Contracts:
+
+- **Per-member bitwise parity**: member f's probabilities inside the
+  fleet launch are BITWISE the solo :func:`serve_block` probabilities on
+  the same checkpoint, and a request routed to f samples with the same
+  ``fold_in(fold_in(key, b), n)`` key it would get solo — so fleet
+  serving of one member is indistinguishable from solo serving it
+  (pinned in tests/test_serve_fleet.py).
+- **Member-isolated degradation**: every member loads through the
+  checksummed discovery chain (its own :class:`ServeEngine`) and
+  hot-swaps independently through the
+  :class:`~rcmarl_tpu.serve.swap.CheckpointWatcher` discipline; a
+  corrupt/poisoned member candidate degrades THAT member to its
+  last-good slice — the fleet keeps serving, the other members keep
+  swapping.
+- **Config homogeneity is loud**: members must share one serving config
+  (the fleet is one stacked program; mixing shapes would be a silent
+  deployment error, the replica-world rule one level up).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from rcmarl_tpu.config import Config
+from rcmarl_tpu.models.mlp import MLPParams, pad_features
+from rcmarl_tpu.serve.engine import (
+    SERVE_MODES,
+    ServeEngine,
+    batch_probs,
+    serve_keys,
+    serve_request_keys,
+)
+from rcmarl_tpu.serve.swap import CheckpointWatcher
+
+
+def fleet_stack(blocks: Sequence[MLPParams]) -> MLPParams:
+    """F row-stacked actor blocks (each
+    :func:`~rcmarl_tpu.serve.engine.stack_actor_rows` output, leading
+    agent axis) stacked along a NEW leading fleet axis: leaf shapes
+    ``(N, ...) -> (F, N, ...)``, row f = member f. Mismatched member
+    shapes fail loudly in the stack — a fleet is one program."""
+    if not blocks:
+        raise ValueError("fleet_stack needs at least one member block")
+    return jax.tree.map(lambda *ls: jnp.stack(ls), *blocks)
+
+
+def fleet_set_member(fleet: MLPParams, f: int, block: MLPParams) -> MLPParams:
+    """A NEW fleet with member ``f``'s slice replaced wholesale by
+    ``block`` — the hot-swap primitive: built completely, then the
+    caller rebinds its single fleet reference (the CheckpointWatcher
+    atomicity contract, per member)."""
+    return jax.tree.map(lambda fl, nb: fl.at[f].set(nb), fleet, block)
+
+
+def _fleet_block(
+    cfg: Config,
+    fleet: MLPParams,
+    obs: jnp.ndarray,
+    key: jax.Array,
+    route: jnp.ndarray,
+    mode: str = "sample",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """ONE compiled launch serving a request batch across F members.
+
+    Args:
+      cfg: static config (the compile key, like :func:`serve_block`).
+      fleet: the fleet-stacked actor blocks (:func:`fleet_stack`),
+        leading axis F.
+      obs: (B, N, obs_dim) batched observations, exactly the solo
+        layout.
+      route: (B,) int32 — request b is served by member ``route[b]``.
+        DATA, not structure: a re-route re-dispatches the same
+        executable (the retrace-audited contract).
+      key: base PRNG key; per-(request, agent) keys derive via
+        :func:`serve_request_keys` exactly as solo, so routing to a
+        member samples the actions that member would sample solo.
+      mode: 'sample' or 'greedy' (static — one program per arm).
+
+    Returns ``(actions, probs)``: (B, N) int32 and (B, N, n_actions) —
+    row b is member ``route[b]``'s output, bitwise its solo
+    :func:`serve_block` row.
+    """
+    if mode not in SERVE_MODES:
+        raise ValueError(f"mode={mode!r}: expected one of {SERVE_MODES}")
+    B, N = obs.shape[0], obs.shape[1]
+    x = pad_features(obs, fleet[0][0].shape[-2])
+    # the ONE solo serve_block core (engine.batch_probs) vmapped over
+    # the fleet axis — the per-member parity pin holds bitwise because
+    # there is exactly one implementation to drift
+    probs_all = jax.vmap(
+        lambda blk: batch_probs(cfg, blk, x)
+    )(fleet)  # (F, B, N, n_actions)
+    probs = probs_all[route, jnp.arange(B)]  # routing is a gather on DATA
+    if mode == "greedy":
+        actions = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+    else:
+        keys = serve_request_keys(key, B, N)
+        actions = jax.vmap(jax.vmap(jax.random.categorical))(
+            keys, jnp.log(probs)
+        ).astype(jnp.int32)
+    return actions, probs
+
+
+#: The jitted fleet serving entry point (registered in
+#: ``utils/profiling.py:jit_entry_points`` — retrace/cost audited like
+#: every hot path). ``cfg`` and ``mode`` are static; fleet,
+#: observations, key, AND the route are data, so re-routes and member
+#: hot-swaps re-dispatch the SAME executable.
+fleet_block = partial(
+    jax.jit, static_argnums=0, static_argnames=("mode",)
+)(_fleet_block)
+
+
+class FleetEngine:
+    """Host shell around :func:`fleet_block`: F checkpoints, one
+    compiled launch, member-isolated degradation.
+
+    Each member is a full :class:`~rcmarl_tpu.serve.engine.ServeEngine`
+    (checksummed load, ``.prev`` fallback, loud replica/non-finite
+    rejection) with its own
+    :class:`~rcmarl_tpu.serve.swap.CheckpointWatcher`; the engine keeps
+    ONE stacked fleet reference built from the members' blocks. A
+    member hot-swap rebuilds only that member's slice and rebinds the
+    fleet wholesale — a launch before the rebind serves the old fleet,
+    one after serves the new, and no launch can ever observe a torn
+    member. A REJECTED member candidate (corrupt file, NaN params)
+    leaves that member's last-good slice serving: the fleet never
+    degrades past the one bad member.
+    """
+
+    def __init__(
+        self,
+        checkpoints: Sequence,
+        cfg: Optional[Config] = None,
+        mode: str = "sample",
+        eval_seed: int = 0,
+    ) -> None:
+        if not checkpoints:
+            raise ValueError("FleetEngine needs at least one checkpoint")
+        if mode not in SERVE_MODES:
+            raise ValueError(f"mode={mode!r}: expected one of {SERVE_MODES}")
+        self.members: List[ServeEngine] = [
+            ServeEngine(p, cfg=cfg, mode=mode, eval_seed=eval_seed)
+            for p in checkpoints
+        ]
+        cfg0 = self.members[0].cfg
+        for m in self.members[1:]:
+            if m.cfg != cfg0:
+                raise ValueError(
+                    f"fleet members must share ONE serving config: "
+                    f"{m.checkpoint_path} was trained under a different "
+                    "Config than member 0 — a mixed-shape fleet is a "
+                    "deployment error, not a transport fault"
+                )
+        self.cfg = cfg0
+        self.mode = mode
+        self.eval_seed = eval_seed
+        self.watchers = [CheckpointWatcher(m) for m in self.members]
+        self.fleet = fleet_stack([m.block for m in self.members])
+        self.counters = {"launches": 0, "actions": 0}
+
+    @property
+    def n_members(self) -> int:
+        return len(self.members)
+
+    def round_robin_route(self, B: int) -> jnp.ndarray:
+        """The default (B,) route: request b -> member b % F."""
+        return jnp.arange(B, dtype=jnp.int32) % self.n_members
+
+    def serve(
+        self,
+        obs: jnp.ndarray,
+        route: Optional[jnp.ndarray] = None,
+        key: Optional[jax.Array] = None,
+        step: Optional[int] = None,
+        mode: Optional[str] = None,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Serve one (B, N, obs_dim) batch through the fleet ->
+        (actions, probs). ``route=None`` round-robins; ``key=None``
+        uses the deterministic serve stream exactly like the solo
+        engine."""
+        if route is None:
+            route = self.round_robin_route(obs.shape[0])
+        if key is None:
+            key = serve_keys(
+                self.eval_seed,
+                self.counters["launches"] if step is None else step,
+            )
+        out = fleet_block(
+            self.cfg, self.fleet, obs, key, route, mode=mode or self.mode
+        )
+        self.counters["launches"] += 1
+        self.counters["actions"] += int(obs.shape[0]) * int(obs.shape[1])
+        return out
+
+    # -- member hot-swap ---------------------------------------------------
+
+    def poll(self, force: bool = False) -> List[int]:
+        """Poll every member's checkpoint; returns the member indices
+        whose swap APPLIED. Rejected candidates degrade only their own
+        member (counters on that member's engine); applied swaps
+        rebuild the affected slices and rebind the fleet wholesale."""
+        swapped = [
+            f
+            for f, w in enumerate(self.watchers)
+            if w.poll(force=force)
+        ]
+        if swapped:
+            fleet = self.fleet
+            for f in swapped:
+                fleet = fleet_set_member(fleet, f, self.members[f].block)
+            self.fleet = fleet  # single rebind: no torn fleet mid-loop
+        return swapped
+
+    # -- observability -----------------------------------------------------
+
+    def summary(self) -> dict:
+        """Fleet counters + the per-member degradation ledgers."""
+        return {
+            **self.counters,
+            "members": [m.summary() for m in self.members],
+            "degraded_members": [
+                f for f, m in enumerate(self.members) if m.degraded
+            ],
+        }
+
+    def summary_line(self) -> str:
+        """One line the CI cell greps: fleet traffic plus which members
+        are serving last-good (member-isolated degradation)."""
+        c = self.counters
+        per = ", ".join(
+            f"m{f}:{'last-good' if m.degraded else 'fresh'}"
+            f"({m.counters['swaps']}s/{m.counters['rejects']}r)"
+            for f, m in enumerate(self.members)
+        )
+        return (
+            f"fleet: {self.n_members} members, {c['launches']} launches, "
+            f"{c['actions']} actions [{per}]"
+        )

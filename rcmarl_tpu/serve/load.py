@@ -1,0 +1,315 @@
+"""Request-arrival latency harness — serving under load, measured.
+
+The serving engine's throughput rows (``bench.py --serve``) answer "how
+many actions/sec can one compiled launch sustain at a fixed batch?" —
+the offline half of the TF-Agents batched-inference tradition
+(PAPERS.md 1709.02878). The production question is different: requests
+ARRIVE, a micro-batching queue in front of :func:`serve_block` trades
+latency for batch efficiency, and the benchmark is p50/p99 latency vs
+offered load up to the knee where batching saturates. This module is
+that harness:
+
+- :func:`poisson_arrivals` / :func:`bursty_arrivals` — DETERMINISTIC
+  arrival plans (seeded ``numpy`` generators, host-side: no wall-clock
+  and no RNG anywhere near jitted code), in absolute simulated seconds.
+  Replaying the same ``(seed, n, rate)`` replays the exact plan.
+- :func:`run_load` — the single-server micro-batching queue over one
+  arrival plan: a batch closes when it FILLS (``max_batch`` requests)
+  or when the oldest waiting request has waited ``max_wait`` simulated
+  seconds, never before the server is free; every launch is the PADDED
+  ``max_batch`` shape whatever the fill, so the compile-once contract
+  holds across every load point (the ``lint --retrace`` fleet case
+  drives exactly this shape discipline). Service time per launch comes
+  from ``service_fn(fill)`` — a REAL measured launch on the serving
+  path, or an injected model in the unit tests — and the report carries
+  the latency percentiles, queue depth, fill, and utilization.
+- :func:`sweep_load` / :func:`saturation_knee` — the offered-load sweep
+  and the knee extraction: the highest swept load whose p99 stays
+  inside ``knee_factor`` x the lightest load's p99 with the server
+  still under-utilized; the first load past it is saturated (arrivals
+  outpace batch capacity and latency is backlog, not service).
+- :func:`serve_service_fn` / :func:`fleet_service_fn` — the real
+  service models: one wall-clock-timed dispatch of the compiled
+  :func:`~rcmarl_tpu.serve.engine.serve_block` /
+  :func:`~rcmarl_tpu.serve.fleet.fleet_block` program at the padded
+  ``max_batch`` shape (compile happens once, outside the timed
+  launches, like every bench harness here).
+
+The clock is SIMULATED (arrivals are a plan, not a socket), the service
+times are MEASURED — so a row is an honest hybrid: deterministic,
+replayable queueing over real launch costs on this host. Rows land in
+``BENCH_SERVE.jsonl`` via ``python bench.py --serve_load`` with the
+``cost_fingerprint`` + ``headline`` discipline every serving row
+carries.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+#: Default saturation criterion: a load point is past the knee when its
+#: p99 exceeds ``KNEE_FACTOR`` x the lightest swept load's p99 (latency
+#: has become backlog) or the server is effectively always busy.
+KNEE_FACTOR = 4.0
+KNEE_UTILIZATION = 0.98
+
+
+# --------------------------------------------------------------------------
+# Deterministic arrival plans
+# --------------------------------------------------------------------------
+
+
+def poisson_arrivals(seed: int, n: int, rate: float) -> np.ndarray:
+    """``n`` absolute arrival times (simulated seconds) of a Poisson
+    stream at ``rate`` requests/s — exponential inter-arrival gaps from
+    ``default_rng(seed)``, cumulatively summed. Deterministic in
+    ``(seed, n, rate)``."""
+    if n < 1 or rate <= 0.0:
+        raise ValueError(f"need n >= 1 and rate > 0 (got n={n}, rate={rate})")
+    rng = np.random.default_rng(seed)
+    return np.cumsum(rng.exponential(1.0 / rate, size=n))
+
+
+def bursty_arrivals(
+    seed: int, n: int, rate: float, burst: int = 8
+) -> np.ndarray:
+    """``n`` arrival times of a BURSTY stream at long-run offered load
+    ``rate``: bursts of ``burst`` simultaneous requests, burst starts
+    Poisson at ``rate / burst`` bursts/s — the same mean load as
+    :func:`poisson_arrivals` concentrated into spikes (the adversarial
+    arrival pattern for a micro-batching queue). Deterministic in
+    ``(seed, n, rate, burst)``."""
+    if burst < 1:
+        raise ValueError(f"burst={burst} must be >= 1")
+    n_bursts = math.ceil(n / burst)
+    starts = poisson_arrivals(seed, n_bursts, rate / burst)
+    return np.repeat(starts, burst)[:n]
+
+
+# --------------------------------------------------------------------------
+# The micro-batching queue (simulated clock, measured service)
+# --------------------------------------------------------------------------
+
+
+def run_load(
+    service_fn: Callable[[int], float],
+    arrivals: np.ndarray,
+    max_batch: int,
+    max_wait: float,
+) -> Dict[str, float]:
+    """Run one arrival plan through the single-server micro-batching
+    queue; returns the latency/queue report.
+
+    Close rule: with the server free at ``t`` and request ``i`` the
+    oldest waiting, the batch closes at
+    ``max(t, min(fill_time, arrivals[i] + max_wait))`` — when it fills
+    to ``max_batch``, or when the oldest request's ``max_wait`` budget
+    expires, whichever first, but never before the server frees (a
+    backlogged queue launches immediately). ``service_fn(fill)`` is the
+    seconds one launch of the padded ``max_batch`` program takes with
+    ``fill`` real requests; request latency = completion - arrival.
+
+    Report keys: ``p50/p95/p99`` latency (seconds), ``mean_latency``,
+    ``launches``, ``fill_mean`` (real requests per launch),
+    ``queue_depth_mean``/``queue_depth_max`` (waiting requests at each
+    close, incl. beyond ``max_batch``), ``utilization`` (service busy
+    fraction of the makespan), ``service_mean`` (seconds/launch).
+    """
+    if max_batch < 1:
+        raise ValueError(f"max_batch={max_batch} must be >= 1")
+    if max_wait < 0.0:
+        raise ValueError(f"max_wait={max_wait} must be >= 0")
+    arrivals = np.asarray(arrivals, dtype=np.float64)
+    n = arrivals.shape[0]
+    lat = np.empty(n, dtype=np.float64)
+    i = 0
+    t = 0.0
+    busy = 0.0
+    fills: List[int] = []
+    depths: List[int] = []
+    services: List[float] = []
+    while i < n:
+        open_t = max(t, float(arrivals[i]))
+        fill_t = (
+            float(arrivals[i + max_batch - 1])
+            if i + max_batch <= n
+            else math.inf
+        )
+        close_t = max(open_t, min(fill_t, float(arrivals[i]) + max_wait))
+        j = i
+        while j < n and j - i < max_batch and arrivals[j] <= close_t:
+            j += 1
+        fill = j - i
+        depths.append(
+            int(np.searchsorted(arrivals, close_t, side="right")) - i
+        )
+        s = float(service_fn(fill))
+        if not (s > 0.0 and math.isfinite(s)):
+            raise ValueError(f"service_fn({fill}) returned {s}")
+        lat[i:j] = (close_t + s) - arrivals[i:j]
+        busy += s
+        services.append(s)
+        fills.append(fill)
+        t = close_t + s
+        i = j
+    makespan = t - float(arrivals[0])
+    p50, p95, p99 = np.percentile(lat, [50.0, 95.0, 99.0])
+    return {
+        "requests": int(n),
+        "p50": float(p50),
+        "p95": float(p95),
+        "p99": float(p99),
+        "mean_latency": float(lat.mean()),
+        "launches": len(fills),
+        "fill_mean": float(np.mean(fills)),
+        "queue_depth_mean": float(np.mean(depths)),
+        "queue_depth_max": int(np.max(depths)),
+        "utilization": float(busy / makespan) if makespan > 0 else 1.0,
+        "service_mean": float(np.mean(services)),
+    }
+
+
+def sweep_load(
+    service_fn: Callable[[int], float],
+    loads: Sequence[float],
+    n_requests: int,
+    max_batch: int,
+    max_wait: float,
+    seed: int = 0,
+    arrival: str = "poisson",
+    burst: int = 8,
+) -> List[Dict[str, float]]:
+    """One :func:`run_load` report per offered load (requests/s), each
+    tagged with its ``offered_load`` and arrival process — the
+    latency-vs-load curve ``bench.py --serve_load`` emits. The SAME
+    seed namespaces every point, so the sweep is replayable end to
+    end."""
+    if arrival not in ("poisson", "bursty"):
+        raise ValueError(
+            f"arrival={arrival!r}: expected 'poisson' or 'bursty'"
+        )
+    points = []
+    for load in loads:
+        arr = (
+            poisson_arrivals(seed, n_requests, load)
+            if arrival == "poisson"
+            else bursty_arrivals(seed, n_requests, load, burst)
+        )
+        rep = run_load(service_fn, arr, max_batch, max_wait)
+        rep["offered_load"] = float(load)
+        rep["arrival"] = arrival
+        points.append(rep)
+    return points
+
+
+def saturation_knee(
+    points: Sequence[Dict[str, float]],
+    factor: float = KNEE_FACTOR,
+    max_utilization: float = KNEE_UTILIZATION,
+) -> Optional[float]:
+    """The saturation knee of a :func:`sweep_load` curve: the highest
+    ``offered_load`` still UNDER the knee — p99 within ``factor`` x the
+    lightest load's p99 and utilization below ``max_utilization``.
+    Returns None when even the lightest point is saturated (sweep
+    started past the knee)."""
+    if not points:
+        return None
+    ordered = sorted(points, key=lambda p: p["offered_load"])
+    base_p99 = ordered[0]["p99"]
+    knee = None
+    for p in ordered:
+        if p["p99"] > factor * base_p99 or p["utilization"] >= max_utilization:
+            break
+        knee = p["offered_load"]
+    return knee
+
+
+# --------------------------------------------------------------------------
+# Real service models (measured launches at the padded shape)
+# --------------------------------------------------------------------------
+
+
+def _pad_fill(obs_pool, fill: int):
+    """The padded launch input for ``fill`` real requests: the pool IS
+    the ``max_batch`` shape — rows past ``fill`` are padding the
+    latency accounting ignores (the queue bills only real requests),
+    so the launch shape never changes with the fill."""
+    del fill  # the launch shape is fixed; fill only feeds the accounting
+    return obs_pool
+
+
+def serve_service_fn(
+    cfg, block, max_batch: int, mode: str = "sample", seed: int = 0
+) -> Callable[[int], float]:
+    """A measured service model over the compiled
+    :func:`~rcmarl_tpu.serve.engine.serve_block` program at the padded
+    ``(max_batch, N, obs_dim)`` shape: compile + warm once here, then
+    each call is ONE wall-clock-timed launch (device-fetch barrier).
+    The returned closure is what :func:`run_load` bills batches with."""
+    import jax
+
+    from rcmarl_tpu.serve.engine import serve_block, serve_keys
+
+    obs = jax.random.normal(
+        jax.random.PRNGKey(seed), (max_batch, cfg.n_agents, cfg.obs_dim)
+    )
+    key = serve_keys(seed, 0)
+    # compile + one warm execution OUTSIDE the billed launches
+    jax.device_get(serve_block(cfg, block, obs, key, mode=mode)[0])
+    counter = {"launch": 0}
+
+    def service(fill: int) -> float:
+        counter["launch"] += 1
+        k = serve_keys(seed, counter["launch"])
+        t0 = time.perf_counter()
+        actions, _ = serve_block(
+            cfg, block, _pad_fill(obs, fill), k, mode=mode
+        )
+        jax.device_get(actions)
+        return time.perf_counter() - t0
+
+    return service
+
+
+def fleet_service_fn(
+    cfg,
+    fleet,
+    n_members: int,
+    max_batch: int,
+    mode: str = "sample",
+    seed: int = 0,
+) -> Callable[[int], float]:
+    """The fleet twin of :func:`serve_service_fn`: one timed launch of
+    the compiled :func:`~rcmarl_tpu.serve.fleet.fleet_block` program at
+    the padded shape, with a round-robin route (DATA — the route could
+    change per launch without a recompile; the harness keeps it fixed
+    so the billed cost is the steady-state one)."""
+    import jax
+    import jax.numpy as jnp
+
+    from rcmarl_tpu.serve.engine import serve_keys
+    from rcmarl_tpu.serve.fleet import fleet_block
+
+    obs = jax.random.normal(
+        jax.random.PRNGKey(seed), (max_batch, cfg.n_agents, cfg.obs_dim)
+    )
+    route = jnp.arange(max_batch, dtype=jnp.int32) % n_members
+    key = serve_keys(seed, 0)
+    jax.device_get(fleet_block(cfg, fleet, obs, key, route, mode=mode)[0])
+    counter = {"launch": 0}
+
+    def service(fill: int) -> float:
+        counter["launch"] += 1
+        k = serve_keys(seed, counter["launch"])
+        t0 = time.perf_counter()
+        actions, _ = fleet_block(
+            cfg, fleet, _pad_fill(obs, fill), k, route, mode=mode
+        )
+        jax.device_get(actions)
+        return time.perf_counter() - t0
+
+    return service

@@ -68,7 +68,14 @@ class CheckpointWatcher:
         self._sig = sig
         return self._try_swap()
 
-    def _try_swap(self) -> bool:
+    def _load_candidate(self):
+        """Load + fault-guard a swap candidate: ``(state, loaded_path)``
+        on success, ``None`` when the candidate was REJECTED (counters
+        incremented, engine degraded — it keeps serving the last good
+        block). The deployment-gate seam: the canary watcher
+        (:class:`rcmarl_tpu.serve.canary.CanaryWatcher`) runs its
+        frozen-policy return gate between this load and
+        :meth:`_apply`."""
         from rcmarl_tpu.faults import params_finite
         from rcmarl_tpu.utils.checkpoint import load_checkpoint_with_meta
 
@@ -83,7 +90,7 @@ class CheckpointWatcher:
             # block
             eng.counters["rejects"] += 1
             eng.degraded = True
-            return False
+            return None
         # A replica-world checkpoint appearing under a solo serving
         # path is an operator error — loud, exactly like the engine's
         # constructor (structure/shape mismatches already raised above).
@@ -101,13 +108,23 @@ class CheckpointWatcher:
         if not params_finite(state.params):
             eng.counters["rejects"] += 1
             eng.degraded = True
-            return False
-        # build + validate COMPLETELY, then swap the single reference:
-        # no serve can ever observe a torn tree
-        block = stack_actor_rows(state.params, eng.cfg)
-        eng.block = block
+            return None
+        return state, loaded
+
+    def _apply(self, state, loaded) -> bool:
+        """Apply a fully validated candidate: build the stacked block
+        COMPLETELY, then swap the engine's single reference — no serve
+        can ever observe a torn tree."""
+        eng = self.engine
+        eng.block = stack_actor_rows(state.params, eng.cfg)
         eng.counters["swaps"] += 1
         eng.degraded = False  # serving the newest candidate again
         if Path(loaded) != self.path:
             eng.counters["fallbacks"] += 1
         return True
+
+    def _try_swap(self) -> bool:
+        candidate = self._load_candidate()
+        if candidate is None:
+            return False
+        return self._apply(*candidate)

@@ -52,6 +52,7 @@ def jit_entry_points() -> Dict[str, object]:
         learner_block_donated,
     )
     from rcmarl_tpu.serve.engine import actor_block, eval_block, serve_block
+    from rcmarl_tpu.serve.fleet import fleet_block
     from rcmarl_tpu.training.trainer import train_block, train_block_donated
     from rcmarl_tpu.training.update import (
         consensus_block,
@@ -69,6 +70,7 @@ def jit_entry_points() -> Dict[str, object]:
         "fit_block": fit_block,
         "consensus_block": consensus_block,
         "serve_block": serve_block,
+        "fleet_block": fleet_block,
         "eval_block": eval_block,
         "actor_block": actor_block,
         "learner_block": learner_block,
@@ -244,6 +246,41 @@ def serve_entry_inputs(cfg):
     return _SERVE_INPUT_CACHE[cfg]
 
 
+_FLEET_INPUT_CACHE: dict = {}
+
+#: Canonical fleet size for the audit arms — two members is the
+#: smallest shape where the fleet axis and the routing gather are real
+#: in the audited program.
+FLEET_AUDIT_MEMBERS = 2
+
+
+def fleet_entry_inputs(cfg):
+    """(fleet, obs, key, route): tiny fleet-serving inputs for lowering
+    the fleet entry point, memoized per config. Member 0 is the SAME
+    memoized :func:`serve_entry_inputs` block (so a ``lint --all`` run
+    pays no extra init for it); member 1 is an independent fresh init —
+    a real second policy version, not a copy."""
+    if cfg not in _FLEET_INPUT_CACHE:
+        from rcmarl_tpu.serve.engine import stack_actor_rows
+        from rcmarl_tpu.serve.fleet import fleet_stack
+        from rcmarl_tpu.training.trainer import init_train_state
+
+        block, obs, key = serve_entry_inputs(cfg)
+        members = [block] + [
+            stack_actor_rows(
+                init_train_state(cfg, jax.random.PRNGKey(100 + f)).params,
+                cfg,
+            )
+            for f in range(1, FLEET_AUDIT_MEMBERS)
+        ]
+        route = (
+            jnp.arange(SERVE_AUDIT_BATCH, dtype=jnp.int32)
+            % FLEET_AUDIT_MEMBERS
+        )
+        _FLEET_INPUT_CACHE[cfg] = (fleet_stack(members), obs, key, route)
+    return _FLEET_INPUT_CACHE[cfg]
+
+
 def lowered_entry_points(
     cfg, with_diag: bool = False, names: Optional[Tuple[str, ...]] = None
 ) -> Dict[str, object]:
@@ -273,6 +310,9 @@ def lowered_entry_points(
                 elif name == "serve_block":
                     block, obs, skey = serve_entry_inputs(cfg)
                     lowered = fn.lower(cfg, block, obs, skey)
+                elif name == "fleet_block":
+                    fleet, obs, skey, route = fleet_entry_inputs(cfg)
+                    lowered = fn.lower(cfg, fleet, obs, skey, route)
                 elif name in ("eval_block", "actor_block"):
                     lowered = fn.lower(
                         cfg, state.params, state.desired, key, state.initial
@@ -374,6 +414,11 @@ def _traced_entry(cfg, with_diag: bool, name: str):
             closed, out_shape = jax.make_jaxpr(
                 lambda bl, o, k: fn(cfg, bl, o, k), return_shape=True
             )(block, obs, skey)
+        elif name == "fleet_block":
+            fleet, obs, skey, route = fleet_entry_inputs(cfg)
+            closed, out_shape = jax.make_jaxpr(
+                lambda fl, o, k, r: fn(cfg, fl, o, k, r), return_shape=True
+            )(fleet, obs, skey, route)
         elif name in ("eval_block", "actor_block"):
             closed, out_shape = jax.make_jaxpr(
                 lambda p, d, k, i: fn(cfg, p, d, k, i), return_shape=True

@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""The committed canary-gate experiment: the deployment loop closed.
+
+Trains ONE real run in three segments — an EARLY snapshot (the "stale
+publish" adversary), the INCUMBENT the serving engine deploys, and a
+LATER snapshot (the healthy publish) — then drives the full
+file-watcher deployment loop (:class:`rcmarl_tpu.serve.canary.
+CanaryWatcher` over a real checkpoint path) through four publishes:
+
+1. **healthy**: the later-training checkpoint — must PROMOTE (its
+   frozen-policy return sits inside the incumbent's band);
+2. **stale**: the early-training checkpoint — checksum-valid, fully
+   finite, just a WORSE policy: must be REJECTED by the BAND (the case
+   neither the checksum chain nor ``params_finite`` can catch);
+3. **poisoned**: NaN-injected params — must be rejected by the guard
+   in front of the gate, paying no eval;
+4. **re-publish**: the healthy checkpoint again — the gate must not
+   wedge after rejections.
+
+After every rejection the engine's serving block is verified BITWISE
+against the last promoted policy. The committed verdict lands in
+``simulation_results/canary_gate.json``; QUALITY.md's "Canary-gated
+deployment" section renders from it
+(:func:`rcmarl_tpu.analysis.quality.canary_section`).
+
+    python scripts/canary_experiment.py [--episodes 900] [--seed 300]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from datetime import datetime
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--episodes", type=int, default=900,
+                   help="episodes to the INCUMBENT checkpoint")
+    p.add_argument("--stale_frac", type=float, default=1 / 6,
+                   help="the stale snapshot's training fraction")
+    p.add_argument("--healthy_extra", type=int, default=300,
+                   help="extra episodes past the incumbent for the "
+                   "healthy candidate")
+    p.add_argument("--seed", type=int, default=300)
+    p.add_argument("--band", type=float, default=0.05,
+                   help="canary band (PARITY.md's 5% tolerance)")
+    p.add_argument("--blocks", type=int, default=2,
+                   help="eval blocks per gate measurement")
+    p.add_argument(
+        "--out", type=str,
+        default=str(Path(__file__).resolve().parent.parent
+                    / "simulation_results/canary_gate.json"),
+    )
+    args = p.parse_args()
+
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from rcmarl_tpu.config import Config
+    from rcmarl_tpu.serve.canary import CanaryGate, CanaryWatcher
+    from rcmarl_tpu.serve.engine import ServeEngine, stack_actor_rows
+    from rcmarl_tpu.training.trainer import train
+    from rcmarl_tpu.utils.checkpoint import save_checkpoint
+
+    cfg = Config(seed=args.seed)  # the reference 5-agent cooperative ring
+    blk = cfg.n_ep_fixed
+    stale_eps = max(blk, int(args.episodes * args.stale_frac) // blk * blk)
+    inc_eps = max(stale_eps + blk, args.episodes // blk * blk)
+    extra_eps = max(blk, args.healthy_extra // blk * blk)
+
+    t0 = time.perf_counter()
+    state, _ = train(cfg, n_episodes=stale_eps)
+    stale_state = jax.tree.map(lambda x: x, state)  # snapshot the pytree
+    state, _ = train(cfg, n_episodes=inc_eps - stale_eps, state=state)
+    incumbent_state = jax.tree.map(lambda x: x, state)
+    state, _ = train(cfg, n_episodes=extra_eps, state=state)
+    healthy_state = state
+    train_wall = round(time.perf_counter() - t0, 2)
+    print(f"trained {stale_eps}/{inc_eps}/{inc_eps + extra_eps} episode "
+          f"snapshots in {train_wall}s")
+
+    def poisoned(st):
+        import jax.numpy as jnp
+
+        return st._replace(
+            params=st.params._replace(
+                actor=jax.tree.map(
+                    lambda l: l.at[0].set(jnp.nan), st.params.actor
+                )
+            )
+        )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = Path(tmp) / "deployed.npz"
+        save_checkpoint(path, incumbent_state, cfg)
+        engine = ServeEngine(path)
+        gate = CanaryGate(
+            cfg, incumbent_state.desired, incumbent_state.initial,
+            band=args.band, blocks=args.blocks,
+        )
+        watcher = CanaryWatcher(engine, gate)
+        incumbent_return = gate.incumbent_return
+        print(f"incumbent ({inc_eps} eps) frozen return: "
+              f"{incumbent_return:.4f}")
+
+        last_promoted = incumbent_state
+        arms = []
+
+        def publish(label, st, expect_promoted, kind):
+            nonlocal last_promoted
+            save_checkpoint(path, st, cfg)
+            if kind == "poisoned":
+                # poison the rotated fallback too: the chain must not
+                # quietly serve the previous file and mask the reject
+                save_checkpoint(path, st, cfg)
+            floor = gate.floor()
+            evals_before = gate.counters["evals"]
+            applied = watcher.poll()
+            gated = gate.counters["evals"] > evals_before
+            if applied:
+                last_promoted = st
+            # after any rejection the engine must still serve the last
+            # promoted policy BITWISE
+            for a, b in zip(
+                jax.tree.leaves(engine.block),
+                jax.tree.leaves(stack_actor_rows(last_promoted.params, cfg)),
+            ):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            rec = {
+                "label": label,
+                "kind": kind,
+                "promoted": bool(applied),
+                "expected_promoted": expect_promoted,
+                "as_expected": bool(applied) == expect_promoted,
+                "floor": round(floor, 4),
+                "candidate_return": (
+                    round(gate.last["candidate_return"], 4)
+                    if gated and gate.last["candidate_return"] is not None
+                    else None
+                ),
+                "reason": (
+                    gate.last["reason"]
+                    if gated
+                    else "rejected by the finiteness guard (no eval paid)"
+                ),
+            }
+            arms.append(rec)
+            verdict = "promoted" if applied else "REJECTED"
+            print(f"{label}: {verdict} (candidate "
+                  f"{rec['candidate_return']}, floor {rec['floor']}) — "
+                  f"{'as expected' if rec['as_expected'] else 'UNEXPECTED'}")
+
+        publish(
+            f"healthy (+{extra_eps} eps)", healthy_state, True, "healthy"
+        )
+        publish(
+            f"stale ({stale_eps} eps snapshot)", stale_state, False, "stale"
+        )
+        publish("poisoned (NaN actor)", poisoned(healthy_state), False,
+                "poisoned")
+        publish(
+            f"healthy re-publish (+{extra_eps} eps)", healthy_state, True,
+            "healthy",
+        )
+
+        result = {
+            "config": {
+                "scenario": "coop ref5_ring (Config defaults)",
+                "episodes_stale": stale_eps,
+                "episodes_incumbent": inc_eps,
+                "episodes_healthy": inc_eps + extra_eps,
+                "seed": args.seed,
+                "band": args.band,
+                "eval_blocks": args.blocks,
+            },
+            "incumbent_return": round(incumbent_return, 4),
+            "arms": arms,
+            "gate_counters": dict(gate.counters),
+            "engine_counters": engine.summary(),
+            "gate_summary": gate.summary_line(),
+            "engine_summary": engine.summary_line(),
+            "all_as_expected": all(a["as_expected"] for a in arms),
+            "train_wall_s": train_wall,
+            "platform": jax.devices()[0].platform,
+            "timestamp": datetime.now().isoformat(timespec="seconds"),
+        }
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(result, indent=1) + "\n")
+    print(json.dumps(result, indent=1))
+    print(f"wrote {out}")
+    print(gate.summary_line())
+    # rc IS the acceptance gate: this experiment exists to prove the
+    # canary catches the degraded publishes and passes the healthy ones
+    return 0 if result["all_as_expected"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

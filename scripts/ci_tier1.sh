@@ -272,6 +272,107 @@ grep -q "hot-swap atomic OK" "$serve_log"
 grep -q "served: last-good" "$serve_log"
 echo "serve smoke cell OK"
 
+# Production-serving smoke cell (round 14): the latency/fleet/canary
+# tier end to end through the real CLI and engines, outside the pytest
+# budget — tiny train -> a fleet of 2 checkpoint versions served in ONE
+# launch (the CLI verifies per-member bitwise parity before timing;
+# grep the fleet row), one load burst through the micro-batching queue
+# (grep a latency point), corrupt one member -> the FLEET keeps serving
+# with that member degraded to last-good, and the canary gate: a
+# poisoned publish and a band-violating (fresh-init) publish are both
+# REJECTED (grep the "rejected" line) while a healthy re-publish
+# promotes. rc=0 throughout.
+prod_dir="$smoke_dir/prod_serve"
+timeout -k 10 180 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
+    --n_agents 3 --in_degree 3 --nrow 3 --ncol 3 \
+    --n_episodes 4 --n_ep_fixed 2 --max_ep_len 4 --n_epochs 2 --H 1 \
+    --checkpoint_every 1 --summary_dir "$prod_dir" --quiet
+prod_log="$smoke_dir/prod_serve.log"
+cp "$prod_dir/checkpoint.npz" "$prod_dir/member0.npz"
+cp "$prod_dir/checkpoint.npz.prev" "$prod_dir/member1.npz"
+timeout -k 10 180 env JAX_PLATFORMS=cpu python -m rcmarl_tpu serve \
+    --fleet "$prod_dir/member0.npz" "$prod_dir/member1.npz" \
+    --batch 16 --steps 4 --reps 1 | tee "$prod_log"
+grep -q '"member_parity": "bitwise"' "$prod_log"
+grep -q '"fleet": 2' "$prod_log"
+timeout -k 10 420 env JAX_PLATFORMS=cpu python - "$prod_dir" <<'PY' | tee "$prod_log"
+import sys
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rcmarl_tpu.serve.canary import CanaryGate, CanaryWatcher
+from rcmarl_tpu.serve.engine import ServeEngine
+from rcmarl_tpu.serve.fleet import FleetEngine
+from rcmarl_tpu.serve.load import fleet_service_fn, poisson_arrivals, run_load
+from rcmarl_tpu.training.trainer import init_train_state
+from rcmarl_tpu.utils.checkpoint import load_checkpoint_with_meta, save_checkpoint
+
+root = sys.argv[1]
+members = [f"{root}/member0.npz", f"{root}/member1.npz"]
+fleet = FleetEngine(members)
+
+# one load burst through the micro-batching queue over the REAL fleet
+# program (padded max_batch shape, measured launches)
+service = fleet_service_fn(fleet.cfg, fleet.fleet, 2, max_batch=16)
+rep = run_load(service, poisson_arrivals(0, 64, 2000.0), 16, 0.005)
+assert np.isfinite(rep["p99"]) and rep["p99"] > 0
+print(f"load burst: p50 {rep['p50']*1000:.2f} ms, "
+      f"p99 {rep['p99']*1000:.2f} ms, {rep['launches']} launches")
+
+# corrupt member 1 (primary, no .prev) -> that member degrades to its
+# last-good slice; the fleet keeps serving
+with open(members[1], "r+b") as f:
+    f.seek(100)
+    f.write(b"\xde\xad\xbe\xef" * 16)
+assert fleet.poll() == []  # the in-place corruption IS a file change
+assert fleet.members[1].degraded is True
+obs = jax.random.normal(
+    jax.random.PRNGKey(0), (8, fleet.cfg.n_agents, fleet.cfg.obs_dim)
+)
+actions, _ = fleet.serve(obs)
+assert np.isfinite(np.asarray(actions)).all()
+print(fleet.summary_line())
+
+# canary gate on the solo path: a poisoned publish is rejected by the
+# guard chain, a band-violating publish by the REAL band decision
+# (the incumbent reference is pinned above any achievable return, so a
+# finite fresh-init candidate is deterministically below the floor —
+# the committed canary_gate.json experiment carries the
+# trained-vs-stale version of this arm), and a healthy re-publish
+# promotes after the rejections
+path = f"{root}/checkpoint.npz"
+eng = ServeEngine(path)
+state, cfg, _, _ = load_checkpoint_with_meta(path)
+gate = CanaryGate(cfg, state.desired, state.initial, band=0.05, blocks=1)
+watcher = CanaryWatcher(eng, gate)
+poisoned = state._replace(params=state.params._replace(
+    actor=jax.tree.map(lambda l: jnp.asarray(l).at[0].set(jnp.nan),
+                       state.params.actor)))
+save_checkpoint(path, poisoned, cfg)
+save_checkpoint(path, poisoned, cfg)  # poison the .prev rotation too
+assert watcher.poll() is False, "poisoned publish was not rejected"
+assert eng.counters["rejects"] == 1 and eng.degraded
+print("canary: poisoned publish rejected (guard, no eval paid)")
+gate.incumbent_return = 0.0  # floor above any achievable return here
+fresh = init_train_state(cfg, jax.random.PRNGKey(123))
+save_checkpoint(path, fresh, cfg)
+assert watcher.poll() is False, "band-violating publish was not rejected"
+assert gate.last["reason"] == "frozen return below the band floor"
+print("canary: band-violating publish rejected "
+      f"(candidate {gate.last['candidate_return']:.3f} < "
+      f"floor {gate.last['floor']:.3f})")
+gate.set_incumbent(state.params)  # back to the measured incumbent
+save_checkpoint(path, state, cfg)  # healthy re-publish
+assert watcher.poll() is True, "healthy re-publish did not promote"
+print(gate.summary_line())
+print(eng.summary_line())
+PY
+grep -q "load burst: p50" "$prod_log"
+grep -q "m1:last-good" "$prod_log"
+grep -q "rejected" "$prod_log"
+echo "production-serving smoke cell OK"
+
 # Pipeline smoke cell: the async actor-learner pipeline end to end
 # through the real CLI — a depth-2 pipelined run with a sparse publish
 # cadence must exit rc=0 with the staleness counters on the summary

@@ -143,6 +143,36 @@ run_step "9b. fit-scan kernel refit (fitstack pallas vs scan, on-chip)" \
     --configs n16_mixed n64_full \
     --fitstack on pallas --consensus_micro --out PERF.jsonl
 
+# The production serving tier (PR 14): the committed latency-vs-load
+# sweep and the F=4 fleet row are CPU fallbacks (headline:false). (10)
+# re-runs the micro-batching latency sweep on-chip — Poisson + bursty
+# arrival twins at max_batch 4096 up to 80M req/s offered, the
+# saturation knee is the headline value; (10b) trains a fresh ref5
+# checkpoint, snapshots four policy versions, and serves them as ONE
+# fleet launch on-chip (per-member bitwise parity verified by the CLI
+# before timing). Both tee into BENCH_SERVE.jsonl like step 6.
+run_step "10. serving latency knee refit (micro-batching queue, on-chip)" \
+    bash -c 'set -o pipefail; timeout 1800 python bench.py --serve_load | tee -a BENCH_SERVE.jsonl'
+
+run_step "10b. fleet serving row (F=4 policy versions, one launch)" \
+    bash -c 'set -o pipefail; d=$(mktemp -d); \
+      timeout 900 python - "$d" <<'"'"'PY'"'"'
+import sys, jax
+from pathlib import Path
+from rcmarl_tpu.config import Config
+from rcmarl_tpu.training.trainer import train
+from rcmarl_tpu.utils.checkpoint import save_checkpoint
+cfg = Config(slow_lr=0.002, fast_lr=0.01, seed=100)
+out = Path(sys.argv[1]); state = None
+for v in range(4):
+    state, _ = train(cfg, n_episodes=100, state=state)
+    save_checkpoint(out / f"policy_v{v + 1}.npz", state, cfg)
+PY
+      timeout 900 python -m rcmarl_tpu serve \
+        --fleet "$d"/policy_v1.npz "$d"/policy_v2.npz \
+                "$d"/policy_v3.npz "$d"/policy_v4.npz \
+        --batch 4096 --steps 30 --reps 3 --out BENCH_SERVE.jsonl'
+
 echo "== session summary =="
 rc=0
 for name in "${step_order[@]}"; do

@@ -61,7 +61,9 @@ class TestPolicyPublisher:
         assert pub.published_block == 0
         assert pub.offer({"w": np.full(3, 2.0)}, 2) is True
         assert pub.published_block == 2
-        assert pub.counters == {"publishes": 1, "rejects": 0}
+        assert pub.counters == {
+            "publishes": 1, "rejects": 0, "canary_rejects": 0,
+        }
 
     def test_validate_rejects_nonfinite_keeps_last_good(self):
         good = {"w": np.ones(3, np.float32)}
@@ -69,7 +71,9 @@ class TestPolicyPublisher:
         bad = {"w": np.array([1.0, np.nan, 1.0], np.float32)}
         assert pub.offer(bad, 1) is False
         assert pub.acting is good  # last good kept, wholesale
-        assert pub.counters == {"publishes": 0, "rejects": 1}
+        assert pub.counters == {
+            "publishes": 0, "rejects": 1, "canary_rejects": 0,
+        }
         fresh = {"w": np.full(3, 2.0, np.float32)}
         assert pub.offer(fresh, 2) is True
         assert pub.acting is fresh and pub.published_block == 2

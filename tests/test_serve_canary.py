@@ -1,0 +1,363 @@
+"""Canary-gated deployment contracts (rcmarl_tpu.serve.canary +
+the pipeline publisher's canary hook).
+
+The pins that close the deployment loop:
+
+- the GATE's decision rule is exact: candidate frozen return below
+  ``incumbent - band * |incumbent|`` -> rejected; at/above -> promoted
+  with the incumbent reference advanced; non-finite params -> rejected
+  WITHOUT paying an eval; non-finite measured return -> rejected;
+- gate measurements are DETERMINISTIC: the same candidate measures the
+  same frozen return (the eval stream is seeded), so a decision is
+  replayable;
+- the WATCHER splices the gate between candidate validation and the
+  atomic swap: a gate-rejected file candidate leaves the engine
+  serving the incumbent bitwise with the degradation counters
+  incremented ('served: last-good'), a promoted one swaps atomically;
+- the PUBLISHER's canary hook gives the in-memory pipeline chain the
+  same protection (canary_rejects counted, acting tree untouched).
+
+Band-logic cells run on a scripted ``frozen_return`` (deterministic,
+no rollouts); a small number of real eval_block measurements pin the
+measurement path itself.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+from rcmarl_tpu.pipeline.publish import PolicyPublisher
+from rcmarl_tpu.serve.canary import CanaryGate, CanaryWatcher
+from rcmarl_tpu.serve.engine import ServeEngine, stack_actor_rows
+from rcmarl_tpu.training.trainer import init_train_state
+from rcmarl_tpu.utils.checkpoint import save_checkpoint
+
+
+def tiny_cfg(**overrides):
+    base = dict(
+        n_agents=3,
+        agent_roles=(Roles.COOPERATIVE,) * 3,
+        in_nodes=circulant_in_nodes(3, 3),
+        nrow=3,
+        ncol=3,
+        n_episodes=4,
+        n_ep_fixed=2,
+        max_ep_len=4,
+        n_epochs=2,
+        H=1,
+    )
+    base.update(overrides)
+    return Config(**base)
+
+
+CFG = tiny_cfg()
+STATE = init_train_state(CFG, jax.random.PRNGKey(0))
+STATE_B = init_train_state(CFG, jax.random.PRNGKey(1))
+
+
+def _poison(state):
+    return state._replace(
+        params=state.params._replace(
+            actor=jax.tree.map(
+                lambda l: l.at[0].set(jnp.nan), state.params.actor
+            )
+        )
+    )
+
+
+class ScriptedGate(CanaryGate):
+    """The band-logic test vehicle: frozen_return reads a scripted
+    queue instead of rolling out, so each decision's inputs are exact
+    and the band arithmetic is the only thing under test."""
+
+    def __init__(self, returns, **kw):
+        super().__init__(CFG, STATE.desired, STATE.initial, **kw)
+        self._returns = list(returns)
+
+    def frozen_return(self, params):
+        return self._returns.pop(0)
+
+
+class TestCanaryGateDecision:
+    def test_band_floor_arithmetic(self):
+        g = ScriptedGate([-5.0], band=0.05)
+        g.incumbent_return = -5.0
+        assert g.floor() == pytest.approx(-5.25)
+
+    def test_candidate_below_floor_rejected(self):
+        g = ScriptedGate([-5.0, -5.26], band=0.05)
+        g.set_incumbent(STATE.params)  # scripted -5.0
+        assert g.admit(STATE_B.params) is False
+        assert g.counters == {"evals": 1, "rejects": 1, "accepts": 0}
+        assert g.last["accepted"] is False
+        assert g.last["reason"] == "frozen return below the band floor"
+        assert g.last["floor"] == pytest.approx(-5.25)
+        assert g.last["degradation"] == pytest.approx(0.26)
+        # the incumbent reference is untouched: it keeps serving
+        assert g.incumbent_return == pytest.approx(-5.0)
+
+    def test_candidate_within_band_promoted_and_becomes_incumbent(self):
+        g = ScriptedGate([-5.0, -5.2], band=0.05)
+        g.set_incumbent(STATE.params)
+        assert g.admit(STATE_B.params) is True
+        assert g.counters["accepts"] == 1
+        # the promoted candidate IS the new incumbent reference
+        assert g.incumbent_return == pytest.approx(-5.2)
+
+    def test_improving_candidate_promoted(self):
+        g = ScriptedGate([-5.0, -4.0], band=0.05)
+        g.set_incumbent(STATE.params)
+        assert g.admit(STATE_B.params) is True
+        assert g.incumbent_return == pytest.approx(-4.0)
+
+    def test_nan_poisoned_candidate_rejected_without_eval(self):
+        """Non-finite params short-circuit BEFORE the frozen-return
+        measurement (the scripted queue holds only the incumbent's
+        value — an eval would pop from an empty list and fail)."""
+        g = ScriptedGate([-5.0], band=0.05)
+        g.set_incumbent(STATE.params)
+        assert g.admit(_poison(STATE_B).params) is False
+        assert g.counters == {"evals": 0, "rejects": 1, "accepts": 0}
+        assert g.last["reason"] == "non-finite candidate params"
+
+    def test_nonfinite_frozen_return_rejected(self):
+        g = ScriptedGate([-5.0, float("nan")], band=0.05)
+        g.set_incumbent(STATE.params)
+        assert g.admit(STATE_B.params) is False
+        assert g.last["reason"] == "non-finite frozen return"
+
+    def test_no_incumbent_is_loud(self):
+        g = ScriptedGate([-5.0])
+        with pytest.raises(RuntimeError, match="incumbent"):
+            g.admit(STATE.params)
+
+    def test_invalid_knobs_loud(self):
+        with pytest.raises(ValueError, match="band"):
+            CanaryGate(CFG, STATE.desired, STATE.initial, band=-0.1)
+        with pytest.raises(ValueError, match="blocks"):
+            CanaryGate(CFG, STATE.desired, STATE.initial, blocks=0)
+
+    def test_summary_line_reads_the_last_decision(self):
+        g = ScriptedGate([-5.0, -9.0], band=0.05)
+        g.set_incumbent(STATE.params)
+        g.admit(STATE_B.params)
+        line = g.summary_line()
+        assert "0 accepted, 1 rejected" in line
+        assert "rejected (frozen return below the band floor)" in line
+
+
+class TestCanaryGateMeasurement:
+    def test_frozen_return_deterministic(self):
+        """The real measurement path: the same params measure the same
+        return (seeded eval stream) — a gate decision is replayable."""
+        g = CanaryGate(CFG, STATE.desired, STATE.initial, blocks=1)
+        r1 = g.frozen_return(STATE.params)
+        r2 = g.frozen_return(STATE.params)
+        assert np.isfinite(r1)
+        assert r1 == r2
+
+    def test_identical_candidate_always_promotes(self):
+        """A republish of the serving params can never be rejected:
+        its frozen return IS the incumbent's (same seeds, same
+        policy)."""
+        g = CanaryGate(CFG, STATE.desired, STATE.initial, blocks=1)
+        g.set_incumbent(STATE.params)
+        assert g.admit(STATE.params) is True
+
+
+class TestCanaryWatcher:
+    def _watcher(self, tmp_path, gate=None):
+        path = tmp_path / "checkpoint.npz"
+        save_checkpoint(path, STATE, CFG)
+        eng = ServeEngine(path)
+        if gate is None:
+            gate = ScriptedGate([-5.0], band=0.05)
+        return eng, CanaryWatcher(eng, gate), path
+
+    def test_incumbent_pinned_at_construction(self, tmp_path):
+        _, w, _ = self._watcher(tmp_path)
+        assert w.gate.incumbent_return == pytest.approx(-5.0)
+
+    def test_band_violating_candidate_keeps_incumbent(self, tmp_path):
+        """A checksum-valid, fully finite candidate whose frozen return
+        fell out of the band: rejected on BOTH ledgers, the engine
+        serving the incumbent bitwise — 'bad policy' behaves exactly
+        like 'corrupt file'."""
+        eng, w, path = self._watcher(
+            tmp_path, ScriptedGate([-5.0, -9.0], band=0.05)
+        )
+        save_checkpoint(path, STATE_B, CFG)
+        assert w.poll() is False
+        assert w.gate.counters["rejects"] == 1
+        assert eng.counters["rejects"] == 1
+        assert eng.counters["swaps"] == 0
+        for a, b in zip(
+            jax.tree.leaves(eng.block),
+            jax.tree.leaves(stack_actor_rows(STATE.params, CFG)),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert "served: last-good" in eng.summary_line()
+
+    def test_healthy_candidate_promotes_atomically(self, tmp_path):
+        eng, w, path = self._watcher(
+            tmp_path, ScriptedGate([-5.0, -4.9], band=0.05)
+        )
+        save_checkpoint(path, STATE_B, CFG)
+        assert w.poll() is True
+        assert eng.counters["swaps"] == 1
+        for a, b in zip(
+            jax.tree.leaves(eng.block),
+            jax.tree.leaves(stack_actor_rows(STATE_B.params, CFG)),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert w.gate.incumbent_return == pytest.approx(-4.9)
+        assert "served: fresh" in eng.summary_line()
+
+    def test_poisoned_candidate_rejected_before_the_gate(self, tmp_path):
+        """A NaN candidate is the FILE chain's reject (params_finite in
+        _load_candidate): the gate pays no eval and its counters stay
+        clean — the scripted queue holds only the incumbent value."""
+        eng, w, path = self._watcher(tmp_path)
+        poisoned = _poison(STATE_B)
+        save_checkpoint(path, poisoned, CFG)
+        save_checkpoint(path, poisoned, CFG)  # poison .prev too
+        assert w.poll() is False
+        assert eng.counters["rejects"] == 1
+        assert w.gate.counters["evals"] == 0
+
+    def test_reject_then_promote_sequence(self, tmp_path):
+        """The committed-experiment shape: a degraded publish is caught,
+        the next healthy publish still promotes (the gate does not
+        wedge)."""
+        eng, w, path = self._watcher(
+            tmp_path, ScriptedGate([-5.0, -9.0, -5.01], band=0.05)
+        )
+        save_checkpoint(path, STATE_B, CFG)
+        assert w.poll() is False
+        save_checkpoint(path, STATE_B, CFG)
+        assert w.poll() is True
+        assert w.gate.counters == {"evals": 2, "accepts": 1, "rejects": 1}
+        assert eng.counters["swaps"] == 1 and eng.counters["rejects"] == 1
+
+
+class TestPublisherCanaryHook:
+    def test_canary_reject_keeps_acting_tree(self):
+        pub = PolicyPublisher(
+            STATE.params, 1, canary=lambda params: False
+        )
+        assert pub.offer(STATE_B.params, 1) is False
+        assert pub.counters["canary_rejects"] == 1
+        assert pub.counters["publishes"] == 0
+        assert pub.acting is STATE.params  # untouched reference
+
+    def test_canary_accept_publishes(self):
+        seen = []
+
+        def canary(params):
+            seen.append(params)
+            return True
+
+        pub = PolicyPublisher(STATE.params, 1, canary=canary)
+        assert pub.offer(STATE_B.params, 1) is True
+        assert seen == [STATE_B.params]
+        assert pub.acting is STATE_B.params
+        assert pub.counters["publishes"] == 1
+
+    def test_finiteness_guard_runs_before_the_canary(self):
+        """validate=True rejects a NaN candidate BEFORE the canary
+        callable sees it — the eval never pays for a tree the cheap
+        guard already condemned."""
+        calls = []
+        pub = PolicyPublisher(
+            STATE.params, 1, validate=True,
+            canary=lambda p: calls.append(p) or True,
+        )
+        assert pub.offer(_poison(STATE_B).params, 1) is False
+        assert pub.counters["rejects"] == 1
+        assert pub.counters["canary_rejects"] == 0
+        assert calls == []
+
+    def test_canary_respects_publish_cadence(self):
+        calls = []
+        pub = PolicyPublisher(
+            STATE.params, 2, canary=lambda p: calls.append(p) or True
+        )
+        assert pub.offer(STATE_B.params, 1) is False  # not a boundary
+        assert calls == []  # the gate is not consulted off-boundary
+        assert pub.offer(STATE_B.params, 2) is True
+        assert len(calls) == 1
+
+    def test_real_gate_bound_to_publisher(self):
+        """The intended composition: PolicyPublisher(canary=gate.admit)
+        with the REAL gate — a republish of the incumbent promotes
+        (identical frozen return), and the gate counters land."""
+        gate = CanaryGate(CFG, STATE.desired, STATE.initial, blocks=1)
+        gate.set_incumbent(STATE.params)
+        pub = PolicyPublisher(STATE.params, 1, canary=gate.admit)
+        assert pub.offer(STATE.params, 1) is True
+        assert gate.counters["accepts"] == 1
+
+
+class TestCanarySection:
+    def test_renders_from_the_committed_artifact(self):
+        """QUALITY.md's canary section renders from the committed
+        experiment artifact (render-from-evidence, never hand-typed);
+        absent artifact renders empty."""
+        from pathlib import Path
+
+        from rcmarl_tpu.analysis.quality import canary_section
+
+        artifact = (
+            Path(__file__).resolve().parent.parent
+            / "simulation_results/canary_gate.json"
+        )
+        if not artifact.exists():
+            pytest.skip("committed canary artifact not present")
+        lines = canary_section(artifact)
+        text = "\n".join(lines)
+        assert "## Canary-gated deployment" in text
+        assert "**REJECTED**" in text
+        assert "promoted" in text
+        assert canary_section("/nonexistent/canary.json") == []
+
+
+class TestCanaryCLI:
+    def test_serve_canary_band_requires_watch(self, tmp_path):
+        from rcmarl_tpu.cli import main
+
+        path = tmp_path / "checkpoint.npz"
+        save_checkpoint(path, STATE, CFG)
+        with pytest.raises(SystemExit, match="watch_every"):
+            main([
+                "serve", "--checkpoint", str(path), "--canary_band", "0.05",
+            ])
+
+    @pytest.mark.slow
+    def test_serve_cli_canary_row(self, tmp_path, capsys):
+        """The CLI wire-up: a canary-gated serve run emits the gate
+        counters on the row and the canary summary line (an identical
+        checkpoint republished mid-loop promotes). Slow marker: the
+        ci_tier1.sh smoke cell drives the same chain through the real
+        CLI outside the pytest budget."""
+        import json
+
+        from rcmarl_tpu.cli import main
+
+        path = tmp_path / "checkpoint.npz"
+        save_checkpoint(path, STATE, CFG)
+        assert main([
+            "serve", "--checkpoint", str(path),
+            "--batch", "4", "--steps", "2", "--reps", "1",
+            "--obs_buffers", "1", "--watch_every", "1",
+            "--canary_band", "0.05",
+        ]) == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        row = json.loads(out[0])
+        assert row["canary"]["band"] == 0.05
+        assert np.isfinite(row["canary"]["incumbent_return"])
+        assert out[-1].startswith("canary:")

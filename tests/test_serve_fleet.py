@@ -1,0 +1,312 @@
+"""Fleet-serving contracts (rcmarl_tpu.serve.fleet).
+
+The pins that make a fleet row trustworthy:
+
+- PER-MEMBER BITWISE PARITY: member f's probabilities inside the fleet
+  launch equal the solo ``serve_block`` probabilities on the same
+  checkpoint bitwise, and a request routed to f samples the exact
+  action it would get solo (shared fold_in keys);
+- ROUTING IS DATA: re-routing between launches re-dispatches the same
+  compiled executable — zero recompiles across route changes and
+  member hot-swaps (the compile-count pin; the lint --retrace fleet
+  case drives the full matrix);
+- MEMBER-ISOLATED DEGRADATION: a corrupt/poisoned member candidate
+  degrades only that member to its last-good slice — the fleet keeps
+  serving and the other members keep swapping;
+- config homogeneity is loud.
+
+Tiny 3-agent configs, states built directly by ``init_train_state``
+(no training) — the tier-1 budget discipline of tests/test_serve.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+from rcmarl_tpu.serve.engine import (
+    serve_block,
+    serve_request_keys,
+    stack_actor_rows,
+)
+from rcmarl_tpu.serve.fleet import (
+    FleetEngine,
+    fleet_block,
+    fleet_set_member,
+    fleet_stack,
+)
+from rcmarl_tpu.training.trainer import init_train_state
+from rcmarl_tpu.utils.checkpoint import save_checkpoint
+
+
+def tiny_cfg(**overrides):
+    base = dict(
+        n_agents=3,
+        agent_roles=(Roles.COOPERATIVE,) * 3,
+        in_nodes=circulant_in_nodes(3, 3),
+        nrow=3,
+        ncol=3,
+        n_episodes=4,
+        n_ep_fixed=2,
+        max_ep_len=4,
+        n_epochs=2,
+        H=1,
+    )
+    base.update(overrides)
+    return Config(**base)
+
+
+CFG = tiny_cfg()
+STATES = [init_train_state(CFG, jax.random.PRNGKey(s)) for s in range(3)]
+BLOCKS = [stack_actor_rows(s.params, CFG) for s in STATES]
+B = 6
+OBS = jax.random.normal(jax.random.PRNGKey(5), (B, CFG.n_agents, CFG.obs_dim))
+KEY = jax.random.PRNGKey(9)
+ROUTE = jnp.arange(B, dtype=jnp.int32) % 2
+
+
+def _leaves_equal(a, b):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def _fleet_engine(tmp_path, n=2, **kw):
+    paths = []
+    for f in range(n):
+        p = tmp_path / f"member{f}.npz"
+        save_checkpoint(p, STATES[f], CFG)
+        paths.append(p)
+    return FleetEngine(paths, **kw), paths
+
+
+class TestFleetBlock:
+    def test_fleet_stack_adds_leading_member_axis(self):
+        fleet = fleet_stack(BLOCKS[:2])
+        for fl, b0 in zip(jax.tree.leaves(fleet), jax.tree.leaves(BLOCKS[0])):
+            assert fl.shape == (2,) + b0.shape
+        # row f IS member f, bitwise
+        for f in range(2):
+            _leaves_equal(
+                jax.tree.map(lambda l: l[f], fleet), BLOCKS[f]
+            )
+
+    def test_per_member_probs_bitwise_vs_solo(self):
+        """THE fleet acceptance pin: every request's probability row is
+        BITWISE the routed member's solo serve_block row."""
+        fleet = fleet_stack(BLOCKS[:2])
+        _, fleet_probs = fleet_block(CFG, fleet, OBS, KEY, ROUTE)
+        solo = [
+            np.asarray(serve_block(CFG, blk, OBS, KEY)[1])
+            for blk in BLOCKS[:2]
+        ]
+        r = np.asarray(ROUTE)
+        for b in range(B):
+            np.testing.assert_array_equal(
+                np.asarray(fleet_probs)[b], solo[r[b]][b]
+            )
+
+    def test_routed_actions_bitwise_vs_solo(self):
+        """A request routed to member f samples the EXACT action it
+        would get from solo serving f — the fold_in key discipline is
+        member-independent, so routing cannot change a draw."""
+        fleet = fleet_stack(BLOCKS[:2])
+        fleet_actions, _ = fleet_block(CFG, fleet, OBS, KEY, ROUTE)
+        solo = [
+            np.asarray(serve_block(CFG, blk, OBS, KEY)[0])
+            for blk in BLOCKS[:2]
+        ]
+        r = np.asarray(ROUTE)
+        for b in range(B):
+            np.testing.assert_array_equal(
+                np.asarray(fleet_actions)[b], solo[r[b]][b]
+            )
+
+    def test_greedy_routes_argmax(self):
+        fleet = fleet_stack(BLOCKS[:2])
+        actions, probs = fleet_block(
+            CFG, fleet, OBS, KEY, ROUTE, mode="greedy"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(actions), np.asarray(jnp.argmax(probs, axis=-1))
+        )
+
+    def test_sample_keys_are_the_solo_keys(self):
+        """Fleet sampling consumes serve_request_keys(key, B, N) —
+        verified by replaying the categorical draw per (request,
+        agent)."""
+        fleet = fleet_stack(BLOCKS[:2])
+        actions, probs = fleet_block(CFG, fleet, OBS, KEY, ROUTE)
+        keys = serve_request_keys(KEY, B, CFG.n_agents)
+        for b in range(B):
+            for n in range(CFG.n_agents):
+                a = jax.random.categorical(keys[b, n], jnp.log(probs[b, n]))
+                assert int(a) == int(actions[b, n]), (b, n)
+
+    def test_route_changes_and_member_swaps_share_one_program(self):
+        """Routing and the fleet tree are DATA: re-routes, member
+        hot-swaps, and repeated batches reuse the compiled executable —
+        the jit cache must not grow after warmup."""
+        fleet = fleet_stack(BLOCKS[:2])
+        swapped = fleet_set_member(fleet, 1, BLOCKS[2])
+        routes = [
+            jnp.zeros((B,), jnp.int32),
+            ROUTE,
+            jnp.ones((B,), jnp.int32),
+        ]
+        fleet_block(CFG, fleet, OBS, KEY, routes[0])  # warmup (this cfg)
+        before = int(fleet_block._cache_size())
+        for fl in (fleet, swapped):
+            for route in routes:
+                fleet_block(CFG, fl, OBS, KEY, route)
+        assert int(fleet_block._cache_size()) == before
+
+    def test_bad_mode_loud(self):
+        with pytest.raises(ValueError, match="mode"):
+            fleet_block(
+                CFG, fleet_stack(BLOCKS[:2]), OBS, KEY, ROUTE, mode="nope"
+            )
+
+
+class TestFleetSetMember:
+    def test_replaces_exactly_one_slice(self):
+        fleet = fleet_stack(BLOCKS[:2])
+        out = fleet_set_member(fleet, 1, BLOCKS[2])
+        _leaves_equal(jax.tree.map(lambda l: l[0], out), BLOCKS[0])
+        _leaves_equal(jax.tree.map(lambda l: l[1], out), BLOCKS[2])
+        # the original fleet is untouched (functional update)
+        _leaves_equal(jax.tree.map(lambda l: l[1], fleet), BLOCKS[1])
+
+
+class TestFleetEngine:
+    def test_serve_round_robin_matches_fleet_block(self, tmp_path):
+        eng, _ = _fleet_engine(tmp_path)
+        a, p = eng.serve(OBS, key=KEY)
+        ref_a, ref_p = fleet_block(
+            CFG, eng.fleet, OBS, KEY, eng.round_robin_route(B)
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(ref_a))
+        np.testing.assert_array_equal(np.asarray(p), np.asarray(ref_p))
+        assert eng.counters["launches"] == 1
+        assert eng.counters["actions"] == B * CFG.n_agents
+
+    def test_member_swap_updates_only_that_slice(self, tmp_path):
+        eng, paths = _fleet_engine(tmp_path)
+        save_checkpoint(paths[1], STATES[2], CFG)
+        assert eng.poll() == [1]
+        _leaves_equal(
+            jax.tree.map(lambda l: l[0], eng.fleet), BLOCKS[0]
+        )
+        _leaves_equal(
+            jax.tree.map(lambda l: l[1], eng.fleet), BLOCKS[2]
+        )
+        assert eng.members[1].counters["swaps"] == 1
+
+    def test_corrupt_member_degrades_alone(self, tmp_path):
+        """One member's primary AND .prev corrupted: that member is
+        rejected to its last-good slice, the OTHER member still swaps —
+        the fleet never degrades past the bad member."""
+        eng, paths = _fleet_engine(tmp_path)
+        # member 1 gets a real update first (so .prev exists), then
+        # both its files are corrupted
+        save_checkpoint(paths[1], STATES[2], CFG)
+        assert eng.poll() == [1]
+        for suffix in ("", ".prev"):
+            with open(str(paths[1]) + suffix, "r+b") as f:
+                f.seek(100)
+                f.write(b"\xde\xad\xbe\xef" * 16)
+        # member 0 publishes a healthy update in the same poll round
+        save_checkpoint(paths[0], STATES[2], CFG)
+        assert eng.poll() == [0]
+        assert eng.members[1].counters["rejects"] == 1
+        assert eng.members[1].degraded is True
+        assert eng.members[0].degraded is False
+        # fleet: member 0 fresh, member 1 last-good (its prior swap)
+        _leaves_equal(
+            jax.tree.map(lambda l: l[0], eng.fleet), BLOCKS[2]
+        )
+        _leaves_equal(
+            jax.tree.map(lambda l: l[1], eng.fleet), BLOCKS[2]
+        )
+        assert eng.summary()["degraded_members"] == [1]
+        assert "m1:last-good" in eng.summary_line()
+        assert "m0:fresh" in eng.summary_line()
+
+    def test_poisoned_member_candidate_rejected_alone(self, tmp_path):
+        eng, paths = _fleet_engine(tmp_path)
+        poisoned = STATES[2]._replace(
+            params=STATES[2].params._replace(
+                actor=jax.tree.map(
+                    lambda l: l.at[0].set(jnp.nan), STATES[2].params.actor
+                )
+            )
+        )
+        save_checkpoint(paths[0], poisoned, CFG)
+        assert eng.poll() == []
+        assert eng.members[0].counters["rejects"] == 1
+        _leaves_equal(
+            jax.tree.map(lambda l: l[0], eng.fleet), BLOCKS[0]
+        )
+
+    def test_mixed_config_members_fail_loudly(self, tmp_path):
+        p0 = tmp_path / "m0.npz"
+        save_checkpoint(p0, STATES[0], CFG)
+        other_cfg = tiny_cfg(hidden=(16, 16))
+        p1 = tmp_path / "m1.npz"
+        save_checkpoint(
+            p1, init_train_state(other_cfg, jax.random.PRNGKey(0)), other_cfg
+        )
+        with pytest.raises(ValueError, match="share ONE serving config"):
+            FleetEngine([p0, p1])
+
+    def test_empty_fleet_loud(self):
+        with pytest.raises(ValueError, match="at least one"):
+            FleetEngine([])
+
+
+class TestFleetCLI:
+    @pytest.mark.slow
+    def test_serve_fleet_cli_emits_parity_certified_row(
+        self, tmp_path, capsys
+    ):
+        # slow marker: the CLI wire-up is also CI-enforced end to end by
+        # the ci_tier1.sh production-serving smoke cell (the PR-8/PR-9
+        # budget-shedding pattern); the bitwise parity pin itself stays
+        # tier-1 (TestFleetBlock above)
+        import json
+
+        from rcmarl_tpu.cli import main
+
+        paths = []
+        for f in range(2):
+            p = tmp_path / f"m{f}.npz"
+            save_checkpoint(p, STATES[f], CFG)
+            paths.append(str(p))
+        assert main([
+            "serve", "--fleet", *paths,
+            "--batch", "8", "--steps", "2", "--reps", "1",
+            "--obs_buffers", "2",
+        ]) == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        row = json.loads(out[0])
+        assert row["kind"] == "serve"
+        assert row["fleet"] == 2
+        assert row["member_parity"] == "bitwise"
+        assert row["actions_per_sec"] > 0
+        assert row["headline"] is False  # CPU row discipline
+        assert row["degradation"]["degraded_members"] == []
+        assert "fleet: 2 members" in out[-1]
+
+    def test_fleet_with_canary_band_rejected(self, tmp_path):
+        from rcmarl_tpu.cli import main
+
+        p = tmp_path / "m.npz"
+        save_checkpoint(p, STATES[0], CFG)
+        with pytest.raises(SystemExit, match="SOLO"):
+            main([
+                "serve", "--fleet", str(p), "--canary_band", "0.05",
+                "--watch_every", "1",
+            ])

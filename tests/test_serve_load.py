@@ -1,0 +1,229 @@
+"""Latency-harness contracts (rcmarl_tpu.serve.load).
+
+The pins that make a latency-vs-load row trustworthy:
+
+- arrival plans are DETERMINISTIC in their seed (replaying a sweep
+  replays the exact queueing), with the configured mean load;
+- the micro-batching queue's close rule is exact: a batch closes when
+  it FILLS (max_batch) or when the oldest request has waited max_wait,
+  never before the server frees — verified against hand-computed
+  latencies on crafted arrival plans;
+- saturation is accounted, not hidden: past the capacity
+  max_batch/service the utilization pins near 1, the queue depth grows,
+  and the knee extraction flags the crossing;
+- the whole report is replayable: same arrivals + same service model =
+  identical report.
+
+The queue units run on an injected constant service model (no jax at
+all); one tiny cell drives the REAL serve_block service model end to
+end at the padded shape.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from rcmarl_tpu.serve.load import (
+    KNEE_FACTOR,
+    bursty_arrivals,
+    poisson_arrivals,
+    run_load,
+    saturation_knee,
+    sweep_load,
+)
+
+
+class TestArrivalPlans:
+    def test_poisson_deterministic_in_seed(self):
+        a = poisson_arrivals(7, 500, 1000.0)
+        b = poisson_arrivals(7, 500, 1000.0)
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, poisson_arrivals(8, 500, 1000.0))
+
+    def test_poisson_mean_rate(self):
+        a = poisson_arrivals(0, 20000, 1000.0)
+        # mean inter-arrival gap ~ 1/rate (law of large numbers slack)
+        assert np.diff(a).mean() == pytest.approx(1e-3, rel=0.05)
+        assert np.all(np.diff(a) >= 0)  # sorted by construction
+
+    def test_bursty_same_long_run_load_in_spikes(self):
+        burst = 8
+        a = bursty_arrivals(0, 8000, 1000.0, burst=burst)
+        assert a.shape == (8000,)
+        # bursts are simultaneous: every run of `burst` shares one time
+        assert np.all(a[:burst] == a[0])
+        # long-run load matches the configured rate (~1000 req/s)
+        rate = len(a) / (a[-1] - a[0])
+        assert rate == pytest.approx(1000.0, rel=0.1)
+        np.testing.assert_array_equal(
+            a, bursty_arrivals(0, 8000, 1000.0, burst=burst)
+        )
+
+    def test_invalid_args_loud(self):
+        with pytest.raises(ValueError):
+            poisson_arrivals(0, 0, 1000.0)
+        with pytest.raises(ValueError):
+            poisson_arrivals(0, 10, 0.0)
+        with pytest.raises(ValueError):
+            bursty_arrivals(0, 10, 100.0, burst=0)
+
+
+class TestMicroBatchQueue:
+    def test_max_wait_flush_exact(self):
+        """A lone request closes at arrival + max_wait; its latency is
+        exactly max_wait + service."""
+        rep = run_load(
+            lambda fill: 0.002, np.array([1.0]), max_batch=64,
+            max_wait=0.005,
+        )
+        assert rep["launches"] == 1
+        assert rep["fill_mean"] == 1.0
+        assert rep["p50"] == pytest.approx(0.007)
+        assert rep["p99"] == pytest.approx(0.007)
+
+    def test_max_batch_closes_immediately(self):
+        """max_batch simultaneous arrivals close at the arrival instant:
+        latency is pure service, the max_wait budget untouched."""
+        arr = np.full(8, 2.0)
+        rep = run_load(lambda fill: 0.003, arr, max_batch=8, max_wait=1.0)
+        assert rep["launches"] == 1
+        assert rep["fill_mean"] == 8.0
+        assert rep["p99"] == pytest.approx(0.003)
+
+    def test_close_rule_hand_computed(self):
+        """Crafted plan, constant service 2ms, max_batch 2, max_wait
+        10ms: [0.0, 0.001] fill a batch at t=0.001 (latencies 3ms/2ms);
+        [0.1] rides its max_wait alone (12ms); [0.2, 0.2005] fill at
+        0.2005 (2.5ms/2ms)."""
+        arr = np.array([0.0, 0.001, 0.1, 0.2, 0.2005])
+        lat = {}
+
+        def service(fill):
+            return 0.002
+
+        rep = run_load(service, arr, max_batch=2, max_wait=0.010)
+        assert rep["launches"] == 3
+        # reconstruct the exact latencies: close times 0.001, 0.110,
+        # 0.2005; completions 0.003, 0.112, 0.2025
+        expect = np.array(
+            [0.003, 0.002, 0.012, 0.0025, 0.002]
+        )
+        assert rep["mean_latency"] == pytest.approx(expect.mean())
+        assert rep["p99"] == pytest.approx(
+            np.percentile(expect, 99.0)
+        )
+        del lat
+
+    def test_backlog_launches_without_extra_wait(self):
+        """With the server busy and >= max_batch waiting, the next batch
+        closes the instant the server frees (no max_wait added)."""
+        # 6 simultaneous arrivals, max_batch 2, service 1ms: three
+        # back-to-back launches at t=0, 0.001, 0.002
+        arr = np.zeros(6)
+        rep = run_load(lambda fill: 0.001, arr, max_batch=2, max_wait=0.5)
+        assert rep["launches"] == 3
+        assert rep["p99"] == pytest.approx(0.003)
+        assert rep["utilization"] == pytest.approx(1.0)
+
+    def test_saturation_accounting(self):
+        """Offered load past max_batch/service: utilization pins ~1,
+        queue depth grows, and latency is backlog-dominated (far above
+        the underloaded max_wait+service bound)."""
+        arr = poisson_arrivals(0, 4000, 10000.0)  # 10k req/s offered
+        # capacity = 16 / 0.004 = 4k req/s << offered
+        rep = run_load(lambda fill: 0.004, arr, max_batch=16, max_wait=0.002)
+        assert rep["utilization"] > 0.99
+        assert rep["fill_mean"] == pytest.approx(16.0, rel=0.05)
+        assert rep["queue_depth_max"] > 100
+        assert rep["p99"] > 10 * (0.002 + 0.004)
+
+    def test_report_replayable(self):
+        arr = poisson_arrivals(3, 1000, 5000.0)
+        a = run_load(lambda fill: 0.001, arr, 32, 0.004)
+        b = run_load(lambda fill: 0.001, arr, 32, 0.004)
+        assert a == b
+
+    def test_bad_service_model_loud(self):
+        with pytest.raises(ValueError):
+            run_load(lambda fill: 0.0, np.array([0.0]), 4, 0.01)
+        with pytest.raises(ValueError):
+            run_load(lambda fill: 0.001, np.array([0.0]), 0, 0.01)
+        with pytest.raises(ValueError):
+            run_load(lambda fill: 0.001, np.array([0.0]), 4, -1.0)
+
+
+class TestSweepAndKnee:
+    def test_sweep_points_tagged_and_knee_found(self):
+        """Constant service 1ms, max_batch 32 -> capacity 32k req/s:
+        loads below stay under the knee, loads far above saturate."""
+        pts = sweep_load(
+            lambda fill: 0.001, [1000.0, 8000.0, 200000.0],
+            n_requests=3000, max_batch=32, max_wait=0.005, seed=0,
+        )
+        assert [p["offered_load"] for p in pts] == [1e3, 8e3, 2e5]
+        assert all(p["arrival"] == "poisson" for p in pts)
+        knee = saturation_knee(pts)
+        assert knee == 8000.0  # 200k is past capacity: p99 explodes
+        sat = pts[-1]
+        assert sat["utilization"] > 0.99
+        assert sat["p99"] > KNEE_FACTOR * pts[0]["p99"]
+
+    def test_knee_none_when_sweep_starts_saturated(self):
+        pts = sweep_load(
+            lambda fill: 0.01, [100000.0], n_requests=2000,
+            max_batch=8, max_wait=0.001, seed=0,
+        )
+        assert saturation_knee(pts) is None
+
+    def test_bursty_sweep_waits_less_than_poisson_at_light_load(self):
+        """Bursts fill batches instantly, so at light load the bursty
+        arrival pattern SHORTENS p50 vs the same offered Poisson load
+        (the batching-friendly spike) — the two processes are genuinely
+        different inputs, not a relabel."""
+        kw = dict(
+            n_requests=2000, max_batch=16, max_wait=0.01, seed=0,
+        )
+        poisson = sweep_load(lambda f: 0.001, [500.0], **kw)[0]
+        bursty = sweep_load(
+            lambda f: 0.001, [500.0], arrival="bursty", burst=16, **kw
+        )[0]
+        assert bursty["p50"] < poisson["p50"]
+        assert bursty["fill_mean"] > poisson["fill_mean"]
+
+    def test_unknown_arrival_loud(self):
+        with pytest.raises(ValueError):
+            sweep_load(lambda f: 0.001, [1.0], 10, 4, 0.01, arrival="nope")
+
+
+class TestRealServiceModel:
+    def test_serve_service_fn_measures_real_launches(self):
+        """The real service model: a compiled serve_block launch at the
+        padded max_batch shape, positive finite seconds per call, and
+        the queue runs on it end to end."""
+        import jax
+
+        from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+        from rcmarl_tpu.serve.engine import stack_actor_rows
+        from rcmarl_tpu.serve.load import serve_service_fn
+        from rcmarl_tpu.training.trainer import init_train_state
+
+        cfg = Config(
+            n_agents=3,
+            agent_roles=(Roles.COOPERATIVE,) * 3,
+            in_nodes=circulant_in_nodes(3, 3),
+            nrow=3, ncol=3, n_episodes=4, n_ep_fixed=2, max_ep_len=4,
+            n_epochs=2, H=1,
+        )
+        block = stack_actor_rows(
+            init_train_state(cfg, jax.random.PRNGKey(0)).params, cfg
+        )
+        service = serve_service_fn(cfg, block, max_batch=8)
+        s = service(5)  # partial fill, same padded shape
+        assert s > 0.0 and np.isfinite(s)
+        rep = run_load(
+            service, poisson_arrivals(0, 40, 2000.0), max_batch=8,
+            max_wait=0.002,
+        )
+        assert rep["requests"] == 40
+        assert np.isfinite(rep["p99"]) and rep["p99"] > 0
