@@ -530,6 +530,7 @@ def _measure_serve_load(
     requests: int,
     mode: str = "sample",
     arrival: str = "poisson",
+    shed_after_ms: float = None,
 ) -> None:
     """Child: the latency-under-load measurement — a deterministic
     arrival sweep through the micro-batching queue in front of the
@@ -568,9 +569,14 @@ def _measure_serve_load(
     )
     service = serve_service_fn(cfg, block, max_batch, mode=mode, seed=0)
     max_wait = max_wait_ms / 1000.0
+    import math as _math
+
+    shed_after = (
+        _math.inf if shed_after_ms is None else shed_after_ms / 1000.0
+    )
     points = sweep_load(
         service, loads, requests, max_batch, max_wait, seed=0,
-        arrival=arrival,
+        arrival=arrival, shed_after=shed_after,
     )
     for p in points:
         # humane units for the committed rows: latency in ms
@@ -579,6 +585,8 @@ def _measure_serve_load(
         p["utilization"] = round(p["utilization"], 4)
         p["fill_mean"] = round(p["fill_mean"], 1)
         p["queue_depth_mean"] = round(p["queue_depth_mean"], 1)
+        # the deadline-shedding ledger rides EVERY row (0.0 = shed-free)
+        p["shed_fraction"] = round(p["shed_fraction"], 4)
     knee = saturation_knee(
         [
             dict(p, p99=p["p99_ms"], utilization=p["utilization"])
@@ -597,6 +605,7 @@ def _measure_serve_load(
                 "workload": {
                     "max_batch": max_batch,
                     "max_wait_ms": max_wait_ms,
+                    "shed_after_ms": shed_after_ms,
                     "loads": list(loads),
                     "requests": requests,
                     "mode": mode,
@@ -632,6 +641,16 @@ def main_serve_load() -> int:
                  "--max_wait_ms", "5",
                  "--loads", "1e5,1e6,5e6,2e7,8e7",
                  "--requests", "100000", "--arrival", "bursty"],
+            ),
+            (
+                # the deadline-shedding arm: same sweep with a 10ms shed
+                # deadline, so the past-the-knee points report a bounded
+                # p99 + an explicit shed fraction instead of backlog
+                "tpu_serve_load_shed",
+                ["--serve_load_child", "--max_batch", "4096",
+                 "--max_wait_ms", "5", "--shed_after_ms", "10",
+                 "--loads", "1e5,1e6,5e6,2e7,8e7",
+                 "--requests", "100000"],
             ),
         ],
         # the CPU fallback sweep MUST cross this host's capacity (~2e5
@@ -784,6 +803,11 @@ if __name__ == "__main__":
                 _arm_arg(args, "--arrival", ("poisson", "bursty"))
                 if "--arrival" in args
                 else "poisson"
+            ),
+            shed_after_ms=(
+                float(args[args.index("--shed_after_ms") + 1])
+                if "--shed_after_ms" in args
+                else None
             ),
         )
     elif "--serve_load" in sys.argv:

@@ -701,6 +701,142 @@ def canary_section(artifact_path) -> list:
     return lines
 
 
+def gossip_readmission_section(artifact_path) -> list:
+    """QUALITY.md lines for the gossip readmission experiment, rendered
+    from the committed ``scripts/gossip_readmission.py`` artifact
+    (``simulation_results/gossip_readmission.json``) — same byte-stable
+    render-from-evidence contract as the gossip/canary sections. Empty
+    when the artifact does not exist."""
+    p = Path(artifact_path)
+    if not p.exists():
+        return []
+    d = json.loads(p.read_text())
+    cfg = d["config"]
+    lines = [
+        "",
+        "## Gossip readmission under flapping senders",
+        "",
+        "The PR-7 guard excludes a rolled-back replica for exactly ONE "
+        "mix — right for transient poisonings, but a FLAPPING sender "
+        "(probabilistically poisoned segment by segment) re-enters the "
+        "mix every time its luck turns. `train_gossip(readmit_after=K)` "
+        "(`--gossip_readmit_after`) makes the quarantine sticky: an "
+        "excluded replica must prove K consecutive healthy probe rounds "
+        "before its payloads re-enter; it keeps training and keeps "
+        "RECEIVING mixes meanwhile, so readmission is recovery, not "
+        "resurrection. The committed experiment "
+        f"(`{p.name}`, `scripts/gossip_readmission.py`: "
+        f"R={cfg['replicas']} full graph, gossip_H={cfg['gossip_H']}, "
+        f"agent-level nan_p={cfg['nan_p']} without sanitize — the "
+        f"flapping injection — {cfg['n_episodes']} episodes, measured "
+        f"on {d['platform']}):",
+        "",
+        "| arm | rollbacks | excluded replica-rounds | readmitted | "
+        "all replicas finite | final return | verdict |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for a in d["arms"]:
+        if a["label"] == "clean":
+            verdict = "— (clean band source)"
+        elif a["within_band"]:
+            verdict = "within the clean band"
+        else:
+            verdict = "**OUTSIDE the clean band**"
+        lines.append(
+            f"| {a['label']} | {a['rollbacks']} | "
+            f"{a['excluded_replica_rounds']} | {a['readmitted']} | "
+            f"{sum(a['replica_healthy'])}/{len(a['replica_healthy'])} | "
+            f"{a['final_return']} | {verdict} |"
+        )
+    n_readmit = max(a["readmitted"] for a in d["arms"])
+    lines += [
+        "",
+        "Reading: the excluded-replica-rounds column is the containment "
+        "price — the sticky arm pays MORE excluded rounds than the "
+        "legacy arm on the same fault draws (a quarantined replica "
+        "serves its probation instead of bouncing straight back), and "
+        "the readmitted column proves re-entry actually happens "
+        f"({n_readmit} readmissions in the sticky arm). The envelope "
+        "holds under the flapping: every replica ends finite in every "
+        "arm and both faulted arms' returns sit inside the clean band "
+        f"(tolerance {cfg['tol']:.0%}) — quarantine costs mixing "
+        "freshness, not convergence. `readmit_after=0` (the default) "
+        "is pinned bit-for-bit to the PR-7 one-round behavior "
+        "(tests/test_gossip.py); the scripted-flap twins pin the "
+        "streak-reset semantics, and the chaos campaign's "
+        "`gossip_flapping` cell gates the live behavior in "
+        "RESILIENCE.jsonl.",
+    ]
+    return lines
+
+
+def chaos_campaign_section(ledger_path) -> list:
+    """QUALITY.md lines summarizing the committed RESILIENCE.jsonl
+    chaos ledger (``python -m rcmarl_tpu chaos --run``) — rendered from
+    the ledger itself so the section can never disagree with the gated
+    artifact. Empty when the ledger does not exist."""
+    p = Path(ledger_path)
+    if not p.exists():
+        return []
+    rows = [
+        json.loads(line)
+        for line in p.read_text().splitlines()
+        if line.strip()
+    ]
+    if not rows:
+        return []
+    by_subsystem: Dict[str, list] = {}
+    for r in rows:
+        by_subsystem.setdefault(r["subsystem"], []).append(r)
+    lines = [
+        "",
+        "## Chaos campaign (RESILIENCE.jsonl)",
+        "",
+        "The fault surface as ONE swept, CI-gated artifact "
+        "(`rcmarl_tpu.chaos`): every injectable fault in the repo is a "
+        "registered point, each (point, intensity) cell runs as a short "
+        "REAL run through the actual subsystem entry points, and the "
+        f"committed ledger (`{p.name}`, {len(rows)} cells across "
+        f"{len(by_subsystem)} subsystems) is gated every CI run by "
+        "`chaos --check` — a cell that previously survived and now "
+        "fails, or whose degradation envelope widens past tolerance, "
+        "is a finding. Cells EXPECTED to fail (the undefended "
+        "comparison arms: plain-mean gossip, H=0 under collusion) are "
+        "part of the documented surface — a regression that silently "
+        "fixed them would be as suspicious as one that broke a "
+        "defended cell.",
+        "",
+        "| subsystem | cells | survived | degraded | failed (documented "
+        "undefended arms) | unexpected outcomes |",
+        "|---|---|---|---|---|---|",
+    ]
+    for sub in sorted(by_subsystem):
+        rs = by_subsystem[sub]
+        counts = {o: sum(1 for r in rs if r["outcome"] == o)
+                  for o in ("survived", "degraded", "failed")}
+        unexpected = sum(1 for r in rs if r["outcome"] != r["expected"])
+        lines.append(
+            f"| {sub} | {len(rs)} | {counts['survived']} | "
+            f"{counts['degraded']} | {counts['failed']} | {unexpected} |"
+        )
+    lines += [
+        "",
+        "Reading: `survived` = the guards contained the fault "
+        "completely (finite, in-band, bitwise-correct serving); "
+        "`degraded` = contained but measurably reduced (skipped "
+        "blocks, a quarantined replica, latency past the bound on the "
+        "shed-free overload arm); `failed` = containment broke — every "
+        "committed `failed` row is an EXPECTED undefended arm, and the "
+        "unexpected-outcomes column is 0 by construction on a clean "
+        "ledger. Per-cell intensities, guard counters, and the "
+        "final-vs-clean return deltas live in the ledger rows; "
+        "`python -m rcmarl_tpu chaos --list` prints the registry with "
+        "each point's guard and test pin, and README's unified "
+        "fault-surface table cross-references every row.",
+    ]
+    return lines
+
+
 def adaptive_adversary_section(artifact_path) -> list:
     """QUALITY.md lines for the adaptive colluding-adversary
     experiment, rendered from the committed
@@ -966,6 +1102,10 @@ def write_quality_md(
         Path(out_path).parent / "simulation_results/gossip_byzantine.json"
     )
     lines += gossip_evidence_section(gossip_artifact)
+    readmission_artifact = (
+        Path(out_path).parent / "simulation_results/gossip_readmission.json"
+    )
+    lines += gossip_readmission_section(readmission_artifact)
     bf16_artifact = (
         Path(out_path).parent / "simulation_results/bf16_parity.json"
     )
@@ -986,6 +1126,8 @@ def write_quality_md(
         Path(out_path).parent / "simulation_results/canary_gate.json"
     )
     lines += canary_section(canary_artifact)
+    resilience_ledger = Path(out_path).parent / "RESILIENCE.jsonl"
+    lines += chaos_campaign_section(resilience_ledger)
     lines += [
         "",
         "## Related artifacts",
@@ -1033,6 +1175,17 @@ def write_quality_md(
             "- `simulation_results/canary_gate.json` — the deployment-"
             "loop experiment behind the canary-gate section "
             "(`scripts/canary_experiment.py`)"
+        )
+    if readmission_artifact.exists():
+        lines.append(
+            "- `simulation_results/gossip_readmission.json` — the "
+            "flapping-sender readmission experiment behind the gossip-"
+            "readmission section (`scripts/gossip_readmission.py`)"
+        )
+    if resilience_ledger.exists():
+        lines.append(
+            "- `RESILIENCE.jsonl` — the CI-gated chaos-campaign ledger "
+            "behind the chaos section (`python -m rcmarl_tpu chaos`)"
         )
     # like cmd_parity's related-artifacts list: only link the robustness
     # companion when it exists, and never from itself

@@ -1,0 +1,34 @@
+"""Chaos campaign — the fault surface as one named, swept, CI-gated object.
+
+The repo's fault machinery grew piecewise: link faults (PR 2), Byzantine
+replicas (PR 7), adaptive collusion (PR 12), checkpoint/serve rejects
+(PR 10/14), pipeline skip semantics (PR 11) — each proven in its own
+test or one-off script. This package is the single artifact that says
+"this is the fault surface, and here is how the system degrades at each
+point":
+
+- :mod:`rcmarl_tpu.chaos.registry` — every injectable fault as a named
+  :class:`ChaosPoint` (subsystem, injector, intensity knob, expected
+  degradation, guard + test-pin pointers).
+- :mod:`rcmarl_tpu.chaos.campaign` — the runner that sweeps points ×
+  intensities as short REAL runs (per-cell fault isolation, the sweep
+  discipline), classifies each cell survived/degraded/failed, and
+  gates the committed ``RESILIENCE.jsonl`` ledger every CI run
+  (``python -m rcmarl_tpu chaos --check``).
+"""
+
+from rcmarl_tpu.chaos.registry import (  # noqa: F401
+    CHAOS_POINTS,
+    OUTCOMES,
+    CellFailed,
+    ChaosPoint,
+    ChaosSkip,
+    registry_cells,
+)
+from rcmarl_tpu.chaos.campaign import (  # noqa: F401
+    compare_rows,
+    read_resilience,
+    run_campaign,
+    run_cell,
+    write_resilience,
+)

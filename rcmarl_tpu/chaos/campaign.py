@@ -1,0 +1,295 @@
+"""The chaos campaign runner + the CI-gated RESILIENCE.jsonl ledger.
+
+One cell = one (:class:`~rcmarl_tpu.chaos.registry.ChaosPoint`,
+intensity) pair run as a short REAL run with the sweep's per-cell fault
+isolation (PR 2): a crashing cell is recorded ``failed`` with its error
+and the sweep continues. Rows are canonical (sorted cells, sorted keys,
+no timestamps), so regenerating on unchanged code is byte-stable —
+exactly the AUDIT.jsonl discipline applied to resilience.
+
+The gate (``python -m rcmarl_tpu chaos --check``) re-runs the cells and
+compares against the committed ledger:
+
+- ``chaos-regression`` — a cell's outcome moved DOWN the ladder
+  (survived -> degraded/failed, degraded -> failed). The system lost
+  containment it used to have.
+- ``chaos-envelope``  — a cell's degradation envelope WIDENED: the
+  |final - clean| return gap grew past ``ENVELOPE_TOL`` beyond the
+  committed gap. Still contained, but measurably worse.
+- ``chaos-unbaselined`` — a registry cell has no committed row (or the
+  row's knobs/expectation drifted): regenerate the ledger in the same
+  PR (``chaos --run``).
+- ``chaos-stale`` — a committed row no longer names a registry cell.
+
+Cost-arm discipline: a cell the host cannot run (``ChaosSkip``) is a
+NOTE, never a stale/regression finding, and ``--run`` keeps skipped
+cells' committed rows. An outcome moving UP the ladder is a note too —
+an unclaimed win to regenerate, not a failure.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from rcmarl_tpu.chaos.registry import (
+    OUTCOMES,
+    CellFailed,
+    ChaosSkip,
+    point_by_name,
+    registry_cells,
+)
+
+#: Absolute widening (return units) the envelope gate tolerates on top
+#: of the committed |final - clean| gap — tiny-cell returns are exactly
+#: reproducible on one host, but the gate must survive a platform move.
+ENVELOPE_TOL = 0.25
+
+_RANK = {o: i for i, o in enumerate(OUTCOMES)}
+
+
+def _cell_key(row: dict) -> Tuple[str, str]:
+    return (row["point"], row["intensity"])
+
+
+def _round(x: Optional[float]) -> Optional[float]:
+    if x is None or not math.isfinite(x):
+        return None
+    return round(float(x), 4)
+
+
+def run_cell(point_name: str, intensity: str, runner=None) -> dict:
+    """Run ONE campaign cell (fault-isolated) and return its canonical
+    row. ``runner`` overrides the registry runner — the planted-
+    regression tests inject a sabotaged variant through it."""
+    point = point_by_name(point_name)
+    if point is None:
+        raise ValueError(f"unknown chaos point {point_name!r}")
+    expected = dict(point.cells).get(intensity)
+    if expected is None:
+        raise ValueError(
+            f"chaos point {point_name!r} has no intensity {intensity!r} "
+            f"(cells: {[c for c, _ in point.cells]})"
+        )
+    run = runner if runner is not None else point.runner
+    try:
+        res = run(intensity)
+    except ChaosSkip as e:
+        res = {
+            "outcome": "skipped",
+            "counters": {},
+            "final_return": None,
+            "clean_return": None,
+            "detail": str(e),
+        }
+    except CellFailed as e:
+        res = {
+            "outcome": "failed",
+            "counters": {},
+            "final_return": None,
+            "clean_return": None,
+            "detail": f"containment contract violated: {e}",
+        }
+    except Exception as e:  # noqa: BLE001 — per-cell fault isolation
+        res = {
+            "outcome": "failed",
+            "counters": {},
+            "final_return": None,
+            "clean_return": None,
+            "detail": f"{type(e).__name__}: {e}"[:300],
+        }
+    final = _round(res.get("final_return"))
+    clean = _round(res.get("clean_return"))
+    delta = (
+        _round(final - clean)
+        if final is not None and clean is not None
+        else None
+    )
+    return {
+        "kind": "chaos",
+        "point": point.name,
+        "subsystem": point.subsystem,
+        "intensity": intensity,
+        "expected": expected,
+        "outcome": res["outcome"],
+        "counters": {k: res["counters"][k] for k in sorted(res["counters"])},
+        "final_return": final,
+        "clean_return": clean,
+        "return_delta": delta,
+        "detail": res.get("detail", ""),
+    }
+
+
+def _select_cells(cells: Optional[Sequence[str]]) -> List[Tuple[str, str]]:
+    """Resolve ``--cells`` tokens (``point`` or ``point@intensity``)
+    against the registry; None = every cell."""
+    all_cells = list(registry_cells())
+    if not cells:
+        return all_cells
+    chosen: List[Tuple[str, str]] = []
+    for token in cells:
+        name, _, intensity = token.partition("@")
+        matches = [
+            c
+            for c in all_cells
+            if c[0] == name and (not intensity or c[1] == intensity)
+        ]
+        if not matches:
+            raise ValueError(
+                f"--cells {token!r} matches no registry cell; see "
+                "`chaos --list`"
+            )
+        chosen += [c for c in matches if c not in chosen]
+    return chosen
+
+
+def run_campaign(
+    cells: Optional[Sequence[str]] = None, verbose: bool = True
+) -> Tuple[List[dict], List[str]]:
+    """Run the selected cells (default: ALL); returns (rows, notes).
+    Skipped cells become notes, not rows — the ledger only holds cells
+    this run actually measured."""
+    rows, notes = [], []
+    for name, intensity in _select_cells(cells):
+        row = run_cell(name, intensity)
+        if row["outcome"] == "skipped":
+            notes.append(
+                f"{name}@{intensity} skipped on this host: {row['detail']}"
+            )
+            continue
+        if verbose:
+            print(
+                f"# chaos {name}@{intensity}: {row['outcome']}"
+                + (
+                    f" (expected {row['expected']})"
+                    if row["outcome"] != row["expected"]
+                    else ""
+                )
+            )
+        rows.append(row)
+    rows.sort(key=lambda r: (r["subsystem"], r["point"], r["intensity"]))
+    return rows, notes
+
+
+# --------------------------------------------------------------------------
+# ledger IO (the AUDIT.jsonl discipline: canonical, byte-stable)
+# --------------------------------------------------------------------------
+
+
+def read_resilience(path) -> List[dict]:
+    p = Path(path)
+    if not p.exists():
+        return []
+    rows = []
+    for line in p.read_text().splitlines():
+        line = line.strip()
+        if line:
+            rows.append(json.loads(line))
+    return rows
+
+
+def write_resilience(path, rows: Iterable[dict]) -> None:
+    rows = sorted(
+        rows, key=lambda r: (r["subsystem"], r["point"], r["intensity"])
+    )
+    text = "".join(json.dumps(r, sort_keys=True) + "\n" for r in rows)
+    Path(path).write_text(text)
+
+
+# --------------------------------------------------------------------------
+# the gate
+# --------------------------------------------------------------------------
+
+
+def compare_rows(
+    baseline: List[dict],
+    fresh: List[dict],
+    checked: Optional[Sequence[Tuple[str, str]]] = None,
+) -> Tuple[List[str], List[str]]:
+    """Findings + notes of a fresh (sub)campaign vs the committed
+    ledger. ``checked`` is the cell set this run actually executed
+    (``--cells`` subsets only judge what they ran); stale-row detection
+    only applies on FULL checks (checked=None)."""
+    findings, notes = [], []
+    base = {_cell_key(r): r for r in baseline}
+    new = {_cell_key(r): r for r in fresh}
+    cells = list(new) if checked is None else list(checked)
+    for key in cells:
+        name = f"{key[0]}@{key[1]}"
+        f = new.get(key)
+        if f is None:
+            continue  # skipped on this host — noted by the runner
+        b = base.get(key)
+        if b is None:
+            findings.append(
+                f"chaos-unbaselined: {name} has no committed "
+                "RESILIENCE.jsonl row — regenerate with `chaos --run` "
+                "and commit it in the same PR"
+            )
+            continue
+        if b.get("expected") != f.get("expected"):
+            findings.append(
+                f"chaos-unbaselined: {name} expectation drifted "
+                f"({b.get('expected')!r} -> {f.get('expected')!r}) — "
+                "the registry changed; regenerate the ledger"
+            )
+            continue
+        rb, rf = _RANK[b["outcome"]], _RANK[f["outcome"]]
+        if rf > rb:
+            findings.append(
+                f"chaos-regression: {name} was {b['outcome']!r}, now "
+                f"{f['outcome']!r} — {f['detail']}"
+            )
+            continue
+        if rf < rb:
+            notes.append(
+                f"{name} improved {b['outcome']!r} -> {f['outcome']!r} "
+                "(unclaimed win — regenerate the ledger to bank it)"
+            )
+        db, df_ = b.get("return_delta"), f.get("return_delta")
+        if db is not None and df_ is not None:
+            if abs(df_) > abs(db) + ENVELOPE_TOL:
+                findings.append(
+                    f"chaos-envelope: {name} degradation envelope "
+                    f"widened |{df_}| > |{db}| + {ENVELOPE_TOL} "
+                    "(final-vs-clean return gap)"
+                )
+        elif (db is None) != (df_ is None):
+            notes.append(
+                f"{name} return-delta availability changed "
+                f"({db} -> {df_}); counters: {f.get('counters')}"
+            )
+    if checked is None:
+        known = set(registry_cells())
+        for key, b in base.items():
+            if key not in known:
+                findings.append(
+                    f"chaos-stale: committed row {key[0]}@{key[1]} names "
+                    "no registry cell — regenerate the ledger"
+                )
+    return findings, notes
+
+
+def check_campaign(
+    baseline_path, cells: Optional[Sequence[str]] = None
+) -> Tuple[List[str], List[str], List[dict]]:
+    """The full ``chaos --check``: run the (sub)campaign, compare, and
+    return (findings, notes, fresh rows)."""
+    baseline = read_resilience(baseline_path)
+    if not baseline:
+        return (
+            [
+                f"chaos-unbaselined: no committed ledger at "
+                f"{baseline_path} — generate one with `chaos --run`"
+            ],
+            [],
+            [],
+        )
+    checked = _select_cells(cells)
+    fresh, notes = run_campaign(cells)
+    findings, cmp_notes = compare_rows(
+        baseline, fresh, checked=None if cells is None else checked
+    )
+    return findings, notes + cmp_notes, fresh

@@ -1,0 +1,974 @@
+"""The fault-surface registry: every injectable fault, named and runnable.
+
+A :class:`ChaosPoint` is one place the system can be hurt — a transport
+link, a gossip replica, a checkpoint byte range, a pipeline transit, a
+serving queue — with the knob that hurts it, the guard that is supposed
+to absorb it, and the test that pins the mechanism. Each point carries
+one or more CELLS (intensity label + expected outcome); the campaign
+runner (:mod:`rcmarl_tpu.chaos.campaign`) executes every cell as a
+short REAL run through the actual subsystem entry points (``train``,
+``train_gossip``, ``train_pipelined``, the serve engine + watcher, the
+load queue) — never a mock — and classifies the result on the shared
+outcome ladder:
+
+- ``survived`` — the guards contained the fault completely: the run/
+  serving stayed finite AND functionally intact (final return inside
+  the clean twin's band, serving bitwise the expected policy, latency
+  inside the bound). Guard counters firing is NOT degradation — cleanly
+  absorbing a fault is exactly what surviving means.
+- ``degraded`` — contained but measurably reduced: skipped training
+  blocks, a quarantined replica, a return outside the clean band, a
+  latency past the bound. Finite everywhere, bounded everywhere.
+- ``failed`` — containment broke: non-finite params/serving output, a
+  crash, or an assertion on the guard's contract itself. Some cells
+  EXPECT ``failed`` — the undefended comparison arms (plain mean,
+  H=0 under collusion) are part of the documented fault surface, and a
+  regression that silently FIXES them would be as suspicious as one
+  that breaks a defended cell.
+
+Every cell is deterministic (fixed seeds, simulated clocks, injected
+service models where wall time would leak in), so the committed
+``RESILIENCE.jsonl`` rows are reproducible and the ``--check`` gate
+compares like with like.
+
+Band discipline: the tiny cells are O(10)-episode runs, so the
+"functionally intact" band is deliberately generous
+(``RETURN_BAND = 0.5`` relative to the clean twin) — the committed
+ledger's gate is on TRANSITIONS (a survived cell failing, an envelope
+widening), not on the absolute label of a noisy tiny return.
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+import tempfile
+import zipfile
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Dict, Optional, Tuple
+
+#: The outcome ladder, worst last (the --check gate fires on any cell
+#: moving RIGHT of its committed outcome).
+OUTCOMES = ("survived", "degraded", "failed")
+
+#: Relative band vs the clean twin's final return inside which a
+#: faulted cell still counts as functionally intact (see module
+#: docstring — generous by design at this cell size).
+RETURN_BAND = 0.5
+
+#: Final-return window: mean over the last K episodes of the tiny run.
+RETURN_WINDOW = 4
+
+#: The overload cells' latency bound: p99 must stay within this factor
+#: of the knee-point p99 (the acceptance criterion of the deadline-
+#: shedding feature, encoded as a gated cell).
+LATENCY_BOUND_FACTOR = 2.0
+
+
+class CellFailed(RuntimeError):
+    """A containment contract the cell asserts was violated — the
+    campaign records the cell as ``failed`` with this detail (cell
+    isolation: one broken guard never aborts the sweep)."""
+
+
+class ChaosSkip(RuntimeError):
+    """The cell cannot run on THIS host (e.g. a hardware-only arm).
+    Recorded as a note, never a stale-row finding — the cost-arm
+    discipline (skipped-on-this-host is a note, not stale)."""
+
+
+@dataclass(frozen=True)
+class ChaosPoint:
+    """One named point on the fault surface (see module docstring).
+
+    ``cells`` maps intensity label -> expected outcome; ``runner`` is
+    called with the intensity label and returns the result dict
+    (``outcome``/``counters``/``final_return``/``clean_return``/
+    ``detail``). ``guard``/``test_pin`` are the documentation pointers
+    the unified README fault-surface table renders.
+    """
+
+    name: str
+    subsystem: str
+    description: str
+    injector: str
+    guard: str
+    test_pin: str
+    cells: Tuple[Tuple[str, str], ...]
+    runner: Callable
+
+
+# --------------------------------------------------------------------------
+# shared tiny workloads + the clean-twin cache
+# --------------------------------------------------------------------------
+
+_CLEAN_CACHE: Dict[object, float] = {}
+
+
+def _final_return(df) -> float:
+    import numpy as np
+
+    vals = np.asarray(df["True_team_returns"].values, dtype=float)
+    return float(np.mean(vals[-RETURN_WINDOW:]))
+
+
+def _within_band(final: float, clean: float) -> bool:
+    return abs(final - clean) <= RETURN_BAND * max(1.0, abs(clean))
+
+
+def _params_ok(state) -> bool:
+    from rcmarl_tpu.faults import params_finite
+
+    return params_finite(state.params)
+
+
+def _clean_train_return(cfg, n_eps: int) -> float:
+    """Memoized clean-twin final return for a faulted train cell: the
+    SAME tiny config with the fault machinery stripped."""
+    from rcmarl_tpu.training.trainer import train
+
+    clean = cfg.replace(fault_plan=None, consensus_sanitize=False)
+    key = ("train", clean, n_eps)
+    if key not in _CLEAN_CACHE:
+        _, df = train(clean, n_episodes=n_eps)
+        _CLEAN_CACHE[key] = _final_return(df)
+    return _CLEAN_CACHE[key]
+
+
+def _tiny(**overrides):
+    from rcmarl_tpu.lint.configs import tiny_cfg
+
+    return tiny_cfg(**overrides)
+
+
+# --------------------------------------------------------------------------
+# transport: per-link fault plans through the real solo trainer
+# --------------------------------------------------------------------------
+
+#: (point suffix, FaultPlan field) of the probabilistic link faults.
+_LINK_FAULTS = {
+    "link_drop": "drop_p",
+    "link_nan": "nan_p",
+    "link_stale": "stale_p",
+    "link_flip": "flip_p",
+    "link_corrupt": "corrupt_p",
+}
+
+_TRAIN_EPS = 8  # 4 tiny blocks: enough for guards to engage and recover
+
+
+def _train_cell(cfg) -> dict:
+    """One guarded tiny train under ``cfg``'s fault plan, classified
+    against the clean twin (transport/consensus shared core)."""
+    import numpy as np
+
+    from rcmarl_tpu.training.trainer import train
+
+    state, df = train(cfg, n_episodes=_TRAIN_EPS)
+    clean = _clean_train_return(cfg, _TRAIN_EPS)
+    guard = dict(df.attrs.get("guard", {}))
+    returns = np.asarray(df["True_team_returns"].values, dtype=float)
+    final = _final_return(df)
+    if not _params_ok(state) or not np.isfinite(returns[-RETURN_WINDOW:]).all():
+        outcome = "failed"
+    elif (
+        guard.get("skipped", 0) > 0
+        or not np.isfinite(returns).all()
+        or not _within_band(final, clean)
+    ):
+        # lost blocks / poisoned metric rows / outside the band:
+        # contained, but function was measurably reduced
+        outcome = "degraded"
+    else:
+        outcome = "survived"
+    return {
+        "outcome": outcome,
+        "counters": guard,
+        "final_return": None if not math.isfinite(final) else final,
+        "clean_return": clean,
+        "detail": f"{_TRAIN_EPS} episodes, guarded tiny train",
+    }
+
+
+def _run_link(fault: str, sanitize: bool, intensity: str) -> dict:
+    from rcmarl_tpu.faults import FaultPlan
+
+    p = float(intensity)
+    plan = FaultPlan(**{_LINK_FAULTS[fault]: p})
+    return _train_cell(
+        _tiny(
+            n_episodes=_TRAIN_EPS,
+            fault_plan=plan,
+            consensus_sanitize=sanitize,
+        )
+    )
+
+
+def _link_runner(fault: str, sanitize: bool = True):
+    return lambda intensity: _run_link(fault, sanitize, intensity)
+
+
+# --------------------------------------------------------------------------
+# consensus: the adaptive colluding adversary
+# --------------------------------------------------------------------------
+
+
+def _run_adaptive(intensity: str) -> dict:
+    """``h{H}``: 1 Adaptive colluder at scale 10 in the tiny 3-ring;
+    the trimmed H=1 arm must hold the band, the undefended H=0 arm is
+    the documented failure surface (its clip bounds are the attack's)."""
+    import numpy as np
+
+    from rcmarl_tpu.config import Roles
+    from rcmarl_tpu.training.trainer import train
+
+    H = int(intensity.removeprefix("h"))
+    cfg = _tiny(
+        n_episodes=_TRAIN_EPS,
+        agent_roles=(Roles.COOPERATIVE, Roles.COOPERATIVE, Roles.ADAPTIVE),
+        H=H,
+        adaptive_scale=10.0,
+    )
+    clean_key = ("adaptive_clean", H)
+    if clean_key not in _CLEAN_CACHE:
+        _, df = train(
+            cfg.replace(agent_roles=(Roles.COOPERATIVE,) * 3),
+            n_episodes=_TRAIN_EPS,
+        )
+        _CLEAN_CACHE[clean_key] = _final_return(df)
+    clean = _CLEAN_CACHE[clean_key]
+    state, df = train(cfg, n_episodes=_TRAIN_EPS, guard=False)
+    returns = np.asarray(df["True_team_returns"].values, dtype=float)
+    # the behavioral threat model scores the COOPERATIVE team: the
+    # colluder's own row is adversary bookkeeping
+    final = _final_return(df)
+    if not _params_ok(state) or not np.isfinite(returns).all():
+        outcome = "failed"
+        final = None
+    elif not _within_band(final, clean):
+        outcome = "degraded"
+    else:
+        outcome = "survived"
+    return {
+        "outcome": outcome,
+        "counters": {},
+        "final_return": final,
+        "clean_return": clean,
+        "detail": f"1 Adaptive colluder, scale 10, H={H}, guard off",
+    }
+
+
+# --------------------------------------------------------------------------
+# gossip: Byzantine replicas, replica-link bombs, flapping + readmission
+# --------------------------------------------------------------------------
+
+
+def _gossip_cfg(**overrides):
+    base = dict(
+        replicas=4,
+        gossip_every=1,
+        gossip_graph="full",
+        gossip_H=1,
+        n_episodes=8,
+    )
+    base.update(overrides)
+    return _tiny(**base)
+
+
+def _gossip_cell(cfg, readmit_after: int = 0, expect_all_healthy=True) -> dict:
+    import numpy as np
+
+    from rcmarl_tpu.parallel.gossip import train_gossip
+
+    states, df = train_gossip(cfg, readmit_after=readmit_after)
+    g = df.attrs["gossip"]
+    byz = set(g["byzantine"])
+    healthy = [
+        ok for r, ok in enumerate(g["replica_healthy"]) if r not in byz
+    ]
+    returns = np.asarray(df["True_team_returns"].values, dtype=float)
+    final = _final_return(df)
+    counters = {
+        k: g[k]
+        for k in ("rounds", "rollbacks", "excluded", "readmitted",
+                  "nonfinite", "deficit")
+    }
+    # the clean twin is the SAME cell config with the fault machinery
+    # stripped — mix arm and episode count included (a mean-mix or
+    # longer-run cell must not measure its envelope against a trimmed
+    # 8-episode twin's learning curve); Config is hashable, so the
+    # stripped config IS the cache key
+    clean_cfg = cfg.replace(
+        fault_plan=None, replica_fault_plan=None, consensus_sanitize=False
+    )
+    clean_key = ("gossip_clean", clean_cfg)
+    if clean_key not in _CLEAN_CACHE:
+        from rcmarl_tpu.parallel.gossip import train_gossip as tg
+
+        _, cdf = tg(clean_cfg, guard=False)
+        _CLEAN_CACHE[clean_key] = _final_return(cdf)
+    clean = _CLEAN_CACHE[clean_key]
+    if not all(healthy) or not np.isfinite(returns[-RETURN_WINDOW:]).all():
+        outcome = "failed"
+        final = final if math.isfinite(final) else None
+    elif g["rollbacks"] > 0 or any(g["quarantined"]) or not _within_band(
+        final, clean
+    ):
+        outcome = "degraded"
+    else:
+        outcome = "survived"
+    return {
+        "outcome": outcome,
+        "counters": counters,
+        "final_return": final,
+        "clean_return": clean,
+        "detail": (
+            f"R={cfg.replicas} {cfg.gossip_graph} graph, "
+            f"gossip_H={cfg.gossip_H}, mix={cfg.gossip_mix}, "
+            f"readmit_after={readmit_after}"
+        ),
+    }
+
+
+def _run_byzantine(intensity: str) -> dict:
+    from rcmarl_tpu.faults import ReplicaFaultPlan
+
+    return _gossip_cell(
+        _gossip_cfg(
+            replica_fault_plan=ReplicaFaultPlan(
+                byzantine_replicas=(3,), byzantine_mode=intensity
+            )
+        )
+    )
+
+
+def _run_byzantine_mean(intensity: str) -> dict:
+    from rcmarl_tpu.faults import ReplicaFaultPlan
+
+    return _gossip_cell(
+        _gossip_cfg(
+            gossip_mix="mean",
+            replica_fault_plan=ReplicaFaultPlan(
+                byzantine_replicas=(3,), byzantine_mode=intensity
+            ),
+        )
+    )
+
+
+def _run_replica_link(intensity: str) -> dict:
+    from rcmarl_tpu.faults import ReplicaFaultPlan
+
+    return _gossip_cell(
+        _gossip_cfg(
+            replica_fault_plan=ReplicaFaultPlan(nan_p=float(intensity))
+        )
+    )
+
+
+def _run_flapping(intensity: str) -> dict:
+    """``readmitK``: agent-level probabilistic NaN bombs WITHOUT
+    sanitize flap individual replicas unhealthy segment by segment; the
+    sticky quarantine must exclude them, readmit them after K clean
+    probe rounds, and keep every replica finite end to end."""
+    from rcmarl_tpu.faults import FaultPlan
+
+    K = int(intensity.removeprefix("readmit"))
+    res = _gossip_cell(
+        _gossip_cfg(
+            n_episodes=12,
+            fault_plan=FaultPlan(nan_p=0.1),
+        ),
+        readmit_after=K,
+    )
+    if res["outcome"] != "failed" and res["counters"]["rollbacks"] == 0:
+        raise CellFailed(
+            "flapping cell drew no rollbacks — the injection rate no "
+            "longer flaps a replica; retune nan_p"
+        )
+    return res
+
+
+# --------------------------------------------------------------------------
+# checkpoint / publish: byte corruption at named positions
+# --------------------------------------------------------------------------
+
+
+def _member_data_offset(path, member: str) -> int:
+    """Byte offset of a (stored, uncompressed) npz member's data — so
+    the corruption cells can hit NAMED regions of the file (a leaf
+    payload, the config header, the meta header) instead of magic
+    offsets."""
+    with zipfile.ZipFile(path) as z:
+        info = z.getinfo(member)
+    with open(path, "rb") as f:
+        f.seek(info.header_offset + 26)
+        n, m = struct.unpack("<HH", f.read(4))
+    return info.header_offset + 30 + n + m
+
+
+def _corrupt_member(path, member: str, skip: int = 96) -> None:
+    """Flip a burst of bytes ``skip`` into the member's data (past the
+    .npy magic/header, inside the array payload)."""
+    off = _member_data_offset(path, member)
+    with open(path, "r+b") as f:
+        f.seek(off + skip)
+        f.write(b"\xde\xad\xbe\xef" * 16)
+
+
+_CKPT_MEMBER = {
+    "payload": "leaf_000.npy",
+    "header": "__config__.npy",
+    "meta": "__meta__.npy",
+}
+
+
+def _run_ckpt_bitflip(intensity: str) -> dict:
+    """Watcher-facing checkpoint corruption at a named position:
+    single-position flips must land on the ``.prev`` fallback
+    (counters correct, serving the previous good policy bitwise);
+    ``truncate`` exercises the unreadable-zip path the same way;
+    ``both`` (primary AND ``.prev``) must REJECT and keep serving the
+    last good block; a healthy re-publish must recover either way."""
+    import jax
+    import numpy as np
+
+    from rcmarl_tpu.serve.engine import (
+        ServeEngine,
+        serve_block,
+        stack_actor_rows,
+    )
+    from rcmarl_tpu.serve.swap import CheckpointWatcher
+    from rcmarl_tpu.training.trainer import init_train_state
+    from rcmarl_tpu.utils.checkpoint import save_checkpoint
+
+    cfg = _tiny()
+    state_a = init_train_state(cfg, jax.random.PRNGKey(0))
+    state_b = init_train_state(cfg, jax.random.PRNGKey(1))
+    obs = jax.random.normal(
+        jax.random.PRNGKey(5), (4, cfg.n_agents, cfg.obs_dim)
+    )
+    key = jax.random.PRNGKey(9)
+
+    def probs_of(state):
+        _, p = serve_block(
+            cfg, stack_actor_rows(state.params, cfg), obs, key
+        )
+        return np.asarray(p)
+
+    with tempfile.TemporaryDirectory() as d:
+        path = Path(d) / "checkpoint.npz"
+        meta = {"replicas": 0, "origin": "chaos"}
+        save_checkpoint(path, state_a, cfg, meta=meta)
+        eng = ServeEngine(path)
+        watcher = CheckpointWatcher(eng)
+        save_checkpoint(path, state_b, cfg, meta=meta)  # rotates A -> .prev
+        if intensity == "both":
+            _corrupt_member(path, _CKPT_MEMBER["payload"])
+            _corrupt_member(str(path) + ".prev", _CKPT_MEMBER["payload"])
+        elif intensity == "truncate":
+            path.write_bytes(path.read_bytes()[: path.stat().st_size // 2])
+        else:
+            _corrupt_member(path, _CKPT_MEMBER[intensity])
+        applied = watcher.poll()
+        _, p = eng.serve(obs, key=key)
+        if not np.isfinite(np.asarray(p)).all():
+            raise CellFailed("engine served non-finite probabilities")
+        if intensity == "both":
+            if applied or eng.counters["rejects"] != 1:
+                raise CellFailed(
+                    "double corruption was not rejected "
+                    f"(applied={applied}, counters={eng.counters})"
+                )
+            expect = state_a  # the initial load is the last good block
+        else:
+            if not applied or eng.counters["fallbacks"] != 1:
+                raise CellFailed(
+                    "single-position corruption did not land on the "
+                    f".prev fallback (applied={applied}, "
+                    f"counters={eng.counters})"
+                )
+            expect = state_a  # .prev holds A
+        if not np.array_equal(np.asarray(p), probs_of(expect)):
+            raise CellFailed("served policy is not the expected block")
+        # recovery: a healthy re-publish must swap in
+        save_checkpoint(path, state_b, cfg, meta=meta)
+        if not watcher.poll():
+            raise CellFailed("healthy re-publish did not recover")
+        _, p2 = eng.serve(obs, key=key)
+        if not np.array_equal(np.asarray(p2), probs_of(state_b)):
+            raise CellFailed("post-recovery serving is not the candidate")
+        return {
+            "outcome": "survived",
+            "counters": dict(eng.counters),
+            "final_return": None,
+            "clean_return": None,
+            "detail": (
+                f"corrupt {intensity}; poll -> "
+                + ("reject+last-good" if intensity == "both" else
+                   ".prev fallback")
+                + "; healthy re-publish recovers"
+            ),
+        }
+
+
+def _run_publish_poison(intensity: str) -> dict:
+    """A NaN-poisoned in-memory publish candidate must be rejected by
+    the shared ``params_finite`` guard with the actor tier kept on the
+    last good tree (the PolicyPublisher half of the watcher contract)."""
+    import numpy as np
+
+    from rcmarl_tpu.pipeline.publish import PolicyPublisher
+
+    good = {"w": np.ones(8, np.float32)}
+    pub = PolicyPublisher(good, validate=True)
+    bad = {"w": np.full(8, np.nan, np.float32)}
+    if pub.offer(bad, 1) is not False or pub.acting is not good:
+        raise CellFailed("poisoned publish reached the acting tier")
+    fresh = {"w": np.full(8, 2.0, np.float32)}
+    if pub.offer(fresh, 2) is not True or pub.acting is not fresh:
+        raise CellFailed("publisher wedged after the rejection")
+    return {
+        "outcome": "survived",
+        "counters": dict(pub.counters),
+        "final_return": None,
+        "clean_return": None,
+        "detail": "NaN candidate rejected, healthy re-publish promoted",
+    }
+
+
+# --------------------------------------------------------------------------
+# pipeline: poisoned actor-tier rollout windows + faulted guarded runs
+# --------------------------------------------------------------------------
+
+
+def _nan_bomb_window(fresh, m):
+    import jax
+    import jax.numpy as jnp
+
+    bomb = lambda l: (
+        jnp.full_like(l, jnp.nan)
+        if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+        else l
+    )
+    return jax.tree.map(bomb, fresh), m
+
+
+def _run_pipeline_window(intensity: str) -> dict:
+    """``transient``: block 1's dispatched window is poisoned once —
+    one redraw must recover it (no skip, full publishes).
+    ``persistent``: every draw of block 1 is poisoned — bounded
+    redraws, then a skip with NOTHING published and the staleness
+    lengthened (the skip-and-redraw contract; historically the learner
+    burned its retry budget re-consuming the same poisoned window)."""
+    from rcmarl_tpu.pipeline.trainer import train_pipelined
+
+    persistent = intensity == "persistent"
+
+    def window_fault(b, attempt, fresh, m):
+        if b == 1 and (persistent or attempt == 0):
+            return _nan_bomb_window(fresh, m)
+        return fresh, m
+
+    cfg = _tiny(pipeline_depth=2, n_episodes=8)
+    state, df = train_pipelined(
+        cfg, guard=True, max_retries=2, window_fault=window_fault
+    )
+    g = df.attrs["guard"]
+    p = df.attrs["pipeline"]
+    if not _params_ok(state):
+        raise CellFailed("poisoned window reached the params")
+    n_blocks = p["blocks"]
+    if persistent:
+        ok = (
+            g["redraws"] == 2
+            and g["skipped"] == 1
+            and p["publishes"] == n_blocks - 1
+        )
+        outcome = "degraded"  # one training block lost, contained
+    else:
+        ok = (
+            g["redraws"] == 1
+            and g["skipped"] == 0
+            and p["publishes"] == n_blocks
+        )
+        outcome = "survived"
+    if not ok:
+        raise CellFailed(
+            f"window guard accounting broke: guard={g}, pipeline="
+            f"{ {k: p[k] for k in ('publishes', 'staleness')} }"
+        )
+    final = _final_return(df)
+    return {
+        "outcome": outcome,
+        "counters": {**g, "publishes": p["publishes"]},
+        "final_return": final if math.isfinite(final) else None,
+        "clean_return": None,
+        "detail": (
+            f"{intensity} all-NaN rollout window at block 1, depth 2, "
+            "max_retries 2"
+        ),
+    }
+
+
+def _run_pipeline_faulted(intensity: str) -> dict:
+    """A depth-2 pipelined run under the standard drop+NaN+stale plan
+    with sanitize: the learner-side guard + publisher validation must
+    keep the run finite and publishing."""
+    import numpy as np
+
+    from rcmarl_tpu.lint.configs import tiny_faulted_cfg
+    from rcmarl_tpu.pipeline.trainer import train_pipelined
+
+    depth = int(intensity.removeprefix("depth"))
+    cfg = tiny_faulted_cfg(False, pipeline_depth=depth, n_episodes=8)
+    state, df = train_pipelined(cfg)
+    clean_key = ("pipeline_clean", depth)
+    if clean_key not in _CLEAN_CACHE:
+        _, cdf = train_pipelined(
+            _tiny(pipeline_depth=depth, n_episodes=8)
+        )
+        _CLEAN_CACHE[clean_key] = _final_return(cdf)
+    clean = _CLEAN_CACHE[clean_key]
+    g = df.attrs["guard"]
+    p = df.attrs["pipeline"]
+    returns = np.asarray(df["True_team_returns"].values, dtype=float)
+    final = _final_return(df)
+    if not _params_ok(state) or not np.isfinite(returns[-RETURN_WINDOW:]).all():
+        outcome = "failed"
+        final = None
+    elif g["skipped"] > 0 or not _within_band(final, clean):
+        outcome = "degraded"
+    else:
+        outcome = "survived"
+    return {
+        "outcome": outcome,
+        "counters": {**g, "publishes": p["publishes"]},
+        "final_return": final,
+        "clean_return": clean,
+        "detail": f"depth {depth}, drop+NaN+stale plan, sanitize+guard",
+    }
+
+
+# --------------------------------------------------------------------------
+# serving: stale candidates (canary) + request-level overload
+# --------------------------------------------------------------------------
+
+
+def _run_canary_stale(intensity: str) -> dict:
+    """A checksum-valid, fully finite candidate whose POLICY is below
+    the band — the case no file/finiteness guard can catch — must be
+    rejected by the canary gate with the engine kept BITWISE on the
+    incumbent, and a healthy re-publish must promote."""
+    import jax
+    import numpy as np
+
+    from rcmarl_tpu.serve.canary import CanaryGate, CanaryWatcher
+    from rcmarl_tpu.serve.engine import ServeEngine
+    from rcmarl_tpu.training.trainer import init_train_state
+    from rcmarl_tpu.utils.checkpoint import save_checkpoint
+
+    cfg = _tiny()
+    incumbent = init_train_state(cfg, jax.random.PRNGKey(0))
+    candidate = init_train_state(cfg, jax.random.PRNGKey(123))
+    with tempfile.TemporaryDirectory() as d:
+        path = Path(d) / "checkpoint.npz"
+        save_checkpoint(path, incumbent, cfg)
+        eng = ServeEngine(path)
+        gate = CanaryGate(
+            cfg, incumbent.desired, incumbent.initial, band=0.05, blocks=1
+        )
+        watcher = CanaryWatcher(eng, gate)
+        # pin the incumbent reference above any achievable return, so
+        # the finite fresh-init candidate is deterministically below
+        # the floor (the committed canary_gate.json experiment carries
+        # the trained-vs-stale version of this arm)
+        gate.incumbent_return = 0.0
+        save_checkpoint(path, candidate, cfg)
+        if watcher.poll() is not False:
+            raise CellFailed("band-violating candidate was promoted")
+        if gate.counters["rejects"] != 1 or not eng.degraded:
+            raise CellFailed(
+                f"reject ledger wrong: gate={gate.counters}, "
+                f"engine degraded={eng.degraded}"
+            )
+        # the contract this cell names: after the reject the engine is
+        # BITWISE the incumbent policy (not just counter-correct)
+        from rcmarl_tpu.serve.engine import serve_block, stack_actor_rows
+
+        obs = jax.random.normal(
+            jax.random.PRNGKey(5), (4, cfg.n_agents, cfg.obs_dim)
+        )
+        key = jax.random.PRNGKey(9)
+        _, p = eng.serve(obs, key=key)
+        _, p_inc = serve_block(
+            cfg, stack_actor_rows(incumbent.params, cfg), obs, key
+        )
+        if not np.array_equal(np.asarray(p), np.asarray(p_inc)):
+            raise CellFailed(
+                "post-reject serving is not bitwise the incumbent"
+            )
+        # recovery: set a real incumbent reference; the same candidate
+        # now clears the band and promotes
+        gate.set_incumbent(incumbent.params)
+        save_checkpoint(path, candidate, cfg)
+        if watcher.poll() is not True:
+            raise CellFailed("gate wedged after the rejection")
+        return {
+            "outcome": "survived",
+            "counters": {**gate.counters, **eng.counters},
+            "final_return": (
+                None
+                if gate.last is None
+                else gate.last.get("candidate_return")
+            ),
+            "clean_return": None,
+            "detail": (
+                "stale-policy candidate band-rejected, incumbent kept, "
+                "re-publish promoted"
+            ),
+        }
+
+
+#: Deterministic synthetic service model for the overload cells: the
+#: queue math is the system under test, and a measured launch would
+#: leak wall-clock noise into a gated ledger row.
+_SERVICE_S = 0.001
+_MAX_BATCH = 16
+_MAX_WAIT = 0.002
+_SHED_AFTER = 0.002
+_OVERLOAD_X = 4.0  # offered load, as a multiple of capacity
+
+
+def _run_overload(intensity: str) -> dict:
+    """Request-level overload past the saturation knee through the
+    micro-batching queue (deterministic service model): ``noshed`` is
+    the documented backlog cliff — p99 beyond the latency bound —
+    while ``shed`` must keep p99 within ``LATENCY_BOUND_FACTOR`` x the
+    knee-point p99 with the cost ledgered as the shed fraction (the
+    deadline-shedding acceptance criterion as a gated cell)."""
+    from rcmarl_tpu.serve.load import poisson_arrivals, run_load
+
+    capacity = _MAX_BATCH / _SERVICE_S
+    knee = run_load(
+        lambda fill: _SERVICE_S,
+        poisson_arrivals(0, 4000, 0.8 * capacity),
+        _MAX_BATCH,
+        _MAX_WAIT,
+    )
+    arrivals = poisson_arrivals(0, 4000, _OVERLOAD_X * capacity)
+    shed_after = _SHED_AFTER if intensity == "shed" else math.inf
+    rep = run_load(
+        lambda fill: _SERVICE_S, arrivals, _MAX_BATCH, _MAX_WAIT, shed_after
+    )
+    bound = LATENCY_BOUND_FACTOR * knee["p99"]
+    bounded = rep["p99"] <= bound
+    if intensity == "shed":
+        if not bounded:
+            raise CellFailed(
+                f"shedding failed to bound p99: {rep['p99']:.4f}s > "
+                f"{bound:.4f}s (= {LATENCY_BOUND_FACTOR}x knee p99)"
+            )
+        if rep["shed_fraction"] <= 0.0:
+            raise CellFailed("overload shed nothing — the cell is idle")
+        outcome = "survived"
+    else:
+        # the shed-free arm PAST the knee is backlog by construction;
+        # a bounded p99 here would mean the overload is no overload
+        if bounded:
+            raise CellFailed(
+                "the no-shed overload arm stayed under the bound — "
+                "the offered load no longer saturates; retune"
+            )
+        outcome = "degraded"
+    return {
+        "outcome": outcome,
+        "counters": {
+            "p99_ms": round(rep["p99"] * 1e3, 3),
+            "knee_p99_ms": round(knee["p99"] * 1e3, 3),
+            "shed": rep["shed"],
+            "served": rep["served"],
+            "shed_fraction": round(rep["shed_fraction"], 4),
+        },
+        "final_return": None,
+        "clean_return": None,
+        "detail": (
+            f"{_OVERLOAD_X:.0f}x capacity offered, "
+            f"shed_after={'off' if shed_after == math.inf else shed_after}"
+        ),
+    }
+
+
+# --------------------------------------------------------------------------
+# THE REGISTRY
+# --------------------------------------------------------------------------
+
+CHAOS_POINTS: Tuple[ChaosPoint, ...] = (
+    ChaosPoint(
+        "link_drop", "transport",
+        "consensus link delivers nothing (NaN payload)",
+        "FaultPlan.drop_p + sanitize + guard",
+        "sanitize exclusion + degree-deficit fallback",
+        "tests/test_faults.py", (("0.2", "survived"), ("0.5", "survived")),
+        _link_runner("link_drop"),
+    ),
+    ChaosPoint(
+        "link_nan", "transport",
+        "adversarial all-NaN payload bombs on consensus links",
+        "FaultPlan.nan_p + sanitize + guard",
+        "sanitize exclusion + degree-deficit fallback",
+        "tests/test_faults.py", (("0.2", "survived"), ("0.5", "survived")),
+        _link_runner("link_nan"),
+    ),
+    ChaosPoint(
+        "link_nan_unsanitized", "transport",
+        "NaN bombs with the sanitize kernel OFF (guard-only containment)",
+        "FaultPlan.nan_p, guard rollback/skip",
+        "trainer guard rails (rollback, bounded retry, skip)",
+        "tests/test_faults.py::TestGuardedTraining", (("0.2", "degraded"),),
+        _link_runner("link_nan", sanitize=False),
+    ),
+    ChaosPoint(
+        "link_stale", "transport",
+        "links replay the sender's stale pre-fit weights",
+        "FaultPlan.stale_p + sanitize + guard",
+        "trim/clip into the healthy bounds",
+        "tests/test_faults.py", (("0.3", "survived"),),
+        _link_runner("link_stale"),
+    ),
+    ChaosPoint(
+        "link_flip", "transport",
+        "sign-flip corruption of whole link payloads",
+        "FaultPlan.flip_p + sanitize + guard",
+        "H-trimming (flipped payloads land outside the trim bounds)",
+        "tests/test_faults.py", (("0.3", "survived"),),
+        _link_runner("link_flip"),
+    ),
+    ChaosPoint(
+        "link_corrupt", "transport",
+        "additive Gaussian corruption of link payloads",
+        "FaultPlan.corrupt_p/corrupt_scale + sanitize + guard",
+        "clip into the trim bounds",
+        "tests/test_faults.py", (("0.3", "survived"),),
+        _link_runner("link_corrupt"),
+    ),
+    ChaosPoint(
+        "adaptive_collusion", "consensus",
+        "omniscient colluding adversary crafting payloads against the "
+        "trimmed mean",
+        "Roles.ADAPTIVE + Config.adaptive_scale",
+        "H-trimming (H >= colluders); H=0 is the documented undefended arm",
+        "tests/test_envs.py (adaptive cells), QUALITY.md adaptive section",
+        (("h1", "survived"), ("h0", "failed")),
+        _run_adaptive,
+    ),
+    ChaosPoint(
+        "replica_byzantine", "gossip",
+        "an always-adversarial learner replica bombing every gossip round",
+        "ReplicaFaultPlan.byzantine_replicas/_mode",
+        "trimmed-mean gossip mix at gossip_H + per-replica guard",
+        "tests/test_gossip.py, tests/test_gossip_properties.py",
+        (("nan", "survived"), ("sign_flip", "survived"),
+         ("inf", "survived")),
+        _run_byzantine,
+    ),
+    ChaosPoint(
+        "replica_byzantine_mean", "gossip",
+        "the same Byzantine replica against the UNHARDENED plain-mean mix",
+        "ReplicaFaultPlan.byzantine_replicas + gossip_mix='mean'",
+        "none — the documented comparison arm one NaN replica poisons",
+        "tests/test_gossip.py::TestGossipTrain", (("nan", "failed"),),
+        _run_byzantine_mean,
+    ),
+    ChaosPoint(
+        "replica_link_nan", "gossip",
+        "probabilistic NaN bombs on replica gossip links",
+        "ReplicaFaultPlan.nan_p",
+        "sanitized trimmed mix (per-element exclusion)",
+        "tests/test_gossip_properties.py", (("0.3", "survived"),),
+        _run_replica_link,
+    ),
+    ChaosPoint(
+        "gossip_flapping", "gossip",
+        "replicas flapping unhealthy/healthy under probabilistic "
+        "agent-level poisoning (no sanitize)",
+        "FaultPlan.nan_p + train_gossip(readmit_after=K)",
+        "per-replica rollback + sticky quarantine + K-round readmission",
+        "tests/test_gossip.py (readmission cells)",
+        (("readmit1", "degraded"),),
+        _run_flapping,
+    ),
+    ChaosPoint(
+        "ckpt_bitflip", "checkpoint",
+        "byte corruption of the serving checkpoint at a named position",
+        "bit flips in leaf payload / __config__ / __meta__ / truncation "
+        "/ primary+.prev",
+        "payload checksum + .prev rotation + watcher reject/last-good",
+        "tests/test_serve.py::TestHotSwap",
+        (("payload", "survived"), ("header", "survived"),
+         ("meta", "survived"), ("truncate", "survived"),
+         ("both", "survived")),
+        _run_ckpt_bitflip,
+    ),
+    ChaosPoint(
+        "publish_poison", "publish",
+        "a NaN-poisoned in-memory publish candidate offered to the "
+        "acting tier",
+        "PolicyPublisher(validate=True)",
+        "shared params_finite guard, reject + keep last good",
+        "tests/test_pipeline.py::TestPolicyPublisher", (("nan", "survived"),),
+        _run_publish_poison,
+    ),
+    ChaosPoint(
+        "pipeline_window", "pipeline",
+        "poisoned/dropped actor-tier rollout windows in transit between "
+        "the tiers",
+        "train_pipelined(window_fault=...) (the chaos seam)",
+        "window pickup guard: bounded redraws, then skip (no learner "
+        "launch, nothing published)",
+        "tests/test_pipeline.py (window-guard cells)",
+        (("transient", "survived"), ("persistent", "degraded")),
+        _run_pipeline_window,
+    ),
+    ChaosPoint(
+        "pipeline_faulted", "pipeline",
+        "the standard transport plan under a depth-2 decoupled pipeline",
+        "FaultPlan + sanitize through train_pipelined",
+        "learner-side guard + publisher validation",
+        "tests/test_pipeline.py::TestPipelined", (("depth2", "survived"),),
+        _run_pipeline_faulted,
+    ),
+    ChaosPoint(
+        "serve_canary", "serving",
+        "a checksum-valid, finite candidate whose POLICY regressed below "
+        "the band",
+        "CanaryGate/CanaryWatcher (serve --canary_band)",
+        "frozen-policy return gate, reject + incumbent keeps serving",
+        "tests/test_serve_canary.py", (("stale", "survived"),),
+        _run_canary_stale,
+    ),
+    ChaosPoint(
+        "serve_overload", "serving",
+        "request-level overload past the saturation knee",
+        "offered load >> capacity through the micro-batching queue",
+        "deadline shedding (run_load shed_after): bounded p99, ledgered "
+        "shed fraction",
+        "tests/test_serve_load.py (shed cells)",
+        (("noshed", "degraded"), ("shed", "survived")),
+        _run_overload,
+    ),
+)
+
+
+def registry_cells() -> Tuple[Tuple[str, str], ...]:
+    """Every (point, intensity) cell in canonical order."""
+    return tuple(
+        (p.name, label) for p in CHAOS_POINTS for label, _ in p.cells
+    )
+
+
+def point_by_name(name: str) -> Optional[ChaosPoint]:
+    for p in CHAOS_POINTS:
+        if p.name == name:
+            return p
+    return None

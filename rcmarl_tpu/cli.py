@@ -371,6 +371,18 @@ def _add_gossip_flags(p: argparse.ArgumentParser) -> None:
         help="gossip-stream namespace (graph positions + replica fault "
         "draws), independent of the training seeds",
     )
+    g.add_argument(
+        "--gossip_readmit_after",
+        type=int,
+        default=0,
+        help="sticky-quarantine readmission: a guard-excluded replica "
+        "re-enters the gossip mix only after this many CONSECUTIVE "
+        "healthy probe rounds (an unhealthy segment resets the "
+        "streak — the flapping-sender defense); 0 (default) = the "
+        "historical one-round exclusion, bit-for-bit "
+        "(rcmarl_tpu.parallel.gossip, run-local knob like the serve "
+        "flags — not a Config field)",
+    )
     rf = p.add_argument_group(
         "replica faults (per directed gossip link per round)"
     )
@@ -700,6 +712,7 @@ def cmd_train(argv) -> int:
                 guard={"auto": None, "on": True, "off": False}[args.guard],
                 start_round=int(ckpt_meta.get("gossip_round", 0)),
                 excluded=ckpt_meta.get("excluded"),
+                readmit_after=args.gossip_readmit_after,
             )
             g = sim_data.attrs["gossip"]
             final_meta = {
@@ -751,6 +764,13 @@ def cmd_train(argv) -> int:
             f"{g['nonfinite']} non-finite payload entries, "
             f"{g['deficit']} degree-deficit fallbacks; healthy: "
             f"{sum(g['replica_healthy'])}/{g['replicas']}"
+            + (
+                f"; readmissions: {g['readmitted']} "
+                f"(readmit_after={g['readmit_after']}, quarantined: "
+                f"{sum(g['quarantined'])})"
+                if g.get("readmit_after")
+                else ""
+            )
             + (f" (byzantine: {g['byzantine']})" if g["byzantine"] else "")
         )
 
@@ -2570,6 +2590,136 @@ def cmd_lint(argv) -> int:
 
 
 # --------------------------------------------------------------------------
+# chaos
+# --------------------------------------------------------------------------
+
+
+def cmd_chaos(argv) -> int:
+    p = argparse.ArgumentParser(
+        prog="rcmarl_tpu chaos",
+        description="Chaos campaign: sweep the fault-surface registry "
+        "(rcmarl_tpu.chaos) as short real runs and gate the committed "
+        "RESILIENCE.jsonl ledger — a cell that previously survived and "
+        "now fails, or whose degradation envelope widened past "
+        "tolerance, is a finding (exit 1). The AUDIT.jsonl discipline "
+        "applied to resilience.",
+    )
+    p.add_argument(
+        "--check",
+        action="store_true",
+        help="run the campaign (or the --cells subset) and compare "
+        "against --baseline: outcome regressions (survived->degraded/"
+        "failed, degraded->failed), widened degradation envelopes, "
+        "unbaselined registry cells, and stale committed rows are "
+        "findings; improvements and skipped-on-this-host cells are "
+        "notes (cost-arm discipline). On failure the fresh rows land "
+        "in <baseline>.new",
+    )
+    p.add_argument(
+        "--run",
+        action="store_true",
+        help="regenerate the ledger: run the campaign (or the --cells "
+        "subset, merged over the kept rows) and write --baseline — the "
+        "ledger-update step of a legitimate resilience PR (commit it "
+        "in the same PR)",
+    )
+    p.add_argument(
+        "--list",
+        action="store_true",
+        help="print the fault-surface registry (point, subsystem, "
+        "cells, guard, test pin) and exit",
+    )
+    p.add_argument(
+        "--cells",
+        nargs="+",
+        default=None,
+        metavar="POINT[@INTENSITY]",
+        help="restrict to these cells (e.g. 'link_nan@0.5 ckpt_bitflip' "
+        "— a bare point name selects all its intensities); a subset "
+        "--check judges only what it ran",
+    )
+    p.add_argument(
+        "--baseline",
+        type=str,
+        default="RESILIENCE.jsonl",
+        help="the committed resilience ledger (default ./RESILIENCE.jsonl)",
+    )
+    args = p.parse_args(argv)
+    if sum((args.check, args.run, args.list)) != 1:
+        raise SystemExit(
+            "chaos: pass exactly one of --check / --run / --list"
+        )
+
+    from rcmarl_tpu.chaos.registry import CHAOS_POINTS
+
+    if args.list:
+        for pt in CHAOS_POINTS:
+            cells = ", ".join(
+                f"{label}->{exp}" for label, exp in pt.cells
+            )
+            print(f"{pt.name} [{pt.subsystem}] — {pt.description}")
+            print(f"    injector: {pt.injector}")
+            print(f"    guard:    {pt.guard}")
+            print(f"    pinned:   {pt.test_pin}")
+            print(f"    cells:    {cells}")
+        return 0
+
+    from rcmarl_tpu.chaos.campaign import (
+        check_campaign,
+        read_resilience,
+        run_campaign,
+        write_resilience,
+    )
+
+    if args.run:
+        from rcmarl_tpu.chaos.registry import registry_cells
+
+        rows, notes = run_campaign(args.cells)
+        ran = {(r["point"], r["intensity"]) for r in rows}
+        known = set(registry_cells())
+        # kept rows: cells outside a --cells subset AND cells this host
+        # skipped — a partial regenerate (or a host that cannot run a
+        # cell) must not silently drop measured rows. Rows naming NO
+        # registry cell are dropped here: they are what the check
+        # reports chaos-stale for, and --run is its documented remedy
+        kept = [
+            r
+            for r in read_resilience(args.baseline)
+            if (r["point"], r["intensity"]) not in ran
+            and (r["point"], r["intensity"]) in known
+        ]
+        write_resilience(args.baseline, kept + rows)
+        for note in notes:
+            print(f"# note: {note}", file=sys.stderr)
+        print(
+            f"wrote {len(rows)} fresh + {len(kept)} kept row(s) to "
+            f"{args.baseline}"
+        )
+        return 0
+
+    findings, notes, fresh = check_campaign(args.baseline, args.cells)
+    for note in notes:
+        print(f"# note: {note}", file=sys.stderr)
+    if findings and fresh:
+        write_resilience(f"{args.baseline}.new", fresh)
+        print(
+            f"# fresh rows written to {args.baseline}.new — diff against "
+            f"{args.baseline}; if the resilience change is intentional, "
+            "regenerate with `chaos --run` and commit",
+            file=sys.stderr,
+        )
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"chaos: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    n = len(fresh)
+    subsystems = len({r["subsystem"] for r in fresh})
+    print(f"chaos: OK ({n} cell(s) across {subsystems} subsystem(s) clean)")
+    return 0
+
+
+# --------------------------------------------------------------------------
 # plot
 # --------------------------------------------------------------------------
 
@@ -2949,6 +3099,7 @@ def main(argv=None) -> int:
         "parity": cmd_parity,
         "quality": cmd_quality,
         "lint": cmd_lint,
+        "chaos": cmd_chaos,
     }
     if not argv or argv[0] in ("-h", "--help"):
         print(f"usage: python -m rcmarl_tpu {{{','.join(cmds)}}} [flags]")

@@ -528,33 +528,67 @@ def fault_diagnostics(tree, H, valid=None) -> FaultDiag:
     return FaultDiag(nonfinite=nonfinite, deficit=deficit)
 
 
-def tree_all_finite(tree):
-    """() bool: every leaf of ``tree`` is fully finite — the trainer
-    guard's per-block health check (cheap: one fused reduction)."""
+# --------------------------------------------------------------------------
+# The finite-predicate family — ONE contract, three granularities
+# --------------------------------------------------------------------------
+#
+# Every health decision in the repo (trainer guard, gossip per-replica
+# guard, serve/publish candidate gates, the chaos campaign's outcome
+# classifier) reduces to the same question asked at one of three
+# granularities, and the three predicates below share one contract so
+# they can never drift apart (docs/api.md "finite-predicate family"):
+#
+# - FLOATING LEAVES ONLY: integer/bool leaves (actions, counters, RNG
+#   keys, block indices) are vacuously finite and never inspected — a
+#   predicate that looked at them would reject every healthy tree the
+#   moment a uint32 key rode along.
+# - FINITE means ``isfinite``: NaN AND ±Inf both fail (an Inf-bombed
+#   tree is as unservable as a NaN one).
+# - An all-non-floating tree is healthy by definition for the scalar
+#   forms; the per-replica form REFUSES it loudly (an (R,)-verdict over
+#   nothing would silently pass every replica).
+#
+# ``tree_all_finite`` is the traced form (safe inside jit, one fused
+# reduction); ``params_finite`` the host-bool wrapper every swap chain
+# gates on; ``tree_finite_per_replica`` the host-side factored form.
+
+
+def _float_leaves(tree, xp):
+    """The leaves the finite-predicate contract inspects: floating
+    dtypes only, under either array namespace (``jnp`` for the traced
+    predicate, ``np`` for the host-side ones)."""
     import jax
+
+    return [
+        l
+        for l in jax.tree.leaves(tree)
+        if xp.issubdtype(xp.asarray(l).dtype, xp.floating)
+    ]
+
+
+def tree_all_finite(tree):
+    """() bool: every FLOATING leaf of ``tree`` is fully finite — the
+    trainer guard's per-block health check (cheap: one fused
+    reduction; traced-safe). See the family contract above."""
     import jax.numpy as jnp
 
-    leaves = [
-        jnp.all(jnp.isfinite(l))
-        for l in jax.tree.leaves(tree)
-        if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
-    ]
+    leaves = [jnp.all(jnp.isfinite(l)) for l in _float_leaves(tree, jnp)]
     if not leaves:
         return jnp.asarray(True)
     return jnp.stack(leaves).all()
 
 
 def params_finite(params) -> bool:
-    """Host bool: a candidate parameter tree is fully finite — THE
-    publish/hot-swap guard, shared by every chain that swaps a policy
-    into a running consumer (the serving engine's constructor and
-    checkpoint watcher, :mod:`rcmarl_tpu.serve`, and the pipeline's
-    in-memory publisher, :mod:`rcmarl_tpu.pipeline.publish`). A
-    poisoned-but-well-formed candidate (the transport threat model
-    above, landed in a parameter tree) must be rejected BEFORE the
-    swap, with the consumer kept on its last good tree. Host-syncing —
-    callers that need block-free handoff only validate when a guard is
-    active."""
+    """Host bool: :func:`tree_all_finite` fetched — THE publish/hot-swap
+    candidate guard, shared by every chain that swaps a policy into a
+    running consumer (the serving engine's constructor and checkpoint
+    watcher, :mod:`rcmarl_tpu.serve`, and the pipeline's in-memory
+    publisher, :mod:`rcmarl_tpu.pipeline.publish`). A poisoned-but-
+    well-formed candidate (the transport threat model above, landed in
+    a parameter tree) must be rejected BEFORE the swap, with the
+    consumer kept on its last good tree. Host-syncing — callers that
+    need block-free handoff only validate when a guard is active. Same
+    contract as the family (floating leaves, NaN/±Inf both fail)."""
     return bool(tree_all_finite(params))
 
 
@@ -562,26 +596,27 @@ def tree_finite_per_replica(tree):
     """(R,) numpy bool: :func:`tree_all_finite` factored per LEADING index.
 
     Every floating leaf must carry a shared leading replica axis; entry
-    ``r`` is True iff replica ``r``'s slice of every leaf is fully
-    finite. This is the per-replica guard predicate of the gossip
-    trainer (:mod:`rcmarl_tpu.parallel.gossip`): one poisoned replica
-    rolls back alone instead of forcing a global rollback of the
-    healthy ones. Computed HOST-SIDE on fetched leaves — the verdict
-    feeds a host control decision anyway, and a plain device-to-host
-    copy stays collective-free however the replica axis is sharded.
+    ``r`` is True iff replica ``r``'s slice of every floating leaf is
+    fully finite (the family contract above — non-floating leaves are
+    never inspected, but an all-non-floating tree raises loudly: an
+    (R,) verdict over nothing would silently pass every replica). This
+    is the per-replica guard predicate of the gossip trainer
+    (:mod:`rcmarl_tpu.parallel.gossip`): one poisoned replica rolls
+    back alone instead of forcing a global rollback of the healthy
+    ones. Computed HOST-SIDE on fetched leaves — the verdict feeds a
+    host control decision anyway, and a plain device-to-host copy stays
+    collective-free however the replica axis is sharded.
     """
-    import jax
     import numpy as np
 
-    oks = None
-    for l in jax.tree.leaves(tree):
-        a = np.asarray(l)
-        if not np.issubdtype(a.dtype, np.floating):
-            continue
-        fin = np.isfinite(a.reshape(a.shape[0], -1)).all(axis=1)
-        oks = fin if oks is None else (oks & fin)
-    if oks is None:
+    leaves = _float_leaves(tree, np)
+    if not leaves:
         raise ValueError(
             "tree_finite_per_replica: no floating leaves to health-check"
         )
+    oks = None
+    for l in leaves:
+        a = np.asarray(l)
+        fin = np.isfinite(a.reshape(a.shape[0], -1)).all(axis=1)
+        oks = fin if oks is None else (oks & fin)
     return oks

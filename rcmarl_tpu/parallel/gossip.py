@@ -36,6 +36,24 @@ mix then drops it per element exactly like a NaN-bombing link.
 Degradation counters (mix rounds, rollbacks, exclusions, non-finite
 payload entries, degree-deficit fallbacks) land in
 ``df.attrs['gossip']``, FaultDiag-style.
+
+Readmission (``readmit_after``): the PR-7 exclusion is ONE-round — a
+rolled-back replica sits out the very next mix and re-enters
+unconditionally. That is the right default for transient poisonings,
+but a FLAPPING sender (poisoned this segment, clean the next, poisoned
+again — e.g. a probabilistic agent-level NaN plan without sanitize)
+re-enters the mix exactly when its luck turns, every time.
+``readmit_after=K > 0`` makes the quarantine sticky: an excluded
+replica must first PROVE ``K`` consecutive healthy (finite post-segment
+params/metrics) probe rounds before its payloads re-enter the mix; an
+unhealthy segment resets the streak. The quarantined replica keeps
+TRAINING and keeps RECEIVING mixes (its own slot-0 row is never
+excluded), so readmission is recovery, not resurrection.
+``readmit_after=0`` (default) is the PR-7 behavior bit-for-bit — pinned
+in tests/test_gossip.py. Counters (``readmitted``, the live
+``quarantined`` mask) ride ``df.attrs['gossip']``; the checkpoint meta
+carries the union exclusion mask, so a resumed quarantined replica
+restarts its probe streak (the conservative direction).
 """
 
 from __future__ import annotations
@@ -272,6 +290,7 @@ def train_gossip(
     guard: Optional[bool] = None,
     start_round: int = 0,
     excluded=None,
+    readmit_after: int = 0,
 ):
     """Host-looped gossip-replicated training run.
 
@@ -298,7 +317,13 @@ def train_gossip(
         is active.
       start_round: the gossip round counter to resume from (namespaces
         the per-round fault draws).
-      excluded: (R,) bools carried over from a checkpointed run.
+      excluded: (R,) bools carried over from a checkpointed run (under
+        ``readmit_after > 0`` they seed the sticky quarantine mask; the
+        probe streak restarts at zero — the conservative direction).
+      readmit_after: 0 (default) = the PR-7 one-round exclusion,
+        bit-for-bit; K > 0 = sticky quarantine — an excluded replica
+        re-enters the mix only after K consecutive healthy probe
+        rounds (see the module docstring; the flapping-sender defense).
 
     Returns ``(replica-stacked TrainState, sim_data DataFrame)``. The
     frame's rows are the per-episode mean over the NON-Byzantine
@@ -330,19 +355,28 @@ def train_gossip(
         guard = (
             cfg.replica_fault_plan is not None and cfg.replica_fault_plan.active
         ) or (cfg.fault_plan is not None and cfg.fault_plan.active)
+    if readmit_after < 0:
+        raise ValueError(f"readmit_after={readmit_after} must be >= 0")
 
     stats = {
         "rounds": 0,
         "rollbacks": 0,
         "excluded": 0,
+        "readmitted": 0,
         "nonfinite": 0,
         "deficit": 0,
     }
     plan = cfg.replica_fault_plan
     byz = set(plan.byzantine_replicas) if plan is not None else set()
-    excluded = (
+    carried = (
         np.zeros(R, bool) if excluded is None else np.asarray(excluded, bool)
     )
+    # readmit_after=0: the PR-7 one-round accumulator (cleared after
+    # every mix). K>0: the carried mask seeds the STICKY quarantine
+    # instead, and `excluded` stays a per-round scratch of zeros.
+    excluded = carried if readmit_after == 0 else np.zeros(R, bool)
+    quarantine = carried.copy() if readmit_after > 0 else np.zeros(R, bool)
+    streak = np.zeros(R, np.int64)
     round_idx = int(start_round)
     if states is None:
         states = init_states(cfg, replica_seeds(cfg))
@@ -379,7 +413,21 @@ def train_gossip(
                     skipped, jax.tree.map(lambda x: x.sharding, states)
                 )
                 states = _select_replicas(healthy, states, skipped)
-            excluded = excluded | ~healthy
+            if readmit_after > 0:
+                # sticky quarantine: a quarantined replica's healthy
+                # segment is one finite PROBE round; readmit_after of
+                # them in a row earn re-entry, an unhealthy one resets
+                # the streak (the flapping-sender defense)
+                streak = np.where(quarantine & healthy, streak + 1, streak)
+                readmit = quarantine & healthy & (streak >= readmit_after)
+                if readmit.any():
+                    stats["readmitted"] += int(readmit.sum())
+                    quarantine &= ~readmit
+                    streak[readmit] = 0
+                quarantine |= ~healthy
+                streak[~healthy] = 0
+            else:
+                excluded = excluded | ~healthy
         all_metrics.append(metrics)
         if mix_after:
             # The mix runs on ONE device: the replica axis may be
@@ -388,16 +436,17 @@ def train_gossip(
             # the mix collective-free (the next segment's device_put
             # re-shards). One R×P_total copy per round.
             dev0 = jax.devices()[0]
+            mix_exclude = excluded | quarantine
             mixed_params, diag = gossip_mix_block(
                 cfg,
                 jax.device_put(states.params, dev0),
                 jax.device_put(prev_params, dev0),
                 jnp.asarray(round_idx, jnp.int32),
-                jnp.asarray(excluded),
+                jnp.asarray(mix_exclude),
             )
             states = states._replace(params=mixed_params)
             stats["rounds"] += 1
-            stats["excluded"] += int(excluded.sum())
+            stats["excluded"] += int(mix_exclude.sum())
             stats["nonfinite"] += int(diag.nonfinite)
             stats["deficit"] += int(diag.deficit)
             excluded = np.zeros(R, bool)
@@ -441,7 +490,9 @@ def train_gossip(
                 {
                     "replicas": R,
                     "gossip_round": round_idx,
-                    "excluded": [int(x) for x in excluded],
+                    # the union mask: a checkpoint taken here must carry
+                    # the sticky quarantine, not just the round scratch
+                    "excluded": [int(x) for x in (excluded | quarantine)],
                     "segment_blocks": seg_len,
                 },
             )
@@ -474,9 +525,11 @@ def train_gossip(
         "byzantine": sorted(byz),
         "replica_healthy": [bool(h) for h in healthy_final],
         "gossip_round": round_idx,
-        # the LIVE exclusion mask (non-zero when a trailing unmixed
-        # segment accrued rollbacks): resume must carry it so the
-        # quarantined replica still sits out its next mix
-        "excluded_mask": [int(x) for x in excluded],
+        # the LIVE exclusion mask (one-round scratch ∪ sticky
+        # quarantine): resume must carry it so an excluded/quarantined
+        # replica still sits out its next mix
+        "excluded_mask": [int(x) for x in (excluded | quarantine)],
+        "readmit_after": readmit_after,
+        "quarantined": [int(x) for x in quarantine],
     }
     return states, df

@@ -47,18 +47,39 @@ construction (and still pinned leaf-for-leaf in tests/test_pipeline.py
 and ci_tier1.sh as the regression net), so the synchronous trainer
 remains the trusted baseline every pipelined arm is judged against.
 
-**Guard semantics at depth > 0.** The per-block guard is LEARNER-side:
-a non-finite learner output rolls back and retries with a perturbed
-update key (the rollout batch already exists and is not re-drawn), then
-skips; the publisher additionally validates every publish candidate,
-and a skipped block publishes NOTHING (the rolled-back tree is what the
-actor already acts on), so a poisoned learner can never reach the
-acting tier and skips lengthen the measured staleness instead of
-silently resetting it. After a skip the in-flight dispatch chain stays
-unperturbed (later rollouts are already queued on it) while the STORED
-key folds exactly like the synchronous skip, so a checkpoint taken at a
-skipped block never replays the failing draws on resume — the depth-0
-arm keeps the synchronous skip semantics exactly.
+**Guard semantics at depth > 0.** The guard is two-sided, keyed on
+WHERE the poison lives:
+
+- **Poisoned learner output** (finite rollout window, non-finite
+  update): roll back and retry with a perturbed update key — the
+  rollout batch is kept, because a different update draw can genuinely
+  succeed against the same window — then skip.
+- **Poisoned rollout window** (the actor tier delivered a non-finite
+  batch/metrics): retrying the UPDATE against it is structurally
+  futile — no ``k_upd`` perturbation can launder NaN inputs — so the
+  guard SKIPS-AND-REDRAWS instead: re-dispatch the actor block with a
+  per-attempt folded rollout key (deterministic in ``(key, block,
+  attempt)``, a dedicated stream off the block's roll key), up to
+  ``max_retries`` times, against the CURRENT published params; if every
+  redraw is still poisoned, the block is skipped without ever paying a
+  learner launch. Historically the learner retry loop burned its whole
+  budget of ~s epochs re-consuming the same poisoned window — the
+  chaos campaign's ``pipeline_window`` cells pin the fixed behavior.
+
+Either way the publisher validates every candidate, and a skipped block
+publishes NOTHING (the rolled-back tree is what the actor already acts
+on), so a poisoned tier can never reach the acting side and skips
+lengthen the measured staleness instead of silently resetting it. After
+a skip the in-flight dispatch chain stays unperturbed (later rollouts
+are already queued on it) while the STORED key folds exactly like the
+synchronous skip, so a checkpoint taken at a skipped block never
+replays the failing draws on resume — the depth-0 arm keeps the
+synchronous skip semantics exactly.
+
+``window_fault`` is the chaos-injection seam (:mod:`rcmarl_tpu.chaos`):
+a callable applied to every window the learner picks up — dispatches
+AND redraws — modeling an actor tier that delivers poisoned (or,
+equivalently, dropped) rollout windows in transit between the tiers.
 """
 
 from __future__ import annotations
@@ -167,6 +188,40 @@ def pipeline_summary(attrs: dict) -> str:
     )
 
 
+def _window_healthy(fresh, m) -> bool:
+    """Host bool: the actor-tier rollout window (batch + metrics) is
+    fully finite — the learner-side pickup guard. A poisoned window
+    fails here BEFORE any learner launch is paid (the update retry
+    cannot succeed against non-finite inputs)."""
+    from rcmarl_tpu.faults import tree_all_finite
+
+    return bool(tree_all_finite((fresh, m)))
+
+
+#: fold_in tag deriving the window-REDRAW rollout-key stream from the
+#: block's chain key — a dedicated stream (distinct from the learner
+#: retry's bare fold_in(chain, attempt) update keys), so redraw and
+#: retry draws can never collide.
+_REDRAW_STREAM = 0x5EED
+
+#: the synchronous skip's stored-key fold tag (training/trainer.py's
+#: protocol, shared verbatim so checkpoint-resume semantics match).
+_SKIP_STREAM = 0x5C1B
+
+
+def _skip_stored_key(state: TrainState, b: int) -> TrainState:
+    """The skip protocol's stored-state update, shared by the
+    window-skip and learner-skip paths (exactly ONE fires per block):
+    fold the STORED key like the synchronous skip and advance the block
+    counter — a checkpoint taken at a skipped block never replays the
+    failing draws on resume, while the in-flight dispatch chain stays
+    unperturbed."""
+    return state._replace(
+        key=jax.random.fold_in(state.key, _SKIP_STREAM + b),
+        block=state.block + 1,
+    )
+
+
 def train_pipelined(
     cfg: Config,
     n_episodes: Optional[int] = None,
@@ -175,6 +230,7 @@ def train_pipelined(
     block_callback=None,
     guard: Optional[bool] = None,
     max_retries: int = 1,
+    window_fault=None,
 ):
     """Host-looped pipelined training run (see module docstring).
 
@@ -186,6 +242,15 @@ def train_pipelined(
     the synchronous-handoff reference arm, bitwise the synchronous
     trainer; ``verbose`` adds host fetches per block (quiet runs keep
     the pipeline free-running).
+
+    ``window_fault`` (depth > 0 only) is the chaos-injection seam:
+    ``window_fault(block, attempt, fresh, metrics) -> (fresh, metrics)``
+    applied to every window the learner picks up — the first dispatch
+    is ``attempt=0``, guard redraws count up from 1 — so the chaos
+    campaign can model an actor tier delivering poisoned/dropped
+    rollout windows (:mod:`rcmarl_tpu.chaos`); guarded runs then
+    exercise the skip-and-redraw path for real. ``df.attrs['guard']``
+    grows a ``redraws`` counter next to the synchronous stats.
     """
     n_eps = cfg.n_episodes if n_episodes is None else n_episodes
     if n_eps % cfg.n_ep_fixed != 0:
@@ -202,6 +267,12 @@ def train_pipelined(
     with_diag = cfg.fault_plan is not None and cfg.fault_plan.active
 
     if depth == 0:
+        if window_fault is not None:
+            raise ValueError(
+                "window_fault is the decoupled tiers' transit seam; "
+                "the depth-0 synchronous handoff has no actor->learner "
+                "transit to fault (run pipeline_depth >= 1)"
+            )
         # ---- synchronous handoff IS the synchronous trainer: delegate,
         # so the depth-0 reference arm is bitwise by CONSTRUCTION, not
         # by a hand-maintained twin loop (publish accounting is
@@ -234,7 +305,10 @@ def train_pipelined(
         # one-time copy so the caller's resume state stays alive (the
         # synchronous trainer's exact policy)
         state = jax.tree.map(jnp.copy, state)
-    stats = {"retries": 0, "skipped": 0, "nonfinite": 0, "deficit": 0}
+    stats = {
+        "retries": 0, "redraws": 0, "skipped": 0, "nonfinite": 0,
+        "deficit": 0,
+    }
     all_metrics = []
     staleness = []
 
@@ -285,54 +359,88 @@ def train_pipelined(
     for b in range(n_blocks):
         j, fresh, m = queue.get()
         assert j == b, f"pipeline order broke: got block {j} at {b}"
+        if window_fault is not None:
+            fresh, m = window_fault(b, 0, fresh, m)
         _, k_upd = block_keys(b)
         new_key = chain[b + 1]
         attempt = 0
         accepted = True
-        while True:
-            if attempt:
-                # the synchronous retry discipline applied to the
-                # learner side: deterministic in (key, block,
-                # attempt), rollout batch kept as produced
-                k_upd = jax.random.fold_in(chain[b], attempt)
-            diag = None
-            if with_diag:
-                new_state, diag = learner(
-                    cfg, state, fresh, k_upd, new_key, with_diag=True
-                )
-            else:
-                new_state = learner(cfg, state, fresh, k_upd, new_key)
-            if not guard or _block_healthy(new_state, m):
-                state = new_state
-                break
-            if attempt < max_retries:
-                attempt += 1
-                stats["retries"] += 1
+        diag = None
+        # ---- window pickup guard: a non-finite rollout window makes
+        # every learner retry structurally futile (the batch would be
+        # kept) — redraw the WINDOW instead, fresh actor launches under
+        # per-attempt folded roll keys against the current published
+        # params, then skip the block without paying a learner launch.
+        window_ok = True
+        if guard:
+            window_ok = _window_healthy(fresh, m)
+            redraw = 0
+            while not window_ok and redraw < max_retries:
+                redraw += 1
+                stats["redraws"] += 1
                 if verbose:
                     print(
-                        f"| Block {b + 1} | non-finite learner "
-                        f"output — rolling back (retry "
-                        f"{attempt}/{max_retries})"
+                        f"| Block {b + 1} | non-finite rollout window "
+                        f"— redrawing (redraw {redraw}/{max_retries})"
                     )
-                continue
+                k_roll = jax.random.fold_in(
+                    jax.random.fold_in(chain[b], _REDRAW_STREAM), redraw
+                )
+                fresh, m = actor_block(
+                    cfg, publisher.acting, desired0, k_roll, initial0
+                )
+                if window_fault is not None:
+                    fresh, m = window_fault(b, redraw, fresh, m)
+                window_ok = _window_healthy(fresh, m)
+        if not window_ok:
             stats["skipped"] += 1
             if verbose:
                 print(
-                    f"| Block {b + 1} | still non-finite after "
-                    f"{max_retries} retries — skipping (params "
-                    "rolled back)"
+                    f"| Block {b + 1} | rollout window still "
+                    f"non-finite after {max_retries} redraws — "
+                    "skipping (no learner launch, nothing published)"
                 )
-            # The in-flight dispatch chain stays unperturbed (later
-            # rollouts are already queued on it), but the STORED key
-            # folds exactly like the synchronous skip — a checkpoint
-            # taken at this state must not make a resumed run replay
-            # the failing block's draws forever.
-            state = state._replace(
-                key=jax.random.fold_in(state.key, 0x5C1B + b),
-                block=state.block + 1,
-            )
+            state = _skip_stored_key(state, b)
             accepted = False
-            break
+        else:
+            while True:
+                if attempt:
+                    # the synchronous retry discipline applied to the
+                    # learner side: deterministic in (key, block,
+                    # attempt), rollout batch kept as produced — the
+                    # window is finite here, so a fresh update draw can
+                    # genuinely succeed against it
+                    k_upd = jax.random.fold_in(chain[b], attempt)
+                diag = None
+                if with_diag:
+                    new_state, diag = learner(
+                        cfg, state, fresh, k_upd, new_key, with_diag=True
+                    )
+                else:
+                    new_state = learner(cfg, state, fresh, k_upd, new_key)
+                if not guard or _block_healthy(new_state, m):
+                    state = new_state
+                    break
+                if attempt < max_retries:
+                    attempt += 1
+                    stats["retries"] += 1
+                    if verbose:
+                        print(
+                            f"| Block {b + 1} | non-finite learner "
+                            f"output — rolling back (retry "
+                            f"{attempt}/{max_retries})"
+                        )
+                    continue
+                stats["skipped"] += 1
+                if verbose:
+                    print(
+                        f"| Block {b + 1} | still non-finite after "
+                        f"{max_retries} retries — skipping (params "
+                        "rolled back)"
+                    )
+                state = _skip_stored_key(state, b)
+                accepted = False
+                break
         if diag is not None:
             stats["nonfinite"] += int(diag.nonfinite)
             stats["deficit"] += int(diag.deficit)

@@ -23,6 +23,19 @@ that harness:
   from ``service_fn(fill)`` — a REAL measured launch on the serving
   path, or an injected model in the unit tests — and the report carries
   the latency percentiles, queue depth, fill, and utilization.
+- **Deadline shedding** (``shed_after``): past the saturation knee a
+  shed-free queue's latency is unbounded backlog — every request is
+  eventually served, arbitrarily late. With ``shed_after`` set, a
+  request whose queue wait already exceeds the deadline when the server
+  frees is SHED (dropped unserved, counted) instead of dragging the
+  percentiles into the backlog: a served request's latency is then
+  bounded by ``shed_after + max_wait + service``, so p99 stays pinned
+  near the knee-point p99 at ANY offered load, and the cost is an
+  explicit ``shed_fraction`` on the row instead of a hidden latency
+  cliff (the graceful-degradation trade the chaos campaign's overload
+  cells gate, ``rcmarl_tpu.chaos``). ``shed_after=inf`` (the default)
+  is bitwise the historical shed-free queue; every report row carries
+  ``shed``/``shed_fraction`` either way.
 - :func:`sweep_load` / :func:`saturation_knee` — the offered-load sweep
   and the knee extraction: the highest swept load whose p99 stays
   inside ``knee_factor`` x the lightest load's p99 with the server
@@ -100,6 +113,7 @@ def run_load(
     arrivals: np.ndarray,
     max_batch: int,
     max_wait: float,
+    shed_after: float = math.inf,
 ) -> Dict[str, float]:
     """Run one arrival plan through the single-server micro-batching
     queue; returns the latency/queue report.
@@ -113,26 +127,52 @@ def run_load(
     seconds one launch of the padded ``max_batch`` program takes with
     ``fill`` real requests; request latency = completion - arrival.
 
-    Report keys: ``p50/p95/p99`` latency (seconds), ``mean_latency``,
-    ``launches``, ``fill_mean`` (real requests per launch),
-    ``queue_depth_mean``/``queue_depth_max`` (waiting requests at each
-    close, incl. beyond ``max_batch``), ``utilization`` (service busy
-    fraction of the makespan), ``service_mean`` (seconds/launch).
+    Shed rule (``shed_after < inf``): each time the server frees,
+    waiting requests whose queue wait already exceeds ``shed_after``
+    are dropped head-of-line WITHOUT service (counted, never billed a
+    latency). Every SERVED request's queue wait at batch close is then
+    at most ``shed_after + max_wait``, so latency stays bounded by
+    ``shed_after + max_wait + service`` at any offered load — the
+    backlog turns into an explicit shed fraction instead of an
+    unbounded p99. ``shed_after=inf`` (default) is bitwise the
+    historical shed-free queue.
+
+    Report keys: ``p50/p95/p99`` latency (seconds, over SERVED
+    requests), ``mean_latency``, ``launches``, ``fill_mean`` (real
+    requests per launch), ``queue_depth_mean``/``queue_depth_max``
+    (waiting requests at each close, incl. beyond ``max_batch``),
+    ``utilization`` (service busy fraction of the makespan),
+    ``service_mean`` (seconds/launch), ``served``/``shed``/
+    ``shed_fraction`` (the deadline-shedding ledger — present on EVERY
+    row, 0.0 when shedding is off or never fires).
     """
     if max_batch < 1:
         raise ValueError(f"max_batch={max_batch} must be >= 1")
     if max_wait < 0.0:
         raise ValueError(f"max_wait={max_wait} must be >= 0")
+    if not shed_after > 0.0:
+        raise ValueError(f"shed_after={shed_after} must be > 0")
     arrivals = np.asarray(arrivals, dtype=np.float64)
     n = arrivals.shape[0]
-    lat = np.empty(n, dtype=np.float64)
+    lat = np.full(n, np.nan, dtype=np.float64)
     i = 0
     t = 0.0
     busy = 0.0
+    shed = 0
     fills: List[int] = []
     depths: List[int] = []
     services: List[float] = []
     while i < n:
+        if math.isfinite(shed_after):
+            # head-of-line deadline drop at server-free time: a request
+            # that has already waited past its deadline is hopeless —
+            # serving it would only push every later request further
+            # past the knee
+            while i < n and arrivals[i] <= t and t - arrivals[i] > shed_after:
+                shed += 1
+                i += 1
+            if i >= n:
+                break
         open_t = max(t, float(arrivals[i]))
         fill_t = (
             float(arrivals[i + max_batch - 1])
@@ -156,20 +196,30 @@ def run_load(
         fills.append(fill)
         t = close_t + s
         i = j
+    served = lat[~np.isnan(lat)]
+    if served.size == 0:
+        raise ValueError(
+            f"run_load shed every request (shed_after={shed_after}): the "
+            "deadline is shorter than one service time — no latency to "
+            "report"
+        )
     makespan = t - float(arrivals[0])
-    p50, p95, p99 = np.percentile(lat, [50.0, 95.0, 99.0])
+    p50, p95, p99 = np.percentile(served, [50.0, 95.0, 99.0])
     return {
         "requests": int(n),
         "p50": float(p50),
         "p95": float(p95),
         "p99": float(p99),
-        "mean_latency": float(lat.mean()),
+        "mean_latency": float(served.mean()),
         "launches": len(fills),
         "fill_mean": float(np.mean(fills)),
         "queue_depth_mean": float(np.mean(depths)),
         "queue_depth_max": int(np.max(depths)),
         "utilization": float(busy / makespan) if makespan > 0 else 1.0,
         "service_mean": float(np.mean(services)),
+        "served": int(served.size),
+        "shed": int(shed),
+        "shed_fraction": float(shed / n),
     }
 
 
@@ -182,12 +232,14 @@ def sweep_load(
     seed: int = 0,
     arrival: str = "poisson",
     burst: int = 8,
+    shed_after: float = math.inf,
 ) -> List[Dict[str, float]]:
     """One :func:`run_load` report per offered load (requests/s), each
     tagged with its ``offered_load`` and arrival process — the
     latency-vs-load curve ``bench.py --serve_load`` emits. The SAME
     seed namespaces every point, so the sweep is replayable end to
-    end."""
+    end; ``shed_after`` applies the deadline-shedding rule at every
+    point (the shed fraction rides each row)."""
     if arrival not in ("poisson", "bursty"):
         raise ValueError(
             f"arrival={arrival!r}: expected 'poisson' or 'bursty'"
@@ -199,7 +251,7 @@ def sweep_load(
             if arrival == "poisson"
             else bursty_arrivals(seed, n_requests, load, burst)
         )
-        rep = run_load(service_fn, arr, max_batch, max_wait)
+        rep = run_load(service_fn, arr, max_batch, max_wait, shed_after)
         rep["offered_load"] = float(load)
         rep["arrival"] = arrival
         points.append(rep)

@@ -458,6 +458,23 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
     --adaptive_scale 100 --summary_dir "$smoke_dir" --quiet
 echo "adaptive-adversary smoke cell OK"
 
+# Chaos smoke cell: a representative slice of the chaos campaign
+# through the real CLI, gated against the committed RESILIENCE.jsonl —
+# one transport cell (NaN bombs at the high rate, sanitize+guard), the
+# double-corruption checkpoint cell (primary AND .prev in one poll
+# cycle -> reject+serve-last-good), the poisoned-rollout-window
+# pipeline cell (bounded redraws then skip, nothing published), and
+# BOTH serving overload arms (the deadline-shedding acceptance
+# criterion: shed p99 within 2x the knee-point p99, no-shed past it).
+# A cell that previously survived and now fails — or whose degradation
+# envelope widened past tolerance — exits nonzero here; the FULL
+# campaign rides ci.yml's chaos job (outside the tier-1 wall budget,
+# the PR-8/PR-9 shedding pattern).
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m rcmarl_tpu chaos \
+    --check --baseline RESILIENCE.jsonl \
+    --cells link_nan@0.5 ckpt_bitflip@both pipeline_window serve_overload
+echo "chaos smoke cell OK"
+
 # graftlint cell: the AST passes over the installed package (zero
 # findings is the contract — rcmarl_tpu.lint) plus the retrace audit
 # (tiny guarded+faulted 2-block trains on both netstack arms + a clean

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Gossip readmission experiment — the flapping-sender QUALITY evidence.
+
+A probabilistic agent-level NaN plan WITHOUT sanitize flaps individual
+gossip replicas unhealthy segment by segment (each replica draws its
+own fault pattern, so some segments poison it and some don't — the
+flapping sender the one-round PR-7 exclusion re-admits the moment its
+luck turns). Three arms over the SAME fault draws:
+
+- ``readmit0``  — the legacy one-round exclusion (PR-7 behavior): a
+  rolled-back replica sits out exactly one mix.
+- ``readmitK``  — the sticky quarantine (``train_gossip
+  (readmit_after=K)``): an excluded replica must prove K consecutive
+  healthy probe rounds before re-entering the mix; the experiment
+  demonstrates excluded -> readmitted -> healthy-envelope-holds.
+- ``clean``     — the no-fault control pinning the quality band.
+
+Verdict (rc=0): in the readmission arm at least one replica is
+quarantined AND later readmitted, every replica ends finite, and the
+flapping arms' final returns hold the clean arm's band.
+
+Artifacts:
+  --json_out   committed: simulation_results/gossip_readmission.json —
+               QUALITY.md's "Gossip readmission" section renders from
+               this file (analysis/quality.py:gossip_readmission_section)
+
+Usage (the committed evidence was generated with the defaults):
+  JAX_PLATFORMS=cpu python scripts/gossip_readmission.py \
+      --json_out simulation_results/gossip_readmission.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: Quality band vs the clean control (the PARITY.md tolerance).
+BAND_TOL = 0.05
+
+
+def build_cfg(args, faulted: bool):
+    from rcmarl_tpu.config import Config
+    from rcmarl_tpu.faults import FaultPlan
+
+    return Config(
+        n_episodes=args.n_episodes,
+        n_ep_fixed=args.n_ep_fixed,
+        replicas=args.replicas,
+        gossip_graph="full",
+        gossip_H=args.gossip_H,
+        gossip_every=args.gossip_every,
+        fault_plan=FaultPlan(nan_p=args.nan_p) if faulted else None,
+        slow_lr=0.002,
+    )
+
+
+def run_arm(args, label: str, faulted: bool, readmit_after: int) -> dict:
+    import numpy as np
+
+    from rcmarl_tpu.parallel.gossip import train_gossip
+
+    cfg = build_cfg(args, faulted)
+    t0 = time.perf_counter()
+    states, df = train_gossip(cfg, readmit_after=readmit_after)
+    dt = time.perf_counter() - t0
+    g = df.attrs["gossip"]
+    ret = np.asarray(df["True_team_returns"], float)
+    w = min(100, len(ret) // 4)
+    final = float(np.nanmean(ret[-w:]))
+    return {
+        "label": label,
+        "readmit_after": readmit_after,
+        "faulted": faulted,
+        "rounds": g["rounds"],
+        "rollbacks": g["rollbacks"],
+        "excluded_replica_rounds": g["excluded"],
+        "readmitted": g["readmitted"],
+        "quarantined_final": g["quarantined"],
+        "replica_healthy": g["replica_healthy"],
+        "nonfinite_payload_entries": g["nonfinite"],
+        "final_return": None if np.isnan(final) else round(final, 4),
+        "window_episodes": w,
+        "wall_seconds": round(dt, 1),
+    }
+
+
+def main() -> int:
+    import jax
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--replicas", type=int, default=4)
+    p.add_argument("--gossip_H", type=int, default=1)
+    p.add_argument("--gossip_every", type=int, default=1)
+    p.add_argument("--n_episodes", type=int, default=600)
+    p.add_argument("--n_ep_fixed", type=int, default=20)
+    p.add_argument(
+        "--nan_p", type=float, default=0.002,
+        help="agent-level per-link NaN rate (no sanitize): tuned so "
+        "replicas FLAP — poisoned some segments, clean others",
+    )
+    p.add_argument("--readmit_after", type=int, default=2)
+    p.add_argument("--json_out", type=str, default=None)
+    args = p.parse_args()
+
+    arms = [
+        run_arm(args, "clean", False, 0),
+        run_arm(args, "readmit0 (legacy one-round)", True, 0),
+        run_arm(
+            args, f"readmit{args.readmit_after} (sticky quarantine)",
+            True, args.readmit_after,
+        ),
+    ]
+    clean = arms[0]["final_return"]
+    for a in arms:
+        a["within_band"] = (
+            a["final_return"] is not None
+            and clean is not None
+            and abs(a["final_return"] - clean) <= BAND_TOL * abs(clean)
+        )
+        print(json.dumps(a))
+
+    sticky = arms[2]
+    flapped = sticky["rollbacks"] > 0
+    readmitted = sticky["readmitted"] > 0
+    all_finite = all(a["replica_healthy"] == [True] * args.replicas
+                     for a in arms)
+    band_holds = all(a["within_band"] for a in arms)
+    out = {
+        "generated_by": "python scripts/gossip_readmission.py",
+        "config": {
+            "replicas": args.replicas,
+            "gossip_H": args.gossip_H,
+            "gossip_every": args.gossip_every,
+            "gossip_graph": "full",
+            "n_episodes": args.n_episodes,
+            "n_ep_fixed": args.n_ep_fixed,
+            "nan_p": args.nan_p,
+            "readmit_after": args.readmit_after,
+            "tol": BAND_TOL,
+        },
+        "arms": arms,
+        "verdict": {
+            "flapped": flapped,
+            "readmitted": readmitted,
+            "all_replicas_finite": all_finite,
+            "band_holds": band_holds,
+        },
+        "platform": jax.devices()[0].platform,
+    }
+    if args.json_out:
+        path = Path(args.json_out)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(out, indent=1) + "\n")
+        print(f"wrote {path}", file=sys.stderr)
+    print(
+        f"verdict: flapped={flapped} readmitted={readmitted} "
+        f"finite={all_finite} band={band_holds}",
+        file=sys.stderr,
+    )
+    return 0 if (flapped and readmitted and all_finite and band_holds) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -173,6 +173,19 @@ PY
                 "$d"/policy_v3.npz "$d"/policy_v4.npz \
         --batch 4096 --steps 30 --reps 3 --out BENCH_SERVE.jsonl'
 
+# The chaos campaign (PR 15): the committed RESILIENCE.jsonl was
+# generated on the CPU host (every cell deterministic there). (11)
+# re-runs the FULL campaign on-chip: outcomes must hold — a cell that
+# survived on CPU failing on TPU is a real platform finding, and a
+# widened degradation envelope is reported with the fresh rows in
+# RESILIENCE.jsonl.new. If the on-chip deltas are legitimate (e.g.
+# different launch costs moving a tiny return inside the generous
+# band), regenerate with `chaos --run` and commit the refreshed ledger
+# alongside the session's other artifacts.
+run_step "11. chaos campaign on-chip refit (chaos --check)" \
+    timeout 1800 python -m rcmarl_tpu chaos --check \
+    --baseline RESILIENCE.jsonl
+
 echo "== session summary =="
 rc=0
 for name in "${step_order[@]}"; do

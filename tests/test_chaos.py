@@ -1,0 +1,349 @@
+"""Chaos campaign contracts (rcmarl_tpu.chaos).
+
+Tier-1 pins the cheap layers: registry integrity (every point named,
+cells unique, the acceptance floor of >= 15 cells across >= 4
+subsystems), the --cells selector, the ledger's canonical byte-stable
+IO, the compare gate's full finding matrix on synthetic rows
+(regression / envelope / unbaselined / stale / improvement-note /
+subset semantics), per-cell fault isolation, and the REAL numpy-only
+cells (overload + publish poisoning) through the actual CLI check.
+
+The planted-regression run (disable the sanitize fallback + guard, a
+survived transport cell must flip to failed and the check to rc != 0 —
+the lint-suite discipline) and the committed-ledger spot check ride the
+slow marker: they pay real tiny trains.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from rcmarl_tpu.chaos.campaign import (
+    _select_cells,
+    check_campaign,
+    compare_rows,
+    read_resilience,
+    run_cell,
+    write_resilience,
+)
+from rcmarl_tpu.chaos.registry import (
+    CHAOS_POINTS,
+    OUTCOMES,
+    CellFailed,
+    point_by_name,
+    registry_cells,
+)
+
+REPO_LEDGER = Path(__file__).resolve().parent.parent / "RESILIENCE.jsonl"
+
+
+def _row(point="link_nan", intensity="0.5", outcome="survived",
+         expected=None, delta=None, **over):
+    pt = point_by_name(point)
+    base = {
+        "kind": "chaos",
+        "point": point,
+        "subsystem": pt.subsystem if pt else "transport",
+        "intensity": intensity,
+        "expected": expected
+        if expected is not None
+        else (dict(pt.cells).get(intensity, "survived") if pt else "survived"),
+        "outcome": outcome,
+        "counters": {},
+        "final_return": None,
+        "clean_return": None,
+        "return_delta": delta,
+        "detail": "synthetic",
+    }
+    base.update(over)
+    return base
+
+
+class TestRegistry:
+    def test_points_named_and_unique(self):
+        names = [p.name for p in CHAOS_POINTS]
+        assert len(names) == len(set(names))
+        for p in CHAOS_POINTS:
+            assert p.cells, p.name
+            assert p.guard and p.test_pin and p.injector, p.name
+            for _, expected in p.cells:
+                assert expected in OUTCOMES, (p.name, expected)
+
+    def test_acceptance_floor_cells_and_subsystems(self):
+        """The acceptance criteria's floor: >= 15 campaign cells
+        spanning >= 4 of the named subsystems."""
+        cells = registry_cells()
+        assert len(cells) == len(set(cells))
+        assert len(cells) >= 15
+        subsystems = {p.subsystem for p in CHAOS_POINTS}
+        named = {"transport", "gossip", "checkpoint", "publish",
+                 "pipeline", "serving"}
+        assert len(subsystems & named) >= 4
+
+    def test_selector_resolves_points_and_cells(self):
+        assert _select_cells(None) == list(registry_cells())
+        assert _select_cells(["link_nan@0.5"]) == [("link_nan", "0.5")]
+        both = _select_cells(["serve_overload"])
+        assert set(both) == {("serve_overload", "noshed"),
+                             ("serve_overload", "shed")}
+        with pytest.raises(ValueError, match="matches no registry cell"):
+            _select_cells(["no_such_point"])
+        with pytest.raises(ValueError, match="matches no registry cell"):
+            _select_cells(["link_nan@0.99"])
+
+    def test_run_cell_rejects_unknown(self):
+        with pytest.raises(ValueError, match="unknown chaos point"):
+            run_cell("no_such_point", "x")
+        with pytest.raises(ValueError, match="no intensity"):
+            run_cell("link_nan", "0.99")
+
+
+class TestLedgerIO:
+    def test_roundtrip_and_byte_stability(self, tmp_path):
+        rows = [_row(), _row("serve_overload", "shed", "survived")]
+        p = tmp_path / "RESILIENCE.jsonl"
+        write_resilience(p, rows)
+        first = p.read_bytes()
+        loaded = read_resilience(p)
+        assert len(loaded) == 2
+        write_resilience(p, loaded)
+        assert p.read_bytes() == first  # canonical: rewrite is a no-op
+        # canonical order: sorted by (subsystem, point, intensity)
+        assert [r["subsystem"] for r in loaded] == sorted(
+            r["subsystem"] for r in loaded
+        )
+
+    def test_missing_ledger_reads_empty(self, tmp_path):
+        assert read_resilience(tmp_path / "absent.jsonl") == []
+
+
+class TestCompareGate:
+    def test_outcome_regression_is_a_finding(self):
+        for before, after in (("survived", "degraded"),
+                              ("survived", "failed"),
+                              ("degraded", "failed")):
+            findings, _ = compare_rows(
+                [_row(outcome=before)], [_row(outcome=after)]
+            )
+            assert len(findings) == 1 and "chaos-regression" in findings[0]
+
+    def test_improvement_is_a_note_not_a_finding(self):
+        findings, notes = compare_rows(
+            [_row(outcome="degraded")], [_row(outcome="survived")]
+        )
+        assert findings == []
+        assert any("unclaimed win" in n for n in notes)
+
+    def test_envelope_widening_is_a_finding(self):
+        findings, _ = compare_rows(
+            [_row(delta=-0.1)], [_row(delta=-0.5)]
+        )
+        assert len(findings) == 1 and "chaos-envelope" in findings[0]
+        # within tolerance: clean
+        findings, _ = compare_rows(
+            [_row(delta=-0.1)], [_row(delta=-0.2)]
+        )
+        assert findings == []
+        # NARROWING is never a finding
+        findings, _ = compare_rows(
+            [_row(delta=-0.5)], [_row(delta=-0.1)]
+        )
+        assert findings == []
+
+    def test_unbaselined_and_stale(self):
+        findings, _ = compare_rows([], [_row()])
+        assert len(findings) == 1 and "chaos-unbaselined" in findings[0]
+        # a committed row naming no registry cell is stale on FULL checks
+        ghost = _row(point="retired_point", expected="survived")
+        findings, _ = compare_rows([ghost], [])
+        assert len(findings) == 1 and "chaos-stale" in findings[0]
+        # ...but a --cells subset judges only what it ran
+        findings, _ = compare_rows(
+            [ghost, _row()], [_row()], checked=[("link_nan", "0.5")]
+        )
+        assert findings == []
+
+    def test_expectation_drift_is_unbaselined(self):
+        findings, _ = compare_rows(
+            [_row(expected="degraded")], [_row(expected="survived")]
+        )
+        assert len(findings) == 1 and "chaos-unbaselined" in findings[0]
+
+    def test_cell_isolation_records_failed(self):
+        def boom(intensity):
+            raise RuntimeError("injected crash")
+
+        row = run_cell("link_nan", "0.5", runner=boom)
+        assert row["outcome"] == "failed"
+        assert "injected crash" in row["detail"]
+
+        def contract(intensity):
+            raise CellFailed("guard contract broke")
+
+        row = run_cell("link_nan", "0.5", runner=contract)
+        assert row["outcome"] == "failed"
+        assert "containment contract violated" in row["detail"]
+
+
+class TestRealCellsThroughCLI:
+    """The numpy-only cells (micro-batching overload, publisher
+    poisoning) through the REAL `chaos` CLI — cheap enough for tier-1,
+    and they pin the deadline-shedding acceptance criterion (p99 within
+    2x the knee-point p99 with the shed fraction ledgered)."""
+
+    CELLS = ["serve_overload", "publish_poison"]
+
+    def test_run_then_check_rc0_then_planted_ledger_flip(self, tmp_path):
+        from rcmarl_tpu.cli import main
+
+        ledger = tmp_path / "RESILIENCE.jsonl"
+        assert main(
+            ["chaos", "--run", "--baseline", str(ledger), "--cells"]
+            + self.CELLS
+        ) == 0
+        rows = read_resilience(ledger)
+        assert {(r["point"], r["intensity"]) for r in rows} == {
+            ("serve_overload", "noshed"), ("serve_overload", "shed"),
+            ("publish_poison", "nan"),
+        }
+        shed = next(r for r in rows if r["intensity"] == "shed")
+        assert shed["outcome"] == "survived"
+        assert shed["counters"]["shed_fraction"] > 0
+        assert (
+            shed["counters"]["p99_ms"] <= 2.0 * shed["counters"]["knee_p99_ms"]
+        )
+        noshed = next(r for r in rows if r["intensity"] == "noshed")
+        assert noshed["outcome"] == "degraded"
+        assert (
+            noshed["counters"]["p99_ms"]
+            > 2.0 * noshed["counters"]["knee_p99_ms"]
+        )
+        # a fresh check against what we just wrote is clean
+        assert main(
+            ["chaos", "--check", "--baseline", str(ledger), "--cells"]
+            + self.CELLS
+        ) == 0
+        # plant a ledger that claims the no-shed arm survived: the real
+        # (degraded) outcome is now a regression and the check fails
+        doctored = [
+            dict(r, outcome="survived") if r["intensity"] == "noshed" else r
+            for r in rows
+        ]
+        write_resilience(ledger, doctored)
+        assert main(
+            ["chaos", "--check", "--baseline", str(ledger), "--cells"]
+            + self.CELLS
+        ) == 1
+        # the fresh rows landed next to the baseline for the diff
+        assert (tmp_path / "RESILIENCE.jsonl.new").exists()
+
+    def test_run_drops_rows_of_retired_registry_cells(self, tmp_path):
+        """`chaos --run` is the documented remedy for chaos-stale: a
+        committed row naming no registry cell must be DROPPED by the
+        regenerate (keeping it would leave the check permanently red),
+        while rows of real cells outside the --cells subset are kept."""
+        from rcmarl_tpu.cli import main
+
+        ledger = tmp_path / "RESILIENCE.jsonl"
+        ghost = _row(point="retired_point", expected="survived")
+        kept_real = _row()  # link_nan@0.5: a registry cell, not re-run
+        write_resilience(ledger, [ghost, kept_real])
+        assert main(
+            ["chaos", "--run", "--baseline", str(ledger), "--cells",
+             "publish_poison"]
+        ) == 0
+        cells = {(r["point"], r["intensity"])
+                 for r in read_resilience(ledger)}
+        assert ("retired_point", "0.5") not in cells
+        assert ("link_nan", "0.5") in cells
+        assert ("publish_poison", "nan") in cells
+
+    def test_check_without_ledger_is_unbaselined(self, tmp_path, capsys):
+        from rcmarl_tpu.cli import main
+
+        rc = main(
+            ["chaos", "--check", "--baseline",
+             str(tmp_path / "absent.jsonl"), "--cells", "publish_poison"]
+        )
+        assert rc == 1
+        assert "chaos-unbaselined" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+class TestPlantedRegression:
+    """The lint-suite discipline on the resilience gate: sabotage the
+    defense for real (sanitize fallback AND guard rails disabled), and
+    the survived transport cell must flip to FAILED with the check
+    flipping to rc != 0."""
+
+    def test_disabling_sanitize_flips_cell_to_failed(self):
+        from rcmarl_tpu.chaos import registry
+        from rcmarl_tpu.faults import FaultPlan
+        from rcmarl_tpu.training.trainer import train
+
+        def sabotaged(intensity):
+            # the planted regression: the NaN-bomb plan runs WITHOUT
+            # the sanitize fallback and WITHOUT the guard rails —
+            # exactly the containment the survived cell certifies
+            cfg = registry._tiny(
+                n_episodes=registry._TRAIN_EPS,
+                fault_plan=FaultPlan(nan_p=float(intensity)),
+                consensus_sanitize=False,
+            )
+            state, df = train(
+                cfg, n_episodes=registry._TRAIN_EPS, guard=False
+            )
+            final = registry._final_return(df)
+            import math
+
+            return {
+                "outcome": (
+                    "survived" if registry._params_ok(state) else "failed"
+                ),
+                "counters": {},
+                "final_return": final if math.isfinite(final) else None,
+                "clean_return": registry._clean_train_return(
+                    cfg, registry._TRAIN_EPS
+                ),
+                "detail": "sabotaged: sanitize fallback + guard disabled",
+            }
+
+        fresh = run_cell("link_nan", "0.5", runner=sabotaged)
+        assert fresh["outcome"] == "failed"
+        committed = _row(outcome="survived")
+        findings, _ = compare_rows(
+            [committed], [fresh], checked=[("link_nan", "0.5")]
+        )
+        assert len(findings) == 1 and "chaos-regression" in findings[0]
+
+    def test_committed_ledger_spot_check(self, tmp_path):
+        """Two real cells re-run against the COMMITTED RESILIENCE.jsonl
+        must produce zero findings (the TestCommittedLedger pattern)."""
+        if not REPO_LEDGER.exists():
+            pytest.skip("no committed RESILIENCE.jsonl in this checkout")
+        findings, notes, fresh = check_campaign(
+            REPO_LEDGER, cells=["ckpt_bitflip@both", "serve_overload"]
+        )
+        assert findings == [], findings
+        assert len(fresh) == 3
+
+
+class TestCommittedLedgerShape:
+    def test_committed_rows_meet_the_acceptance_floor(self):
+        """The committed artifact itself: >= 15 cells, >= 4 subsystems,
+        every row canonical with a known outcome/expectation."""
+        if not REPO_LEDGER.exists():
+            pytest.skip("no committed RESILIENCE.jsonl in this checkout")
+        rows = read_resilience(REPO_LEDGER)
+        assert len(rows) >= 15
+        assert len({r["subsystem"] for r in rows}) >= 4
+        known = set(registry_cells())
+        for r in rows:
+            assert (r["point"], r["intensity"]) in known
+            assert r["outcome"] in OUTCOMES
+            assert r["expected"] in OUTCOMES
+            assert json.dumps(r, sort_keys=True)  # strict JSON

@@ -296,6 +296,94 @@ class TestFaultPlan:
         hash(cfg)  # jit-staticness requirement
 
 
+class TestPlanRoundTripEveryField:
+    """The finite contract behind every chaos knob: BOTH plan classes
+    must survive ``to_dict`` -> strict JSON -> rebuild with EVERY field
+    at a non-default value, through the raw dict AND the checkpoint-
+    header Config path (``config_from_json``). Field-introspective: a
+    NEW knob added to either plan fails here until this test (and the
+    checkpoint header it stands for) knows how to give it a non-default
+    — a knob that silently drops from headers can't ship."""
+
+    #: Non-default values per known non-probability field; every field
+    #: not listed here must be a [0,1] probability (asserted below).
+    _SPECIAL = {
+        "corrupt_scale": 2.5,
+        "seed": 7,
+        "byzantine_replicas": (1, 3),
+        "byzantine_mode": "sign_flip",
+    }
+    _PROBS = ("drop_p", "stale_p", "corrupt_p", "flip_p", "nan_p", "inf_p")
+
+    def _nondefault(self, cls):
+        import dataclasses
+
+        kw = {}
+        for i, f in enumerate(dataclasses.fields(cls)):
+            if f.name in self._SPECIAL:
+                kw[f.name] = self._SPECIAL[f.name]
+            elif f.name in self._PROBS:
+                kw[f.name] = round(0.01 * (i + 1), 3)
+            else:
+                pytest.fail(
+                    f"{cls.__name__}.{f.name} is a NEW chaos knob this "
+                    "round-trip test does not know: give it a "
+                    "non-default here AND make sure config_from_json "
+                    "rebuilds it (the checkpoint-header contract)"
+                )
+        return kw
+
+    @pytest.mark.parametrize(
+        "cls", [FaultPlan, None], ids=["FaultPlan", "ReplicaFaultPlan"]
+    )
+    def test_to_dict_json_rebuild_is_lossless(self, cls):
+        import dataclasses
+        import json as _json
+
+        from rcmarl_tpu.faults import ReplicaFaultPlan
+
+        cls = cls or ReplicaFaultPlan
+        plan = cls(**self._nondefault(cls))
+        d = _json.loads(_json.dumps(plan.to_dict()))  # strict JSON trip
+        if "byzantine_replicas" in d:
+            d["byzantine_replicas"] = tuple(d["byzantine_replicas"])
+        rebuilt = cls(**d)
+        assert rebuilt == plan
+        assert dataclasses.asdict(rebuilt) == dataclasses.asdict(plan)
+        # a DROPPED field would rebuild to its default and break
+        # equality — prove the probe values are all non-default
+        defaults = cls()
+        for f in dataclasses.fields(cls):
+            assert getattr(plan, f.name) != getattr(defaults, f.name), (
+                f"{cls.__name__}.{f.name} probe value equals the "
+                "default — the drop-detection has no teeth for it"
+            )
+
+    def test_config_header_roundtrip_both_plans(self):
+        from rcmarl_tpu.faults import ReplicaFaultPlan
+        from rcmarl_tpu.utils.checkpoint import (
+            _config_to_json,
+            config_from_json,
+        )
+
+        cfg = Config(
+            replicas=4,
+            gossip_every=1,
+            gossip_graph="full",
+            gossip_H=1,
+            n_agents=3,
+            agent_roles=(Roles.COOPERATIVE,) * 3,
+            in_nodes=circulant_in_nodes(3, 3),
+            nrow=3,
+            ncol=3,
+            fault_plan=FaultPlan(**self._nondefault(FaultPlan)),
+            replica_fault_plan=ReplicaFaultPlan(
+                **self._nondefault(ReplicaFaultPlan)
+            ),
+        )
+        assert config_from_json(_config_to_json(cfg)) == cfg
+
+
 class TestApplyLinkFaults:
     def _trees(self, key):
         N, n_in = 4, 3

@@ -262,6 +262,106 @@ class TestGossipTrain:
         assert not any(df.attrs["gossip"]["replica_healthy"][:3])
 
 
+class TestReadmission:
+    def test_negative_readmit_after_rejected(self):
+        with pytest.raises(ValueError, match="readmit_after"):
+            train_gossip(gossip_cfg(), n_episodes=2, readmit_after=-1)
+
+    @pytest.mark.slow
+    def test_readmit_zero_is_bitwise_the_legacy_path(self):
+        """readmit_after=0 (the default) pins bit-for-bit to the PR-7
+        one-round exclusion: on a CLEAN config the whole readmission
+        machinery must also be inert at any K (no guard events, so
+        quarantine/streak never move)."""
+        cfg = gossip_cfg()
+        s0, df0 = train_gossip(cfg, n_episodes=4, readmit_after=0)
+        s2, df2 = train_gossip(cfg, n_episodes=4, readmit_after=2)
+        for a, b in zip(jax.tree.leaves(s0), jax.tree.leaves(s2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        g0, g2 = df0.attrs["gossip"], df2.attrs["gossip"]
+        assert g0["readmitted"] == 0 and g2["readmitted"] == 0
+        assert g0["quarantined"] == [0] * 4
+        assert g0["readmit_after"] == 0 and g2["readmit_after"] == 2
+        np.testing.assert_array_equal(
+            df0["True_team_returns"].values, df2["True_team_returns"].values
+        )
+
+    @pytest.mark.slow
+    def test_flapping_replica_quarantined_then_readmitted(self, monkeypatch):
+        """Scripted health flapping (replica 3 unhealthy in segment 0
+        only): under readmit_after=2 it must sit out TWO mixes (the
+        quarantine is sticky across its first healthy probe round) and
+        re-enter at the third, with the readmission counted; under the
+        legacy readmit_after=0 the same script excludes it from exactly
+        ONE mix. The real-fault twin of this cell is the committed
+        gossip_flapping row in RESILIENCE.jsonl and the
+        gossip_readmission.json experiment."""
+        import rcmarl_tpu.training.trainer as trainer_mod
+
+        def scripted_health(calls):
+            healths = iter(calls)
+
+            def fake(states, metrics):
+                return np.asarray(next(healths), bool)
+
+            return fake
+
+        cfg = gossip_cfg()
+        script = [
+            [True, True, True, False],
+            [True, True, True, True],
+            [True, True, True, True],
+            [True, True, True, True],
+        ]
+        monkeypatch.setattr(
+            trainer_mod, "_replica_block_healthy", scripted_health(script)
+        )
+        _, df = train_gossip(cfg, n_episodes=8, guard=True, readmit_after=2)
+        g = df.attrs["gossip"]
+        # seg0: quarantined (excluded from mix 0); seg1: probe 1
+        # (still excluded from mix 1); seg2: probe 2 -> READMITTED
+        # before mix 2; seg3: fully back
+        assert g["rollbacks"] == 1
+        assert g["readmitted"] == 1
+        assert g["excluded"] == 2  # replica-rounds spent excluded
+        assert g["quarantined"] == [0] * 4
+
+        monkeypatch.setattr(
+            trainer_mod, "_replica_block_healthy", scripted_health(script)
+        )
+        _, df0 = train_gossip(cfg, n_episodes=8, guard=True, readmit_after=0)
+        g0 = df0.attrs["gossip"]
+        assert g0["rollbacks"] == 1
+        assert g0["readmitted"] == 0
+        assert g0["excluded"] == 1  # legacy: one mix, then back in
+
+    @pytest.mark.slow
+    def test_flap_resets_the_probe_streak(self, monkeypatch):
+        """A replica that flaps unhealthy again mid-probe must restart
+        its streak — the exact hole one-round exclusion leaves open."""
+        import rcmarl_tpu.training.trainer as trainer_mod
+
+        script = [
+            [True, True, True, False],  # quarantined
+            [True, True, True, True],   # probe 1
+            [True, True, True, False],  # flaps: streak resets
+            [True, True, True, True],   # probe 1 again — NOT readmitted
+        ]
+        healths = iter(script)
+        monkeypatch.setattr(
+            trainer_mod,
+            "_replica_block_healthy",
+            lambda s, m: np.asarray(next(healths), bool),
+        )
+        cfg = gossip_cfg()
+        _, df = train_gossip(cfg, n_episodes=8, guard=True, readmit_after=2)
+        g = df.attrs["gossip"]
+        assert g["readmitted"] == 0
+        assert g["quarantined"] == [0, 0, 0, 1]  # still serving probation
+        assert g["excluded"] == 4  # excluded from every mix
+        assert g["rollbacks"] == 2
+
+
 class TestReplicaCheckpoint:
     def test_replica_world_roundtrip_and_fallback(self, tmp_path):
         from rcmarl_tpu.utils.checkpoint import (

@@ -234,6 +234,78 @@ class TestPipelined:
             key = jax.random.fold_in(key, 0x5C1B + b)
         np.testing.assert_array_equal(np.asarray(state.key), np.asarray(key))
 
+    def test_poisoned_rollout_window_redraws_then_skips(self):
+        """The skip-and-redraw regression net (the chaos campaign's
+        pipeline_window cells): an all-NaN actor window must never be
+        retried against (the learner retry is structurally futile with
+        the batch kept) — PERSISTENT poisoning terminates in bounded
+        REDRAWS then a skip with nothing published, the stored key
+        folded like the synchronous skip, and the staleness lengthened;
+        TRANSIENT poisoning is healed by one redraw with zero learner
+        retries burned."""
+        import jax.numpy as jnp
+
+        def bomb_block1(persistent):
+            def window_fault(b, attempt, fresh, m):
+                if b == 1 and (persistent or attempt == 0):
+                    fresh = jax.tree.map(
+                        lambda l: (
+                            jnp.full_like(l, jnp.nan)
+                            if jnp.issubdtype(
+                                jnp.asarray(l).dtype, jnp.floating
+                            )
+                            else l
+                        ),
+                        fresh,
+                    )
+                return fresh, m
+            return window_fault
+
+        cfg = tiny_cfg(pipeline_depth=2, n_episodes=8)
+        seen_keys = {}
+        state, df = train_pipelined(
+            cfg, guard=True, max_retries=2,
+            window_fault=bomb_block1(True),
+            block_callback=lambda s, b: seen_keys.update(
+                {b: np.asarray(s.key)}
+            ),
+        )
+        g, p = df.attrs["guard"], df.attrs["pipeline"]
+        assert g["redraws"] == 2 and g["skipped"] == 1
+        assert g["retries"] == 0  # no learner launch paid for the window
+        assert p["publishes"] == p["blocks"] - 1  # skip published NOTHING
+        # staleness lengthened: block 3's dispatch (fired after block
+        # 1's skip) still acts on block-1-old params
+        assert p["staleness"] == [0, 1, 1, 2]
+        assert all(
+            np.isfinite(np.asarray(l)).all()
+            for l in jax.tree.leaves(state.params)
+        )
+        # the STORED key at the skipped block is the per-skip fold of
+        # the synchronous protocol on top of the walked chain — a
+        # checkpoint taken there never replays the failing draws
+        key = jax.random.PRNGKey(cfg.seed)
+        _, _, _, key = jax.random.split(key, 4)  # init_train_state split
+        key, _, _ = jax.random.split(key, 3)  # block 0's chain step
+        key = jax.random.fold_in(key, 0x5C1B + 1)
+        np.testing.assert_array_equal(seen_keys[1], np.asarray(key))
+
+        # transient: one redraw heals the window — nothing skipped
+        state2, df2 = train_pipelined(
+            cfg, guard=True, max_retries=2,
+            window_fault=bomb_block1(False),
+        )
+        g2, p2 = df2.attrs["guard"], df2.attrs["pipeline"]
+        assert g2["redraws"] == 1 and g2["skipped"] == 0
+        assert g2["retries"] == 0
+        assert p2["publishes"] == p2["blocks"]
+
+    def test_window_fault_rejected_at_depth0(self):
+        with pytest.raises(ValueError, match="window_fault"):
+            train_pipelined(
+                tiny_cfg(), window_fault=lambda b, a, f, m: (f, m)
+            )
+
     def test_resume_continues_block_counter(self):
         cfg = tiny_cfg(pipeline_depth=2, n_episodes=4)
         state, _ = train_pipelined(cfg)

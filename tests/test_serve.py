@@ -193,6 +193,41 @@ class TestHotSwap:
         _leaves_equal(eng.block, STATE_B.params.actor)  # last good kept
         assert "served: last-good" in eng.summary_line()
 
+    def test_double_corruption_within_one_poll_cycle(self, tmp_path):
+        """Primary AND .prev both corrupted BETWEEN polls (one poll
+        cycle sees the whole double fault): exactly one reject, ZERO
+        fallbacks (a fallback counter that moved would claim the .prev
+        served, which it never did), serving stays bitwise the last
+        good block — and a healthy re-publish recovers completely.
+        Extends the single-corruption cells above; the chaos campaign's
+        ckpt_bitflip@both cell gates the same contract in
+        RESILIENCE.jsonl."""
+        eng = _engine(tmp_path)
+        watcher = CheckpointWatcher(eng)
+        ref_a, ref_p = eng.serve(OBS, key=KEY)
+        fallbacks_before = eng.counters["fallbacks"]
+        # a new publish lands, then BOTH files rot before the next poll
+        save_checkpoint(tmp_path / "checkpoint.npz", STATE_B, CFG)
+        for name in ("checkpoint.npz", "checkpoint.npz.prev"):
+            with open(tmp_path / name, "r+b") as f:
+                f.seek(100)
+                f.write(b"\xde\xad\xbe\xef" * 16)
+        assert watcher.poll() is False
+        assert eng.counters["rejects"] == 1
+        assert eng.counters["fallbacks"] == fallbacks_before  # never served
+        assert eng.counters["swaps"] == 0
+        _leaves_equal(eng.block, STATE.params.actor)  # last good kept
+        a, p = eng.serve(OBS, key=KEY)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(ref_a))
+        np.testing.assert_array_equal(np.asarray(p), np.asarray(ref_p))
+        assert "served: last-good" in eng.summary_line()
+        # recovery: a healthy re-publish swaps in and clears the status
+        save_checkpoint(tmp_path / "checkpoint.npz", STATE_B, CFG)
+        assert watcher.poll() is True
+        _leaves_equal(eng.block, STATE_B.params.actor)
+        assert eng.counters["swaps"] == 1 and eng.counters["rejects"] == 1
+        assert "served: fresh" in eng.summary_line()
+
     def test_corrupt_primary_falls_back_to_prev(self, tmp_path):
         """A corrupted primary with a good .prev swaps the PREVIOUS
         params in (the discovery chain's fallback), counted as a

@@ -153,6 +153,82 @@ class TestMicroBatchQueue:
             run_load(lambda fill: 0.001, np.array([0.0]), 4, -1.0)
 
 
+class TestDeadlineShedding:
+    def test_shed_off_reproduces_the_shed_free_queue(self):
+        """``shed_after=inf`` (and the default) is bitwise the
+        historical queue: identical latency numbers, with the shedding
+        ledger present at zero on every row (the PR-14-row
+        reproduction pin)."""
+        import math
+
+        arr = poisson_arrivals(0, 2000, 10000.0)
+        base = run_load(lambda f: 0.004, arr, 16, 0.002)
+        explicit = run_load(lambda f: 0.004, arr, 16, 0.002, math.inf)
+        assert base == explicit
+        assert base["shed"] == 0 and base["shed_fraction"] == 0.0
+        assert base["served"] == base["requests"]
+
+    def test_shed_accounting_hand_computed(self):
+        """Service 10ms, max_batch 1, shed_after 5ms, arrivals at 0 /
+        1ms / 2ms: request 0 serves (10ms), requests 1 and 2 have
+        waited 9ms/8ms when the server frees — both past the deadline,
+        both shed."""
+        rep = run_load(
+            lambda f: 0.010, np.array([0.0, 0.001, 0.002]),
+            max_batch=1, max_wait=0.0, shed_after=0.005,
+        )
+        assert rep["launches"] == 1
+        assert rep["served"] == 1 and rep["shed"] == 2
+        assert rep["shed_fraction"] == pytest.approx(2.0 / 3.0)
+        assert rep["p99"] == pytest.approx(0.010)
+
+    def test_shed_bounds_p99_past_the_knee(self):
+        """The acceptance criterion: past the saturation knee, deadline
+        shedding keeps p99 within 2x the knee-point p99 (the shed-free
+        twin explodes into backlog), with the cost ledgered as the shed
+        fraction. This is the same contract the chaos campaign's
+        serve_overload cells gate in RESILIENCE.jsonl."""
+        service = lambda f: 0.001  # noqa: E731 — injected model
+        max_batch, max_wait = 16, 0.002
+        capacity = max_batch / 0.001
+        knee = run_load(
+            service, poisson_arrivals(0, 4000, 0.8 * capacity),
+            max_batch, max_wait,
+        )
+        overload = poisson_arrivals(0, 4000, 4.0 * capacity)
+        noshed = run_load(service, overload, max_batch, max_wait)
+        shed = run_load(
+            service, overload, max_batch, max_wait, shed_after=0.002
+        )
+        assert noshed["p99"] > 2.0 * knee["p99"]  # the documented cliff
+        assert shed["p99"] <= 2.0 * knee["p99"]  # bounded past the knee
+        assert shed["shed_fraction"] > 0.5  # the cost is explicit
+        # and the bound is the analytical one: shed_after+max_wait+svc
+        assert shed["p99"] <= 0.002 + max_wait + 0.001 + 1e-9
+
+    def test_sweep_rows_carry_shed_fraction(self):
+        pts = sweep_load(
+            lambda f: 0.001, [1000.0, 200000.0], n_requests=2000,
+            max_batch=16, max_wait=0.002, seed=0, shed_after=0.004,
+        )
+        assert all("shed_fraction" in p for p in pts)
+        assert pts[0]["shed_fraction"] == 0.0  # light load sheds nothing
+        assert pts[-1]["shed_fraction"] > 0.0  # saturated load sheds
+
+    def test_bad_deadline_loud_and_head_always_serves(self):
+        with pytest.raises(ValueError, match="shed_after"):
+            run_load(lambda f: 0.001, np.array([0.0]), 4, 0.01,
+                     shed_after=0.0)
+        # a deadline far below one service time sheds everything BEHIND
+        # the head-of-line request, but the head itself always serves
+        # (its wait is zero when the server first considers it)
+        rep = run_load(
+            lambda f: 1.0, np.zeros(64), max_batch=1, max_wait=0.0,
+            shed_after=1e-6,
+        )
+        assert rep["served"] == 1 and rep["shed"] == 63
+
+
 class TestSweepAndKnee:
     def test_sweep_points_tagged_and_knee_found(self):
         """Constant service 1ms, max_batch 32 -> capacity 32k req/s:
