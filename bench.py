@@ -167,10 +167,21 @@ def _measure(
     )
 
 
-def _measure_serve(batch: int, steps: int, reps: int, mode: str = "sample") -> None:
+def _measure_serve(
+    batch: int,
+    steps: int,
+    reps: int,
+    mode: str = "sample",
+    serve_impl: str = "xla",
+) -> None:
     """Child: the SERVING headline — actions/sec through the compiled
-    batched inference launch (rcmarl_tpu.serve.engine.serve_block) at
-    the published reference shape (5 agents, 20-wide nets).
+    batched inference launch at the published reference shape (5
+    agents, 20-wide nets), on the requested ``serve_impl`` arm: the XLA
+    serve_block chain or the ONE fused forward+key-derivation+sample
+    Pallas program (rcmarl_tpu.ops.pallas_serve). A fused arm first
+    verifies BITWISE parity (actions AND probs) against the XLA chain
+    on the real warmup batch, so a fused headline row carries a parity
+    claim the run itself proved.
 
     Fresh-init parameters: this measures the compiled serving program's
     throughput (the infrastructure number), not a trained policy's
@@ -182,10 +193,15 @@ def _measure_serve(batch: int, steps: int, reps: int, mode: str = "sample") -> N
     import numpy as np
 
     from rcmarl_tpu.config import Config
+    from rcmarl_tpu.ops.pallas_serve import (
+        fused_serve_block,
+        resolve_serve_impl,
+    )
     from rcmarl_tpu.serve.engine import serve_block, serve_keys, stack_actor_rows
     from rcmarl_tpu.training.trainer import init_train_state
     from rcmarl_tpu.utils.profiling import program_fingerprint
 
+    impl = resolve_serve_impl(serve_impl)
     cfg = Config(slow_lr=0.002, fast_lr=0.01, seed=100)
     state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
     block = stack_actor_rows(state.params, cfg)
@@ -197,21 +213,33 @@ def _measure_serve(batch: int, steps: int, reps: int, mode: str = "sample") -> N
         for i in range(n_buf)
     ]
     key = serve_keys(0, 0)
-    fingerprint = program_fingerprint(
-        serve_block.lower(cfg, block, obs[0], key, mode=mode)
-    )
-    # warmup: compile + one execution
-    np.asarray(serve_block(cfg, block, obs[0], key, mode=mode)[0])
+    if impl == "xla":
+        launch = lambda o, k: serve_block(cfg, block, o, k, mode=mode)
+        lowered = serve_block.lower(cfg, block, obs[0], key, mode=mode)
+    else:
+        interp = impl == "pallas_interpret"
+        launch = lambda o, k: fused_serve_block(
+            cfg, block, o, k, mode=mode, interpret=interp
+        )
+        lowered = fused_serve_block.lower(
+            cfg, block, obs[0], key, mode=mode, interpret=interp
+        )
+    fingerprint = program_fingerprint(lowered)
+    # warmup: compile + one execution — and on a fused arm, the bitwise
+    # parity gate vs the XLA chain on this real batch
+    warm_a, warm_p = launch(obs[0], key)
+    np.asarray(warm_a)
+    if impl != "xla":
+        ref_a, ref_p = serve_block(cfg, block, obs[0], key, mode=mode)
+        np.testing.assert_array_equal(np.asarray(warm_a), np.asarray(ref_a))
+        np.testing.assert_array_equal(np.asarray(warm_p), np.asarray(ref_p))
 
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
         actions = None
         for s in range(steps):
-            actions, _ = serve_block(
-                cfg, block, obs[s % n_buf],
-                jax.random.fold_in(key, s), mode=mode,
-            )
+            actions, _ = launch(obs[s % n_buf], jax.random.fold_in(key, s))
         np.asarray(actions)  # completion barrier
         best = min(best, time.perf_counter() - t0)
 
@@ -224,11 +252,14 @@ def _measure_serve(batch: int, steps: int, reps: int, mode: str = "sample") -> N
                 "unit": "actions/s",
                 "platform": jax.devices()[0].platform,
                 "cost_fingerprint": fingerprint,
+                "serve_impl": impl,
+                **({"fused_parity": "bitwise"} if impl != "xla" else {}),
                 "workload": {
                     "batch": batch,
                     "steps": steps,
                     "reps": reps,
                     "mode": mode,
+                    "serve_impl": impl,
                     "n_agents": cfg.n_agents,
                     "hidden": list(cfg.hidden),
                 },
@@ -505,11 +536,15 @@ def main_serve() -> int:
     return _orchestrate_serve(
         tpu_children=[
             (
-                f"tpu_serve_{batch}",
+                f"tpu_serve_{batch}_{impl}",
                 ["--serve_child", "--batch", str(batch), "--steps", "50",
-                 "--reps", "3"],
+                 "--reps", "3", "--serve_impl", impl],
             )
             for batch in (4096, 32768, 131072)
+            # both arms per batch: the candidate list IS the fused-vs-XLA
+            # A/B, and the headline is whichever program actually wins
+            # on-chip (the fused child parity-gates itself before timing)
+            for impl in ("xla", "pallas")
         ],
         cpu_child=["--serve_child", "--batch", "1024", "--steps", "20",
                    "--reps", "2"],
@@ -822,6 +857,15 @@ if __name__ == "__main__":
                 _arm_arg(args, "--mode", ("sample", "greedy"))
                 if "--mode" in args
                 else "sample"
+            ),
+            serve_impl=(
+                _arm_arg(
+                    args,
+                    "--serve_impl",
+                    ("auto", "xla", "pallas", "pallas_interpret"),
+                )
+                if "--serve_impl" in args
+                else "xla"
             ),
         )
     elif "--serve" in sys.argv:

@@ -770,6 +770,83 @@ def gossip_readmission_section(artifact_path) -> list:
     return lines
 
 
+def autoscale_slo_section(artifact_path) -> list:
+    """QUALITY.md lines for the autoscale-SLO experiment, rendered from
+    the committed ``scripts/autoscale_experiment.py`` artifact
+    (``simulation_results/autoscale_slo.json``) — same byte-stable
+    render-from-evidence contract as the gossip/canary sections. Empty
+    when the artifact does not exist."""
+    p = Path(artifact_path)
+    if not p.exists():
+        return []
+    d = json.loads(p.read_text())
+    cfg = d["config"]
+    auto, static = d["arms"][0], d["arms"][1]
+
+    def _ms(x) -> str:
+        return "∞ (all shed)" if x is None else f"{x}"
+
+    lines = [
+        "",
+        "## SLO-driven autoscaling under a 10x load swing",
+        "",
+        "The serving tier's latency harness measures ONE fleet size; "
+        "the SLO control loop (`rcmarl_tpu.serve.autoscale`, README "
+        "\"One-kernel serving + SLO autoscaling\") closes it: windowed "
+        "p99/demand/shed telemetry drives `SLOController` resize "
+        "decisions that land exactly at window boundaries — breach or "
+        "shed doubles the fleet, sustained high demand resizes "
+        "proportionally, and scale-down waits out hysteresis plus a "
+        "projected-demand gate so releasing capacity never causes the "
+        "next breach. The committed experiment "
+        f"(`{p.name}`, `scripts/autoscale_experiment.py`: "
+        f"{cfg['scenario']}, measured per-launch "
+        f"{cfg['per_launch_ms']}ms on the `{cfg['serve_impl_resolved']}` "
+        f"arm at batch {cfg['batch']}, p99 SLO {cfg['slo_ms']}ms, "
+        f"deadline shedding at the SLO on BOTH arms, seeded "
+        f"1x→10x→1x Poisson swing of "
+        f"{auto['requests']} requests, {cfg['n_windows']} control "
+        f"windows of {cfg['window_ms']}ms, measured on "
+        f"{d['platform']}):",
+        "",
+        "| offered load | req/s | autoscaled p99 (ms) | fleet scale | "
+        "autoscaled shed | static p99 (ms) | static shed |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for c in d["curve"]:
+        lines.append(
+            f"| {c['factor']}x | {c['offered_rps']} | "
+            f"{_ms(c['auto_p99_ms'])} | {c['auto_scale']} | "
+            f"{c['auto_shed']} | {_ms(c['static_p99_ms'])} | "
+            f"{c['static_shed']} |"
+        )
+    lines += [
+        "",
+        f"Reading: the autoscaled fleet holds the p99 SLO in every "
+        f"window and sheds {auto['shed']} of {auto['requests']} "
+        f"requests (peak scale {auto['max_scale_used']}, back to "
+        f"{auto['final_scale']} after the swing — the scale column "
+        "shows capacity following load in BOTH directions), while the "
+        "static scale-1 fleet on the identical seeded plan saturates: "
+        f"p99 past the {cfg['slo_ms']}ms target in the violated "
+        f"windows and {static['shed_fraction']:.0%} of all requests "
+        "shed at the deadline — the price of not scaling is paid in "
+        "dropped requests, exactly what the deadline-shedding ledger "
+        "exists to count. The 10x peak offers 5x the static fleet's "
+        "capacity by construction, so saturation is arithmetic, not "
+        "bad luck. The service model is the measured MEDIAN launch "
+        "time of the real compiled serving program (100 timed "
+        "launches), replayed deterministically — the committed curve "
+        "isolates queueing (what scaling fixes) from this host's "
+        "dispatch jitter (what it cannot); live-launch billing rides "
+        "`serve --autoscale`, tests/test_autoscale.py pins the same "
+        "claims on an injected service model, and the chaos "
+        "campaign's `serve_overload@autoscale` cell gates the "
+        "scale-out response in RESILIENCE.jsonl.",
+    ]
+    return lines
+
+
 def chaos_campaign_section(ledger_path) -> list:
     """QUALITY.md lines summarizing the committed RESILIENCE.jsonl
     chaos ledger (``python -m rcmarl_tpu chaos --run``) — rendered from
@@ -1126,6 +1203,10 @@ def write_quality_md(
         Path(out_path).parent / "simulation_results/canary_gate.json"
     )
     lines += canary_section(canary_artifact)
+    autoscale_artifact = (
+        Path(out_path).parent / "simulation_results/autoscale_slo.json"
+    )
+    lines += autoscale_slo_section(autoscale_artifact)
     resilience_ledger = Path(out_path).parent / "RESILIENCE.jsonl"
     lines += chaos_campaign_section(resilience_ledger)
     lines += [
@@ -1181,6 +1262,12 @@ def write_quality_md(
             "- `simulation_results/gossip_readmission.json` — the "
             "flapping-sender readmission experiment behind the gossip-"
             "readmission section (`scripts/gossip_readmission.py`)"
+        )
+    if autoscale_artifact.exists():
+        lines.append(
+            "- `simulation_results/autoscale_slo.json` — the measured "
+            "p99-vs-load swing behind the SLO-autoscaling section "
+            "(`scripts/autoscale_experiment.py`)"
         )
     if resilience_ledger.exists():
         lines.append(

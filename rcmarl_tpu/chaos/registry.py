@@ -746,7 +746,12 @@ def _run_overload(intensity: str) -> dict:
     the documented backlog cliff — p99 beyond the latency bound —
     while ``shed`` must keep p99 within ``LATENCY_BOUND_FACTOR`` x the
     knee-point p99 with the cost ledgered as the shed fraction (the
-    deadline-shedding acceptance criterion as a gated cell)."""
+    deadline-shedding acceptance criterion as a gated cell). The
+    ``autoscale`` arm drives a LONGER sustained overload through the
+    SLO controller (:mod:`rcmarl_tpu.serve.autoscale`): the fleet must
+    scale out, RESTORE the SLO in the steady windows, and end with a
+    strictly smaller shed fraction than the static shed arm pays —
+    degrade-then-recover, not degrade-forever."""
     from rcmarl_tpu.serve.load import poisson_arrivals, run_load
 
     capacity = _MAX_BATCH / _SERVICE_S
@@ -756,6 +761,73 @@ def _run_overload(intensity: str) -> dict:
         _MAX_BATCH,
         _MAX_WAIT,
     )
+    if intensity == "autoscale":
+        from rcmarl_tpu.serve.autoscale import SLOController, autoscale_replay
+
+        slo = LATENCY_BOUND_FACTOR * knee["p99"]
+        # a longer sustained plan than the shed/noshed cells: the ramp
+        # windows ARE the phenomenon under test
+        arrivals = poisson_arrivals(0, 20000, _OVERLOAD_X * capacity)
+        static = run_load(
+            lambda fill: _SERVICE_S, arrivals, _MAX_BATCH, _MAX_WAIT,
+            _SHED_AFTER,
+        )
+        res = autoscale_replay(
+            lambda fill: _SERVICE_S,
+            arrivals,
+            SLOController(slo_p99=slo, max_scale=8),
+            window=0.05,
+            max_batch=_MAX_BATCH,
+            max_wait=_MAX_WAIT,
+            # the deadline IS the SLO here: shed only what would
+            # already miss it, so a healthy scaled-out window is
+            # genuinely shed-free (the static arm keeps the registry's
+            # fixed 2ms deadline — its p99 bound, not an SLO)
+            shed_after=slo,
+            slo_p99=slo,
+        )
+        wins = res["windows"]
+        frac = res["shed"] / max(1, res["requests"])
+        if res["max_scale_used"] <= 1:
+            raise CellFailed(
+                "the controller never scaled out under sustained "
+                f"{_OVERLOAD_X:.0f}x overload"
+            )
+        if not wins or not wins[-1]["slo_ok"]:
+            raise CellFailed(
+                "autoscale failed to restore the SLO by the final "
+                f"window: p99 {wins[-1]['p99'] * 1e3:.3f}ms vs "
+                f"{slo * 1e3:.3f}ms target"
+                if wins
+                else "autoscale produced no windows"
+            )
+        if frac >= static["shed_fraction"]:
+            raise CellFailed(
+                f"autoscale shed fraction {frac:.4f} is not below the "
+                f"static shed arm's {static['shed_fraction']:.4f} — "
+                "scaling out bought nothing"
+            )
+        return {
+            "outcome": "survived",
+            "counters": {
+                "slo_ms": round(slo * 1e3, 3),
+                "final_p99_ms": round(wins[-1]["p99"] * 1e3, 3),
+                "max_scale_used": res["max_scale_used"],
+                "final_scale": res["final_scale"],
+                "resizes": len(res["resizes"]),
+                "shed_fraction": round(frac, 4),
+                "static_shed_fraction": round(
+                    static["shed_fraction"], 4
+                ),
+            },
+            "final_return": None,
+            "clean_return": None,
+            "detail": (
+                f"{_OVERLOAD_X:.0f}x capacity sustained; scale "
+                f"1->{res['max_scale_used']}, SLO restored, shed "
+                f"{frac:.1%} vs static {static['shed_fraction']:.1%}"
+            ),
+        }
     arrivals = poisson_arrivals(0, 4000, _OVERLOAD_X * capacity)
     shed_after = _SHED_AFTER if intensity == "shed" else math.inf
     rep = run_load(
@@ -952,9 +1024,11 @@ CHAOS_POINTS: Tuple[ChaosPoint, ...] = (
         "request-level overload past the saturation knee",
         "offered load >> capacity through the micro-batching queue",
         "deadline shedding (run_load shed_after): bounded p99, ledgered "
-        "shed fraction",
-        "tests/test_serve_load.py (shed cells)",
-        (("noshed", "degraded"), ("shed", "survived")),
+        "shed fraction; SLO autoscaler (serve/autoscale.py): scale-out "
+        "restores the SLO and undercuts the static shed cost",
+        "tests/test_serve_load.py (shed cells), tests/test_autoscale.py",
+        (("noshed", "degraded"), ("shed", "survived"),
+         ("autoscale", "survived")),
         _run_overload,
     ),
 )

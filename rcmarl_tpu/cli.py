@@ -1715,6 +1715,39 @@ def cmd_profile(argv) -> int:
         "(SELECT_MAX_N_IN, PALLAS_CROSSOVER_VOLUME) and the netstack "
         "A/B key on",
     )
+    p.add_argument(
+        "--serve_micro",
+        action="store_true",
+        help="emit a SERVING micro-breakdown row per (config, env, "
+        "dtype, serve_impl) cell INSTEAD of the training breakdown "
+        "(utils/profiling.py:profile_serve): forward vs key-derivation "
+        "vs sample vs the whole launch as the resolved --serve_impl "
+        "arm runs it, plus queue_wait from a short seeded replay at "
+        "half capacity — each row tagged with the active arm's "
+        "cost_fingerprint. Under the fused arm the per-stage keys are "
+        "an honest 0.0 (the stages run in-register inside ONE kernel)",
+    )
+    p.add_argument(
+        "--serve_impl",
+        nargs="+",
+        default=["auto"],
+        choices=["auto", "xla", "pallas", "pallas_interpret"],
+        help="serving arm(s) to micro-profile (--serve_micro)",
+    )
+    p.add_argument(
+        "--serve_batch",
+        type=int,
+        default=512,
+        help="requests per launch for --serve_micro",
+    )
+    p.add_argument(
+        "--serve_mode",
+        type=str,
+        default="sample",
+        choices=["sample", "greedy"],
+        help="serving mode for --serve_micro (greedy zeroes the "
+        "key-derivation/sample stages on every arm)",
+    )
     p.add_argument("--n_ep_fixed", type=int, default=10)
     p.add_argument("--reps", type=int, default=3)
     p.add_argument(
@@ -1756,6 +1789,97 @@ def cmd_profile(argv) -> int:
         profile_phases,
         train_block_fingerprint,
     )
+
+    if args.serve_micro:
+        import jax.numpy as jnp
+
+        from rcmarl_tpu.ops.pallas_serve import (
+            fused_serve_block,
+            resolve_serve_impl,
+        )
+        from rcmarl_tpu.serve.engine import serve_block, stack_actor_rows
+        from rcmarl_tpu.training.trainer import init_train_state
+        from rcmarl_tpu.utils.profiling import (
+            profile_serve,
+            program_fingerprint,
+            serve_tags,
+        )
+
+        if args.serve_batch < 1:
+            raise SystemExit("--serve_batch must be >= 1")
+        n_failed = 0
+        for name, env, dtype, impl in itertools.product(
+            args.configs, args.env, args.compute_dtype, args.serve_impl
+        ):
+            cfg = _bench_config(name, "xla", args.n_ep_fixed, dtype, env=env)
+            try:
+                resolved = resolve_serve_impl(impl)
+                block = stack_actor_rows(
+                    init_train_state(cfg, jax.random.PRNGKey(cfg.seed)).params,
+                    cfg,
+                )
+                # fingerprint the ACTIVE arm on the exact shapes the
+                # micro rows time (the ledger convention: a row cites
+                # the program it measured, never a stand-in)
+                obs = jnp.zeros(
+                    (args.serve_batch, cfg.n_agents, cfg.obs_dim),
+                    jnp.float32,
+                )
+                skey = jax.random.PRNGKey(0)
+                if resolved == "xla":
+                    lowered = serve_block.lower(
+                        cfg, block, obs, skey, mode=args.serve_mode
+                    )
+                else:
+                    lowered = fused_serve_block.lower(
+                        cfg, block, obs, skey, mode=args.serve_mode,
+                        interpret=resolved == "pallas_interpret",
+                    )
+                fingerprint = program_fingerprint(lowered)
+                micro = profile_serve(
+                    cfg, block,
+                    batch=args.serve_batch,
+                    mode=args.serve_mode,
+                    serve_impl=impl,
+                    reps=args.reps,
+                )
+            except Exception as e:  # noqa: BLE001 — bench fault isolation
+                err = json.dumps(
+                    {
+                        "kind": "serve_micro",
+                        "config": name,
+                        "env": env,
+                        "serve_impl": impl,
+                        "compute_dtype": dtype,
+                        "error": f"{type(e).__name__}: {e}"[:300],
+                    }
+                )
+                _emit(err, args.out, err=True)
+                n_failed += 1
+                continue
+            row = json.dumps(
+                {
+                    "kind": "serve_micro",
+                    "config": name,
+                    "env": cfg.env,
+                    "mode": args.serve_mode,
+                    "serve_impl": impl,
+                    "serve_impl_resolved": resolved,
+                    "compute_dtype": cfg.compute_dtype,
+                    "cost_fingerprint": fingerprint,
+                    **serve_tags(cfg, args.serve_batch, args.serve_mode),
+                    "ms": {
+                        k: round(v * 1e3, 3) for k, v in micro.items()
+                    },
+                    "workload": {"reps": args.reps},
+                    "platform": jax.devices()[0].platform,
+                    "timestamp": datetime.now().isoformat(
+                        timespec="seconds"
+                    ),
+                }
+            )
+            _emit(row, args.out)
+        return 1 if n_failed else 0
 
     n_failed = 0
     for name, env, dtype, impl, layout, ns, fs in itertools.product(
@@ -1949,6 +2073,46 @@ def cmd_serve(argv) -> int:
         "under the fold_in key discipline, greedy = deterministic argmax",
     )
     p.add_argument(
+        "--serve_impl",
+        type=str,
+        default="auto",
+        choices=["auto", "xla", "pallas", "pallas_interpret"],
+        help="serving program arm (rcmarl_tpu.ops.pallas_serve): xla = "
+        "the serve_block launch chain; pallas = the ONE fused "
+        "forward+key-derivation+sample kernel; pallas_interpret = the "
+        "fused kernel's interpreter arm (CPU CI); auto = pallas on TPU "
+        "else xla. A fused arm is verified BITWISE against the XLA "
+        "chain (actions AND probs) on the real batch before anything "
+        "is timed",
+    )
+    p.add_argument(
+        "--autoscale",
+        type=int,
+        default=0,
+        metavar="SEG_REQUESTS",
+        help="additionally replay the SLO autoscaler "
+        "(rcmarl_tpu.serve.autoscale) over a seeded 1x->10x->1x "
+        "offered-load swing (SEG_REQUESTS Poisson arrivals per "
+        "segment) through THIS checkpoint's resolved serving arm, "
+        "against the static scale-1 baseline on the same plan; emits a "
+        "serve_autoscale row and prints the grep-able summary line "
+        "('SLO held' only when every window met the p99 target "
+        "shed-free). 0 = off",
+    )
+    p.add_argument(
+        "--slo_ms",
+        type=float,
+        default=0.0,
+        help="p99 SLO for --autoscale, in milliseconds (0 = auto: 4x "
+        "the measured per-launch service time of the resolved arm)",
+    )
+    p.add_argument(
+        "--max_scale",
+        type=int,
+        default=16,
+        help="autoscaler fleet-size ceiling (--autoscale)",
+    )
+    p.add_argument(
         "--eval_seed",
         type=int,
         default=0,
@@ -1998,6 +2162,10 @@ def cmd_serve(argv) -> int:
     import jax.numpy as jnp
 
     from rcmarl_tpu.envs.api import env_obs, env_reset
+    from rcmarl_tpu.ops.pallas_serve import (
+        fused_fleet_block,
+        fused_serve_block,
+    )
     from rcmarl_tpu.serve.engine import ServeEngine, serve_block, serve_keys
     from rcmarl_tpu.serve.fleet import FleetEngine, fleet_block
     from rcmarl_tpu.serve.swap import CheckpointWatcher
@@ -2006,12 +2174,14 @@ def cmd_serve(argv) -> int:
 
     if args.fleet:
         engine = FleetEngine(
-            args.fleet, mode=args.mode, eval_seed=args.eval_seed
+            args.fleet, mode=args.mode, eval_seed=args.eval_seed,
+            serve_impl=args.serve_impl,
         )
         watcher = None  # FleetEngine.poll drives the per-member watchers
     else:
         engine = ServeEngine(
-            args.checkpoint, mode=args.mode, eval_seed=args.eval_seed
+            args.checkpoint, mode=args.mode, eval_seed=args.eval_seed,
+            serve_impl=args.serve_impl,
         )
         if args.watch_every and args.canary_band is not None:
             from rcmarl_tpu.serve.canary import CanaryGate, CanaryWatcher
@@ -2064,21 +2234,50 @@ def cmd_serve(argv) -> int:
             (jnp.arange(args.batch, dtype=jnp.int32) + r) % F
             for r in range(min(F, 4))
         ]
-        # tie the row to the EXACT program being timed (ledger convention)
-        fingerprint = program_fingerprint(
-            fleet_block.lower(
-                cfg, engine.fleet, buffers[0],
-                serve_keys(args.eval_seed, 0), routes[0], mode=args.mode,
+        # tie the row to the EXACT program being timed (ledger
+        # convention): the ACTIVE arm's lowering, not a fixed one
+        key0 = serve_keys(args.eval_seed, 0)
+        if engine.serve_impl == "xla":
+            fingerprint = program_fingerprint(
+                fleet_block.lower(
+                    cfg, engine.fleet, buffers[0], key0, routes[0],
+                    mode=args.mode,
+                )
             )
-        )
+            _, fleet_probs = fleet_block(
+                cfg, engine.fleet, buffers[0], key0, routes[0],
+                mode=args.mode,
+            )
+        else:
+            interp = engine.serve_impl == "pallas_interpret"
+            fingerprint = program_fingerprint(
+                fused_fleet_block.lower(
+                    cfg, engine.fleet, buffers[0], key0, routes[0],
+                    mode=args.mode, interpret=interp,
+                )
+            )
+            # fused-arm gate: the ONE-kernel fleet program must be
+            # BITWISE the XLA chain (actions AND probs) on the real
+            # batch before anything is timed — the row's parity claim
+            # is proven by this run, not assumed
+            fused_a, fleet_probs = fused_fleet_block(
+                cfg, engine.fleet, buffers[0], key0, routes[0],
+                mode=args.mode, interpret=interp,
+            )
+            ref_a, ref_p = fleet_block(
+                cfg, engine.fleet, buffers[0], key0, routes[0],
+                mode=args.mode,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(fused_a), np.asarray(ref_a)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(fleet_probs), np.asarray(ref_p)
+            )
         # per-member BITWISE parity vs solo serving, verified on the
         # real batch BEFORE anything is timed: the emitted fleet row
         # carries a parity claim the run itself proved (a mismatch is a
         # hard error, so the row can never lie)
-        key0 = serve_keys(args.eval_seed, 0)
-        _, fleet_probs = fleet_block(
-            cfg, engine.fleet, buffers[0], key0, routes[0], mode=args.mode
-        )
         r0 = np.asarray(routes[0])
         for f, member in enumerate(engine.members):
             _, solo_probs = serve_block(
@@ -2094,6 +2293,8 @@ def cmd_serve(argv) -> int:
             "member_parity": "bitwise",
             "route": "round_robin(rotating)",
         }
+        if engine.serve_impl != "xla":
+            fleet_fields["fused_parity"] = "bitwise"
 
         def launch(s: int):
             return engine.serve(
@@ -2102,13 +2303,41 @@ def cmd_serve(argv) -> int:
 
         poll = engine.poll if args.watch_every else None
     else:
-        # tie the row to the EXACT program being timed (ledger convention)
-        fingerprint = program_fingerprint(
-            serve_block.lower(
-                cfg, engine.block, buffers[0], serve_keys(args.eval_seed, 0),
-                mode=args.mode,
+        # tie the row to the EXACT program being timed (ledger
+        # convention): the ACTIVE arm's lowering, not a fixed one
+        key0 = serve_keys(args.eval_seed, 0)
+        if engine.serve_impl == "xla":
+            fingerprint = program_fingerprint(
+                serve_block.lower(
+                    cfg, engine.block, buffers[0], key0, mode=args.mode
+                )
             )
-        )
+        else:
+            interp = engine.serve_impl == "pallas_interpret"
+            fingerprint = program_fingerprint(
+                fused_serve_block.lower(
+                    cfg, engine.block, buffers[0], key0,
+                    mode=args.mode, interpret=interp,
+                )
+            )
+            # fused-arm gate: the ONE-kernel program must be BITWISE
+            # the XLA serve_block chain (actions AND probs) on the real
+            # batch before anything is timed — the row's parity claim
+            # is proven by this run, not assumed
+            fused_a, fused_p = fused_serve_block(
+                cfg, engine.block, buffers[0], key0,
+                mode=args.mode, interpret=interp,
+            )
+            ref_a, ref_p = serve_block(
+                cfg, engine.block, buffers[0], key0, mode=args.mode
+            )
+            np.testing.assert_array_equal(
+                np.asarray(fused_a), np.asarray(ref_a)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(fused_p), np.asarray(ref_p)
+            )
+            fleet_fields["fused_parity"] = "bitwise"
 
         def launch(s: int):
             return engine.serve(buffers[s % len(buffers)])
@@ -2147,6 +2376,7 @@ def cmd_serve(argv) -> int:
             ),
             "env": cfg.env,
             "mode": args.mode,
+            "serve_impl": engine.serve_impl,
             "n_agents": cfg.n_agents,
             "hidden": list(cfg.hidden),
             "compute_dtype": cfg.compute_dtype,
@@ -2175,6 +2405,94 @@ def cmd_serve(argv) -> int:
     print(engine.summary_line())
     if args.canary_band is not None:
         print(watcher.gate.summary_line())
+    if args.autoscale:
+        from rcmarl_tpu.serve.autoscale import (
+            SLOController,
+            autoscale_replay,
+            swing_arrivals,
+        )
+        from rcmarl_tpu.serve.autoscale import summary_line as autoscale_line
+        from rcmarl_tpu.serve.load import serve_service_fn
+
+        block0 = engine.members[0].block if args.fleet else engine.block
+        service = serve_service_fn(
+            cfg, block0, args.batch, mode=args.mode,
+            seed=args.eval_seed, serve_impl=engine.serve_impl,
+        )
+        per_launch = best / args.steps  # the timed loop already measured it
+        slo = (args.slo_ms / 1e3) if args.slo_ms > 0 else 4.0 * per_launch
+        # base = HALF one member's batch capacity: the swing's 10x peak
+        # then offers 5x a static member's capacity — the plan where
+        # the autoscaled fleet must hold the SLO while the static
+        # baseline saturates
+        base_rate = 0.5 * args.batch / per_launch
+        arrivals = swing_arrivals(args.eval_seed, base_rate, args.autoscale)
+        window = (float(arrivals[-1]) - float(arrivals[0])) / 40.0
+        replay_kw = dict(
+            window=window,
+            max_batch=args.batch,
+            max_wait=2.0 * per_launch,
+            # the deadline IS the SLO: shed only what would already
+            # miss it — on BOTH arms, so the shed comparison is honest
+            shed_after=slo,
+            slo_p99=slo,
+        )
+        auto = autoscale_replay(
+            service, arrivals,
+            SLOController(slo_p99=slo, max_scale=args.max_scale),
+            **replay_kw,
+        )
+        static = autoscale_replay(service, arrivals, None, **replay_kw)
+
+        def _peak_ms(res):
+            v = max((w["p99"] for w in res["windows"]), default=float("nan"))
+            return round(v * 1e3, 3) if math.isfinite(v) else None
+
+        arow = json.dumps(
+            {
+                "kind": "serve_autoscale",
+                "checkpoint": (
+                    str(args.fleet[0]) if args.fleet else str(args.checkpoint)
+                ),
+                "env": cfg.env,
+                "mode": args.mode,
+                "serve_impl": engine.serve_impl,
+                "batch": args.batch,
+                "slo_ms": round(slo * 1e3, 4),
+                "base_rate": round(base_rate, 1),
+                "seg_requests": args.autoscale,
+                "window_ms": round(window * 1e3, 3),
+                "max_scale": args.max_scale,
+                "autoscaled": {
+                    "slo_held": auto["slo_held"],
+                    "max_scale_used": auto["max_scale_used"],
+                    "final_scale": auto["final_scale"],
+                    "resizes": len(auto["resizes"]),
+                    "windows": len(auto["windows"]),
+                    "requests": auto["requests"],
+                    "shed": auto["shed"],
+                    "shed_fraction": round(
+                        auto["shed"] / max(1, auto["requests"]), 4
+                    ),
+                    "peak_p99_ms": _peak_ms(auto),
+                },
+                "static": {
+                    "scale": 1,
+                    "slo_held": static["slo_held"],
+                    "shed": static["shed"],
+                    "shed_fraction": round(
+                        static["shed"] / max(1, static["requests"]), 4
+                    ),
+                    "peak_p99_ms": _peak_ms(static),
+                },
+                "cost_fingerprint": fingerprint,
+                "platform": jax.devices()[0].platform,
+                "headline": jax.devices()[0].platform == "tpu",
+                "timestamp": datetime.now().isoformat(timespec="seconds"),
+            }
+        )
+        _emit(arow, args.out)
+        print(autoscale_line(auto))
     return 0
 
 

@@ -1,0 +1,446 @@
+"""One-kernel serving: forward + key-derivation + sample in a single
+VMEM-resident Pallas program.
+
+PR 10's ``--consensus_micro``-style measurement showed the serving hot
+path is NOT the 20-wide actor forward: per-request ``fold_in`` key
+derivation plus the categorical sample dominates service time (greedy
+runs 2.5x sample throughput at B=4096). The XLA arm materializes the
+``(B, N, 2)`` uint32 key block and the ``(B, N, A)`` probability block
+in HBM between launches-worth of fusion boundaries; this kernel keeps a
+batch tile resident in VMEM across the whole chain — the row-stacked
+actor forward (the exact :func:`rcmarl_tpu.serve.engine.batch_probs`
+vmap), an in-kernel threefry2x32 ``fold_in(fold_in(key, b), n)`` per
+(request, agent), and the gumbel-argmax categorical draw — writing only
+actions and probabilities back. ``AUDIT.jsonl``'s
+``serve_path[pallas_fused]`` vs ``serve_path[xla_chain]`` rows carry
+the traffic claim as a CI-gated ledger fact
+(:func:`rcmarl_tpu.lint.cost.fused_serve_cost_rows`, the PR-13 gate
+discipline).
+
+Bitwise contract (tests/test_pallas_serve.py): probabilities AND action
+streams are pinned BITWISE against the XLA
+:func:`~rcmarl_tpu.serve.engine.serve_block` arm across the
+{sample, greedy} x {f32, bf16-dot} x {solo, fleet-stacked} matrix.
+Two facts make that possible:
+
+- The forward is the SAME vmapped :func:`rcmarl_tpu.models.mlp.actor_probs`
+  op sequence the XLA arm runs (one implementation to drift, the
+  ``batch_probs`` rule); batch tiling is safe because every request row
+  is computed independently.
+- The sampling chain is integer-exact: threefry2x32 is pure ARX on
+  uint32 (reimplemented here op-for-op against jax's lowering — Pallas
+  cannot call the ``threefry2x32`` primitive), and the uniform→gumbel
+  mantissa chain mirrors ``jax.random.uniform``/``gumbel`` bit for bit,
+  so ``argmax(gumbel + log(probs))`` selects the identical action.
+
+The fleet arm (:func:`fused_fleet_block`) mirrors
+:func:`rcmarl_tpu.serve.fleet.fleet_block` the same way: per-member
+probabilities via the one vmapped core, the route gather as DATA, the
+solo key discipline — so fleet serving of one member stays bitwise its
+solo serve.
+
+``serve_impl`` policy (:func:`resolve_serve_impl`, the netstack/fitstack
+``auto`` tradition): ``'auto'`` resolves to the fused kernel on TPU —
+where the AUDIT.jsonl bytes ledger shows the reduced HBM traffic — and
+to the XLA arm elsewhere (on CPU the kernel only runs interpreted;
+there is no win to select). ``'pallas_interpret'`` is the explicit
+CPU-test arm.
+
+Real lowering rides the queued TPU session (scripts/tpu_session.sh,
+step 12); on this host the kernel runs in interpreter mode, and the
+lint cost arm records real-Pallas-on-CPU compiles as notes, never
+passes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from rcmarl_tpu.config import Config
+from rcmarl_tpu.models.mlp import MLPParams, actor_probs, pad_features
+
+#: The serve implementation arms. 'auto' is the measured policy
+#: (:func:`resolve_serve_impl`); 'pallas_interpret' is the CPU test arm
+#: (interpreter mode — the house pattern for kernels whose real
+#: lowering rides the queued TPU session).
+SERVE_IMPLS = ("auto", "xla", "pallas", "pallas_interpret")
+
+#: Default batch rows per grid step. 128 keeps a tile's activations +
+#: the broadcast actor block comfortably VMEM-resident at the published
+#: reference shape (5 agents, 20-wide nets); the host wrapper shrinks
+#: it to a divisor of B so no request row is ever padded (padding a
+#: batch row would perturb nothing — rows are independent — but an
+#: exact grid keeps the DMA arithmetic exact too).
+_DEFAULT_BLOCK_B = 128
+
+
+def resolve_serve_impl(impl: str = "auto", platform: Optional[str] = None) -> str:
+    """The measured ``serve_impl='auto'`` policy (netstack/fitstack
+    tradition): the fused kernel where its bytes-ledger win is real —
+    TPU — and the XLA arm elsewhere (on CPU the kernel only runs
+    interpreted, which is a correctness arm, not a fast one).
+    Explicit arms pass through unchanged."""
+    if impl not in SERVE_IMPLS:
+        raise ValueError(f"serve_impl={impl!r}: expected one of {SERVE_IMPLS}")
+    if impl != "auto":
+        return impl
+    if platform is None:
+        platform = jax.devices()[0].platform
+    return "pallas" if platform == "tpu" else "xla"
+
+
+# --------------------------------------------------------------------------
+# In-kernel threefry2x32 — op-for-op against jax's lowering
+# --------------------------------------------------------------------------
+#
+# Pallas kernels cannot bind the ``threefry2x32`` primitive, so the
+# block cipher is restated as the pure ARX chain jax lowers it to
+# (rotation schedule and the five key-injection rounds copied from
+# jax._src.prng's threefry2x32 lowering; verified bit-exact against
+# jax.random.fold_in / categorical before this module was written).
+# Everything below is uint32 adds, xors, and shifts — integer-exact on
+# every backend, immune to the fusion-context rounding that rules
+# floating-point reassociation out of bitwise contracts.
+
+_ROTATIONS = ((13, 15, 26, 6), (17, 29, 16, 24))
+_PARITY = np.uint32(0x1BD11BDA)
+
+
+def _rotl(x: jnp.ndarray, d: int) -> jnp.ndarray:
+    return (x << np.uint32(d)) | (x >> np.uint32(32 - d))
+
+
+def _threefry2x32(
+    k0: jnp.ndarray, k1: jnp.ndarray, x0: jnp.ndarray, x1: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One threefry2x32 block: key (k0, k1), counter (x0, x1) -> two
+    uint32 output words. Elementwise — all operands broadcast."""
+    ks = (k0, k1, k0 ^ k1 ^ _PARITY)
+    x0 = x0 + ks[0]
+    x1 = x1 + ks[1]
+    # five groups of four ARX rounds, key injection after each group
+    injections = (
+        (ks[1], ks[2], 1),
+        (ks[2], ks[0], 2),
+        (ks[0], ks[1], 3),
+        (ks[1], ks[2], 4),
+        (ks[2], ks[0], 5),
+    )
+    for g, (i0, i1, c) in enumerate(injections):
+        for r in _ROTATIONS[g % 2]:
+            x0 = x0 + x1
+            x1 = _rotl(x1, r)
+            x1 = x0 ^ x1
+        x0 = x0 + i0
+        x1 = x1 + i1 + np.uint32(c)
+    return x0, x1
+
+
+def _fold_in(
+    k0: jnp.ndarray, k1: jnp.ndarray, data: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``jax.random.fold_in`` on raw key words: threefry with counter
+    ``(0, uint32(data))`` (jax's ``threefry_seed`` puts the 32-bit data
+    word in the low half, zero in the high)."""
+    zero = jnp.zeros_like(data, dtype=jnp.uint32)
+    return _threefry2x32(k0, k1, zero, data.astype(jnp.uint32))
+
+
+_TINY = np.float32(np.finfo(np.float32).tiny)
+
+
+def _gumbel_bits(
+    k0: jnp.ndarray, k1: jnp.ndarray, n_actions: int
+) -> jnp.ndarray:
+    """The per-key gumbel row ``jax.random.categorical`` would draw:
+    ``random_bits(key, (A,))`` via threefry over ``iota(uint32, A)``
+    (odd sizes zero-padded then trimmed, exactly jax's split), the
+    mantissa-fill uniform on ``[tiny, 1)``, and ``-log(-log(u))``.
+
+    ``k0``/``k1`` are ``(..., 1)`` so the static counter rows broadcast;
+    returns ``(..., n_actions)`` f32.
+    """
+    odd = n_actions % 2
+    counts = jax.lax.iota(jnp.uint32, n_actions + odd)
+    half = (n_actions + odd) // 2
+    x0, x1 = counts[:half], counts[half:]
+    if odd:
+        # jax pads an odd counter row with a ZERO word before splitting
+        x1 = x1.at[-1].set(np.uint32(0))
+    o0, o1 = _threefry2x32(k0, k1, x0, x1)
+    bits = jnp.concatenate([o0, o1], axis=-1)[..., :n_actions]
+    # jax.random.uniform(minval=tiny, maxval=1.0) for f32: 23 mantissa
+    # bits ORed into the [1, 2) exponent, minus 1, affine to the range
+    mantissa = (bits >> np.uint32(9)) | np.uint32(0x3F800000)
+    floats = jax.lax.bitcast_convert_type(mantissa, jnp.float32) - 1.0
+    u = jnp.maximum(_TINY, floats * (1.0 - _TINY) + _TINY)
+    return -jnp.log(-jnp.log(u))
+
+
+def _sample_tile(
+    k0: jnp.ndarray,
+    k1: jnp.ndarray,
+    probs: jnp.ndarray,
+    base_b: jnp.ndarray,
+) -> jnp.ndarray:
+    """The in-kernel twin of the XLA sample arm for one batch tile:
+    per-(request, agent) keys ``fold_in(fold_in(key, b), n)`` with the
+    GLOBAL request index b (``base_b`` + tile row), then
+    ``argmax(gumbel + log(probs))`` — bitwise
+    ``jax.random.categorical(keys, jnp.log(probs))``."""
+    bb, n_agents, n_actions = probs.shape
+    b_idx = base_b + jax.lax.iota(jnp.uint32, bb)
+    kb0, kb1 = _fold_in(k0, k1, b_idx)  # (bb,)
+    logits = jnp.log(probs)
+    cols = []
+    for n in range(n_agents):
+        kn0, kn1 = _fold_in(kb0, kb1, jnp.full((bb,), n, jnp.uint32))
+        g = _gumbel_bits(kn0[:, None], kn1[:, None], n_actions)
+        cols.append(jnp.argmax(g + logits[:, n, :], axis=-1))
+    return jnp.stack(cols, axis=1).astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------
+# The kernel
+# --------------------------------------------------------------------------
+
+
+def _tile_probs(block: MLPParams, x: jnp.ndarray, alpha, dtype) -> jnp.ndarray:
+    """The one batched policy core on a tile — textually
+    :func:`rcmarl_tpu.serve.engine.batch_probs`'s vmap (row n = agent
+    n), restated here only because the kernel cannot import the engine
+    (the engine imports this module for the arm dispatch)."""
+    return jax.vmap(
+        lambda p, xn: actor_probs(p, xn, alpha, dtype),
+        in_axes=(0, 1),
+        out_axes=1,
+    )(block, x)
+
+
+def _serve_kernel(
+    *refs,
+    treedef,
+    n_leaves: int,
+    mode: str,
+    alpha,
+    dtype,
+    block_b: int,
+    fleet: bool,
+):
+    """One ``(block_b, N, W)`` batch tile: forward, key derivation, and
+    sample, VMEM-resident end to end — only actions + probabilities
+    leave the tile."""
+    it = iter(refs)
+    leaves = [next(it)[...] for _ in range(n_leaves)]
+    x = next(it)[...]  # (block_b, N, W)
+    route = next(it)[...] if fleet else None
+    key_ref = next(it) if mode == "sample" else None
+    actions_ref = next(it)
+    probs_ref = next(it)
+
+    block = jax.tree.unflatten(treedef, leaves)
+    if fleet:
+        # the fleet_block op sequence: the one solo core vmapped over
+        # the fleet axis, routing as a gather on DATA
+        probs_all = jax.vmap(lambda blk: _tile_probs(blk, x, alpha, dtype))(
+            block
+        )  # (F, block_b, N, A)
+        probs = probs_all[route, jnp.arange(x.shape[0])]
+    else:
+        probs = _tile_probs(block, x, alpha, dtype)
+
+    if mode == "greedy":
+        actions = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+    else:
+        base_b = (pl.program_id(0) * block_b).astype(jnp.uint32)
+        actions = _sample_tile(key_ref[0, 0], key_ref[0, 1], probs, base_b)
+    actions_ref[...] = actions
+    probs_ref[...] = probs
+
+
+def _tile_rows(batch: int, block_b: int) -> int:
+    """The largest tile height <= ``block_b`` dividing ``batch`` (an
+    exact grid — no padded request rows, exact DMA arithmetic)."""
+    bb = max(1, min(block_b, batch))
+    while batch % bb:
+        bb -= 1
+    return bb
+
+
+def _key_words(key: jax.Array) -> jnp.ndarray:
+    """The raw (1, 2) uint32 key words of a legacy or typed PRNG key."""
+    kd = key if jnp.issubdtype(key.dtype, jnp.integer) else jax.random.key_data(key)
+    return kd.astype(jnp.uint32).reshape(1, 2)
+
+
+def _fused_serve(
+    cfg: Config,
+    block: MLPParams,
+    obs: jnp.ndarray,
+    key: Optional[jax.Array],
+    route: Optional[jnp.ndarray],
+    mode: str,
+    block_b: int,
+    interpret: bool,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared host wrapper behind :func:`fused_serve_block` /
+    :func:`fused_fleet_block`: feature padding (host-side, exactly the
+    XLA arm's), the exact batch grid, broadcast BlockSpecs for the
+    actor block, and the Pallas launch."""
+    from rcmarl_tpu.serve.engine import SERVE_MODES
+
+    if mode not in SERVE_MODES:
+        raise ValueError(f"mode={mode!r}: expected one of {SERVE_MODES}")
+    fleet = route is not None
+    B, N = obs.shape[0], obs.shape[1]
+    width_leaf = block[0][0]
+    x = pad_features(obs, width_leaf.shape[-2])
+    n_actions = block[-1][1].shape[-1]
+    bb = _tile_rows(B, block_b)
+    grid = (B // bb,)
+
+    leaves, treedef = jax.tree.flatten(block)
+    inputs = list(leaves)
+    in_specs = [
+        pl.BlockSpec(l.shape, functools.partial(lambda nd, i: (0,) * nd, l.ndim))
+        for l in leaves
+    ]
+    inputs.append(x)
+    in_specs.append(pl.BlockSpec((bb, N, x.shape[-1]), lambda i: (i, 0, 0)))
+    if fleet:
+        inputs.append(route.astype(jnp.int32))
+        in_specs.append(pl.BlockSpec((bb,), lambda i: (i,)))
+    if mode == "sample":
+        inputs.append(_key_words(key))
+        in_specs.append(pl.BlockSpec((1, 2), lambda i: (0, 0)))
+
+    kernel = functools.partial(
+        _serve_kernel,
+        treedef=treedef,
+        n_leaves=len(leaves),
+        mode=mode,
+        alpha=cfg.leaky_alpha,
+        dtype=cfg.dot_dtype,
+        block_b=bb,
+        fleet=fleet,
+    )
+    actions, probs = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((B, N), jnp.int32),
+            jax.ShapeDtypeStruct((B, N, n_actions), jnp.float32),
+        ),
+        in_specs=in_specs,
+        out_specs=(
+            pl.BlockSpec((bb, N), lambda i: (i, 0)),
+            pl.BlockSpec((bb, N, n_actions), lambda i: (i, 0, 0)),
+        ),
+        grid=grid,
+        interpret=interpret,
+    )(*inputs)
+    return actions, probs
+
+
+def _fused_serve_block(
+    cfg: Config,
+    block: MLPParams,
+    obs: jnp.ndarray,
+    key: jax.Array,
+    mode: str = "sample",
+    block_b: int = _DEFAULT_BLOCK_B,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The fused serving program: ``(B, N, obs_dim)`` observations ->
+    ``(actions, probs)`` in ONE Pallas launch, bitwise the XLA
+    :func:`~rcmarl_tpu.serve.engine.serve_block` arm (module
+    docstring). ``cfg``/``mode``/``block_b``/``interpret`` are static —
+    one program per arm, zero steady-state recompiles across batches
+    and hot-swaps (the retrace-audited contract)."""
+    return _fused_serve(cfg, block, obs, key, None, mode, block_b, interpret)
+
+
+#: The jitted fused serving entry point (registered in
+#: ``utils/profiling.py:jit_entry_points`` — retrace/cost audited like
+#: every hot path). Block, observations, and key are DATA.
+fused_serve_block = functools.partial(
+    jax.jit,
+    static_argnums=0,
+    static_argnames=("mode", "block_b", "interpret"),
+)(_fused_serve_block)
+
+
+def _fused_fleet_block(
+    cfg: Config,
+    fleet: MLPParams,
+    obs: jnp.ndarray,
+    key: jax.Array,
+    route: jnp.ndarray,
+    mode: str = "sample",
+    block_b: int = _DEFAULT_BLOCK_B,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The fused fleet serving program — the
+    :func:`~rcmarl_tpu.serve.fleet.fleet_block` twin: F fleet-stacked
+    members, per-request routing as DATA, the solo key discipline, one
+    Pallas launch. Row b is member ``route[b]``'s output, bitwise its
+    solo :func:`fused_serve_block` row (and therefore bitwise the solo
+    XLA ``serve_block`` row — the per-member parity contract)."""
+    return _fused_serve(cfg, fleet, obs, key, route, mode, block_b, interpret)
+
+
+#: The jitted fused fleet entry point. Fleet, observations, key, AND
+#: the route are data, so re-routes and member hot-swaps re-dispatch
+#: the SAME executable.
+fused_fleet_block = functools.partial(
+    jax.jit,
+    static_argnums=0,
+    static_argnames=("mode", "block_b", "interpret"),
+)(_fused_fleet_block)
+
+
+# --------------------------------------------------------------------------
+# Cost model — the kernel's exact DMA arithmetic
+# --------------------------------------------------------------------------
+
+
+def fused_serve_dma_bytes(
+    cfg: Config,
+    batch: int,
+    mode: str = "sample",
+    n_members: int = 0,
+    block_b: int = _DEFAULT_BLOCK_B,
+) -> float:
+    """The kernel's exact HBM traffic in bytes, from its BlockSpecs:
+    the observation tile is DMAd once per grid step (once per request
+    row total), the broadcast actor block + key words once PER GRID
+    STEP (the conservative reading, as the consensus kernel counts its
+    mask planes), and the action/probability tiles written once. What
+    never touches HBM at all — the ``(B, N, 2)`` key block and any
+    probability re-read — is exactly the fused win the
+    ``serve_path[pallas_fused]`` ledger row claims. Deterministic
+    arithmetic, not an estimate (``bytes_model:
+    'pallas-blockspec-dma'``)."""
+    N = cfg.n_agents
+    dims = [cfg.obs_dim, *cfg.hidden, cfg.n_actions]
+    bb = _tile_rows(batch, block_b)
+    n_tiles = batch // bb
+    stack = max(1, n_members) * N
+    param_bytes = sum(
+        (d_in * d_out + d_out) * 4.0
+        for d_in, d_out in zip(dims[:-1], dims[1:])
+    ) * stack
+    bytes_total = batch * N * dims[0] * 4.0  # observations read once
+    bytes_total += param_bytes * n_tiles  # block re-DMAd per tile
+    bytes_total += batch * N * 4.0  # actions written
+    bytes_total += batch * N * dims[-1] * 4.0  # probs written
+    if n_members:
+        bytes_total += batch * 4.0  # route read
+    if mode == "sample":
+        bytes_total += 8.0 * n_tiles  # key words per tile
+    return bytes_total

@@ -1,0 +1,361 @@
+"""SLO-driven autoscaling — the capacity control loop over the load
+harness.
+
+PR 14 measured the latency knee and PR 15 bounded the overload tail
+with deadline shedding, but the fleet itself never RESIZED: a static
+deployment either wastes capacity at the trough or saturates at the
+peak of a load swing. This module closes the loop in the deterministic
+tradition of :mod:`rcmarl_tpu.serve.load`: a pure controller
+(:class:`SLOController`) reads one load window's report and decides
+the next window's fleet scale, and :func:`autoscale_replay` replays a
+SEEDED arrival plan through windowed
+:func:`~rcmarl_tpu.serve.load._simulate_queue` runs under that
+controller — so every scale-up/scale-down decision is unit-testable,
+chaos-sweepable (the ``serve_overload@autoscale`` cells), and
+replayable bit-for-bit from ``(seed, plan, controller)`` alone. No
+wall clock, no RNG, no thresholds hidden in the serving path.
+
+Mechanics:
+
+- **Scale = fleet members.** ``scale`` independent micro-batching
+  queues (one per member, each with its own compiled-launch service
+  model) split each window's arrivals round-robin — the fleet axis of
+  :mod:`rcmarl_tpu.serve.fleet`, simulated. Capacity scales linearly;
+  the window report merges the members' RAW latency arrays, so the
+  windowed percentiles are exact, not percentile-of-percentiles.
+- **Resizes happen ONLY at window boundaries.** Every batch launched
+  inside a window runs to completion inside that window's simulation,
+  and each member's server-free time carries across windows
+  (:func:`~rcmarl_tpu.serve.load._simulate_queue`'s ``t0``), so a
+  resize can never tear a batch mid-flight — the
+  never-resizes-mid-batch contract is structural, and
+  tests/test_autoscale.py pins it.
+- **Control signals lead the SLO.** Scale-up fires on a p99 breach or
+  a shed (multiplicative — the fleet was already late), but ALSO on
+  the DEMAND early signal: offered load x measured service time over
+  the fleet's batch capacity (``rate * service_mean / (scale *
+  max_batch)``) — the busy fraction the window would need with FULL
+  batches. Demand is the honest capacity signal where raw utilization
+  is not: a lightly loaded member still burns a launch every
+  ``max_wait`` on a small fill, so measured busy-time floors near
+  ``service / max_wait`` at ANY scale, while demand falls linearly
+  with scale. Under a ramped swing the demand trigger grows capacity
+  ahead of the breach, which is how the replay holds a p99 SLO across
+  a 10x offered-load swing that saturates the static fleet (the
+  committed ``simulation_results/autoscale_slo.json`` evidence).
+  Scale-down waits out ``hysteresis`` consecutive low-demand windows
+  and only steps when the SMALLER fleet's projected demand stays under
+  the low-water mark — no flapping at a capacity edge.
+
+The summary line (:func:`summary_line`) is what the CI cell greps:
+``autoscale: SLO held ...`` only when EVERY window met the p99 target
+with zero sheds.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from rcmarl_tpu.serve.load import _simulate_queue
+
+#: Controller defaults: the high/low DEMAND water marks (offered load x
+#: service time over ``scale * max_batch`` — module docstring) and the
+#: scale-down hysteresis (consecutive low-demand windows before one
+#: step down). Demand exceeds 1.0 in overload — itself a scale-up
+#: signal.
+HIGH_UTILIZATION = 0.60
+LOW_UTILIZATION = 0.35
+HYSTERESIS = 3
+
+
+class SLOController:
+    """The pure capacity controller: one :meth:`decide` per load
+    window, deterministic in the window report alone.
+
+    Args:
+      slo_p99: the latency objective (seconds) the fleet must hold.
+      min_scale / max_scale: the fleet-size envelope.
+      high_utilization / low_utilization: the demand water marks — the
+        scale-up early signal and the scale-down eligibility mark
+        (module docstring: demand, not raw busy-time, is the signal
+        that scales with fleet size).
+      hysteresis: consecutive healthy low-demand windows required
+        before ONE step down (the anti-flap guard).
+    """
+
+    def __init__(
+        self,
+        slo_p99: float,
+        min_scale: int = 1,
+        max_scale: int = 16,
+        high_utilization: float = HIGH_UTILIZATION,
+        low_utilization: float = LOW_UTILIZATION,
+        hysteresis: int = HYSTERESIS,
+    ) -> None:
+        if not slo_p99 > 0.0:
+            raise ValueError(f"slo_p99={slo_p99} must be > 0")
+        if not 1 <= min_scale <= max_scale:
+            raise ValueError(
+                f"need 1 <= min_scale <= max_scale "
+                f"(got {min_scale}, {max_scale})"
+            )
+        if not 0.0 < low_utilization < high_utilization:
+            raise ValueError(
+                f"need 0 < low_utilization < high_utilization "
+                f"(got {low_utilization}, {high_utilization})"
+            )
+        if hysteresis < 1:
+            raise ValueError(f"hysteresis={hysteresis} must be >= 1")
+        self.slo_p99 = float(slo_p99)
+        self.min_scale = int(min_scale)
+        self.max_scale = int(max_scale)
+        self.high_utilization = float(high_utilization)
+        self.low_utilization = float(low_utilization)
+        self.hysteresis = int(hysteresis)
+        self.scale = self.min_scale
+        self._healthy = 0
+
+    def decide(self, report: Dict[str, float]) -> Optional[str]:
+        """Consume one window report (keys ``p99``, ``demand``,
+        ``shed``); mutates :attr:`scale` for the NEXT window. Returns
+        the resize reason (``'p99-breach'``, ``'shed'``,
+        ``'high-demand'``, ``'scale-down'``) or None when the scale
+        holds.
+
+        Up moves are multiplicative on a breach (the fleet was already
+        late — recover in one step) and PROPORTIONAL on the demand
+        early-signal: the next scale is sized so the measured demand
+        would land back at the low-water mark (a ramp that doubles
+        offered load in one window gets a doubled fleet, not one more
+        member); down moves are single steps gated by hysteresis AND by
+        the smaller fleet's projected demand staying under the
+        LOW-water mark."""
+        p99 = float(report["p99"])
+        demand = float(report["demand"])
+        shed = int(report.get("shed", 0))
+        if shed > 0 or p99 > self.slo_p99:
+            self._healthy = 0
+            if self.scale < self.max_scale:
+                self.scale = min(self.max_scale, self.scale * 2)
+                return "shed" if shed > 0 else "p99-breach"
+            return None
+        if demand >= self.high_utilization:
+            self._healthy = 0
+            if self.scale < self.max_scale:
+                needed = math.ceil(
+                    demand * self.scale / self.low_utilization
+                )
+                self.scale = min(
+                    self.max_scale, max(self.scale + 1, needed)
+                )
+                return "high-demand"
+            return None
+        if self.scale > self.min_scale:
+            projected = demand * self.scale / (self.scale - 1)
+            if projected < self.low_utilization:
+                self._healthy += 1
+                if self._healthy >= self.hysteresis:
+                    self._healthy = 0
+                    self.scale -= 1
+                    return "scale-down"
+                return None
+        self._healthy = 0
+        return None
+
+
+def swing_arrivals(
+    seed: int,
+    base_rate: float,
+    seg_requests: int,
+    factors: Sequence[float] = (1, 2, 4, 8, 10, 10, 8, 4, 2, 1),
+) -> np.ndarray:
+    """A deterministic offered-load SWING: consecutive Poisson segments
+    of ``seg_requests`` requests each at ``factor * base_rate``, glued
+    end to end in absolute simulated seconds. The default profile ramps
+    1x -> 10x -> 1x — the evidence plan where the autoscaled fleet must
+    hold the SLO while the static fleet saturates at the peak.
+    Deterministic in ``(seed, base_rate, seg_requests, factors)``."""
+    from rcmarl_tpu.serve.load import poisson_arrivals
+
+    if seg_requests < 1:
+        raise ValueError(f"seg_requests={seg_requests} must be >= 1")
+    out: List[np.ndarray] = []
+    t0 = 0.0
+    for k, f in enumerate(factors):
+        seg = poisson_arrivals(seed + k, seg_requests, f * base_rate)
+        out.append(t0 + seg)
+        t0 += float(seg[-1])
+    return np.concatenate(out)
+
+
+def autoscale_replay(
+    service_fn: Callable[[int], float],
+    arrivals: np.ndarray,
+    controller: Optional[SLOController],
+    window: float,
+    max_batch: int,
+    max_wait: float,
+    shed_after: float = math.inf,
+    static_scale: int = 1,
+    slo_p99: Optional[float] = None,
+) -> Dict[str, object]:
+    """Replay one seeded arrival plan through the windowed fleet under
+    the controller — the unit the tests, the chaos ``@autoscale`` arm,
+    and the committed SLO evidence all share.
+
+    Args:
+      service_fn: seconds per launch of ONE member's padded
+        ``max_batch`` program (an injected deterministic model in the
+        unit/chaos cells; a measured
+        :func:`~rcmarl_tpu.serve.load.serve_service_fn` closure for the
+        evidence rows — every simulated member bills the same solo
+        launch cost, the fleet-axis reading).
+      arrivals: absolute arrival times (seeded plan).
+      controller: the :class:`SLOController` — or None for the STATIC
+        baseline fleet at ``static_scale`` (the comparison arm).
+      window: the decision epoch in simulated seconds; resizes apply
+        only at window boundaries (module docstring).
+      max_batch / max_wait / shed_after: the per-member queue knobs
+        (:func:`~rcmarl_tpu.serve.load.run_load` semantics).
+      slo_p99: the objective for the per-window ``slo_ok`` verdict;
+        defaults to the controller's.
+
+    Returns ``{"slo_p99", "windows": [...], "resizes": [...],
+    "slo_held", "requests", "served", "shed", "max_scale_used",
+    "final_scale"}`` — windows carry ``scale``, exact merged
+    ``p50/p95/p99``, ``utilization`` (busy over ``scale * window``),
+    shed counts, and ``slo_ok`` (p99 under the SLO AND shed-free).
+    """
+    arrivals = np.asarray(arrivals, dtype=np.float64)
+    if arrivals.size == 0:
+        raise ValueError("autoscale_replay needs at least one arrival")
+    if not window > 0.0:
+        raise ValueError(f"window={window} must be > 0")
+    slo = (
+        float(slo_p99)
+        if slo_p99 is not None
+        else (controller.slo_p99 if controller is not None else math.inf)
+    )
+    scale = controller.scale if controller is not None else int(static_scale)
+    if scale < 1:
+        raise ValueError(f"static_scale={static_scale} must be >= 1")
+    t_lo = float(arrivals[0])
+    # each member's server-free time, carried across windows so a
+    # window that ran long keeps its member busy into the next one
+    free = [t_lo] * scale
+    windows: List[Dict[str, float]] = []
+    resizes: List[Dict[str, object]] = []
+    shed_total = 0
+    served_total = 0
+    n_win = int(math.ceil((float(arrivals[-1]) - t_lo) / window)) or 1
+    for w in range(n_win):
+        w_lo = t_lo + w * window
+        w_hi = w_lo + window
+        sel = (arrivals >= w_lo) & (
+            arrivals < w_hi if w + 1 < n_win else arrivals <= w_hi
+        )
+        win_arr = arrivals[sel]
+        if win_arr.size == 0:
+            continue
+        lats: List[np.ndarray] = []
+        services: List[float] = []
+        busy = 0.0
+        shed = 0
+        for m in range(scale):
+            member_arr = win_arr[m::scale]  # round-robin split
+            if member_arr.size == 0:
+                continue
+            raw = _simulate_queue(
+                service_fn, member_arr, max_batch, max_wait, shed_after,
+                t0=max(free[m], w_lo),
+            )
+            free[m] = raw["t_end"]
+            lats.append(raw["lat"])
+            services.extend(raw["services"])
+            busy += raw["busy"]
+            shed += raw["shed"]
+        lat = np.concatenate(lats)
+        served = lat[~np.isnan(lat)]
+        shed_total += shed
+        served_total += int(served.size)
+        if served.size:
+            p50, p95, p99 = np.percentile(served, [50.0, 95.0, 99.0])
+        else:
+            p50 = p95 = p99 = math.inf  # every request shed: a breach
+        offered = win_arr.size / window
+        service_mean = float(np.mean(services)) if services else 0.0
+        row = {
+            "window": w,
+            "t0": round(w_lo - t_lo, 6),
+            "requests": int(win_arr.size),
+            "scale": scale,
+            "offered_load": float(offered),
+            "service_mean": service_mean,
+            "p50": float(p50),
+            "p95": float(p95),
+            "p99": float(p99),
+            "utilization": float(busy / (scale * window)),
+            "demand": float(
+                offered * service_mean / (scale * max_batch)
+            ),
+            "shed": int(shed),
+            "shed_fraction": float(shed / win_arr.size),
+            "slo_ok": bool(p99 <= slo and shed == 0),
+        }
+        windows.append(row)
+        if controller is not None:
+            prev = controller.scale
+            reason = controller.decide(row)
+            if controller.scale != prev:
+                resizes.append(
+                    {
+                        "after_window": w,
+                        "from": prev,
+                        "to": controller.scale,
+                        "reason": reason,
+                    }
+                )
+                if controller.scale > prev:
+                    # new members come up free at the NEXT boundary
+                    free.extend([w_hi] * (controller.scale - prev))
+                else:
+                    free = free[: controller.scale]
+                scale = controller.scale
+    return {
+        "slo_p99": slo,
+        "windows": windows,
+        "resizes": resizes,
+        "slo_held": bool(windows) and all(r["slo_ok"] for r in windows),
+        "requests": int(arrivals.size),
+        "served": served_total,
+        "shed": shed_total,
+        "max_scale_used": max(r["scale"] for r in windows) if windows else scale,
+        "final_scale": scale,
+    }
+
+
+def summary_line(result: Dict[str, object]) -> str:
+    """The one grep-able line (the CI cell's contract): ``SLO held``
+    appears ONLY when every window met the p99 target shed-free."""
+    wins = result["windows"]
+    n_bad = sum(1 for r in wins if not r["slo_ok"])
+    peak = max((r["p99"] for r in wins), default=float("nan"))
+    span = (
+        f"scale {wins[0]['scale']}->{result['max_scale_used']}"
+        if wins
+        else "no windows"
+    )
+    if result["slo_held"]:
+        return (
+            f"autoscale: SLO held (p99 <= {result['slo_p99'] * 1e3:.3g}ms) "
+            f"across {len(wins)} windows, {span}, "
+            f"{result['shed']} shed, peak p99 {peak * 1e3:.3g}ms"
+        )
+    return (
+        f"autoscale: SLO violated in {n_bad}/{len(wins)} windows "
+        f"(p99 target {result['slo_p99'] * 1e3:.3g}ms, peak p99 "
+        f"{peak * 1e3:.3g}ms), {span}, {result['shed']} shed"
+    )

@@ -244,6 +244,16 @@ class ServeEngine:
     ``rejects`` (corrupted / non-finite candidates refused — the engine
     kept serving the last good block), ``fallbacks`` (loads served by
     the rotated ``.prev`` instead of the primary).
+
+    ``serve_impl`` selects the serving arm
+    (:data:`rcmarl_tpu.ops.pallas_serve.SERVE_IMPLS`): the XLA
+    :func:`serve_block` chain or the fused one-kernel Pallas program
+    (:func:`~rcmarl_tpu.ops.pallas_serve.fused_serve_block`, bitwise
+    the same probabilities AND actions — the pinned contract), with
+    ``'auto'`` resolving by the measured policy
+    (:func:`~rcmarl_tpu.ops.pallas_serve.resolve_serve_impl`). The
+    resolved arm is an engine attribute, not a Config field, so
+    existing checkpoints and audit rows are untouched.
     """
 
     def __init__(
@@ -252,6 +262,7 @@ class ServeEngine:
         cfg: Optional[Config] = None,
         mode: str = "sample",
         eval_seed: int = 0,
+        serve_impl: str = "auto",
     ) -> None:
         from rcmarl_tpu.faults import params_finite
         from rcmarl_tpu.utils.checkpoint import load_checkpoint_with_meta
@@ -275,9 +286,12 @@ class ServeEngine:
                 f"checkpoint {loaded} holds non-finite parameters; "
                 "refusing to serve a poisoned policy"
             )
+        from rcmarl_tpu.ops.pallas_serve import resolve_serve_impl
+
         self.cfg = stored_cfg if cfg is None else cfg
         self.mode = mode
         self.eval_seed = eval_seed
+        self.serve_impl = resolve_serve_impl(serve_impl)
         self.block = stack_actor_rows(state.params, self.cfg)
         #: True while the engine is serving an OLDER block than the
         #: newest candidate it saw (a rejected swap); cleared by the
@@ -312,9 +326,17 @@ class ServeEngine:
                 self.eval_seed,
                 self.counters["launches"] if step is None else step,
             )
-        out = serve_block(
-            self.cfg, self.block, obs, key, mode=mode or self.mode
-        )
+        if self.serve_impl == "xla":
+            out = serve_block(
+                self.cfg, self.block, obs, key, mode=mode or self.mode
+            )
+        else:
+            from rcmarl_tpu.ops.pallas_serve import fused_serve_block
+
+            out = fused_serve_block(
+                self.cfg, self.block, obs, key, mode=mode or self.mode,
+                interpret=(self.serve_impl == "pallas_interpret"),
+            )
         self.counters["launches"] += 1
         self.counters["actions"] += int(obs.shape[0]) * int(obs.shape[1])
         return out

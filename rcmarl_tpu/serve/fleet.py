@@ -154,11 +154,19 @@ class FleetEngine:
         cfg: Optional[Config] = None,
         mode: str = "sample",
         eval_seed: int = 0,
+        serve_impl: str = "auto",
     ) -> None:
+        from rcmarl_tpu.ops.pallas_serve import resolve_serve_impl
+
         if not checkpoints:
             raise ValueError("FleetEngine needs at least one checkpoint")
         if mode not in SERVE_MODES:
             raise ValueError(f"mode={mode!r}: expected one of {SERVE_MODES}")
+        #: the resolved serving arm — the fused Pallas fleet program
+        #: (:func:`rcmarl_tpu.ops.pallas_serve.fused_fleet_block`) or
+        #: the XLA :func:`fleet_block` chain, bitwise interchangeable
+        #: (the pinned contract); an engine attribute, not Config state
+        self.serve_impl = resolve_serve_impl(serve_impl)
         self.members: List[ServeEngine] = [
             ServeEngine(p, cfg=cfg, mode=mode, eval_seed=eval_seed)
             for p in checkpoints
@@ -206,9 +214,18 @@ class FleetEngine:
                 self.eval_seed,
                 self.counters["launches"] if step is None else step,
             )
-        out = fleet_block(
-            self.cfg, self.fleet, obs, key, route, mode=mode or self.mode
-        )
+        if self.serve_impl == "xla":
+            out = fleet_block(
+                self.cfg, self.fleet, obs, key, route, mode=mode or self.mode
+            )
+        else:
+            from rcmarl_tpu.ops.pallas_serve import fused_fleet_block
+
+            out = fused_fleet_block(
+                self.cfg, self.fleet, obs, key, route,
+                mode=mode or self.mode,
+                interpret=(self.serve_impl == "pallas_interpret"),
+            )
         self.counters["launches"] += 1
         self.counters["actions"] += int(obs.shape[0]) * int(obs.shape[1])
         return out

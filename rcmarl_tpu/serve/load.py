@@ -146,6 +146,55 @@ def run_load(
     ``shed_fraction`` (the deadline-shedding ledger — present on EVERY
     row, 0.0 when shedding is off or never fires).
     """
+    raw = _simulate_queue(service_fn, arrivals, max_batch, max_wait, shed_after)
+    arrivals = np.asarray(arrivals, dtype=np.float64)
+    lat, fills, depths, services = (
+        raw["lat"], raw["fills"], raw["depths"], raw["services"]
+    )
+    n, shed, t, busy = arrivals.shape[0], raw["shed"], raw["t_end"], raw["busy"]
+    served = lat[~np.isnan(lat)]
+    if served.size == 0:
+        raise ValueError(
+            f"run_load shed every request (shed_after={shed_after}): the "
+            "deadline is shorter than one service time — no latency to "
+            "report"
+        )
+    makespan = t - float(arrivals[0])
+    p50, p95, p99 = np.percentile(served, [50.0, 95.0, 99.0])
+    return {
+        "requests": int(n),
+        "p50": float(p50),
+        "p95": float(p95),
+        "p99": float(p99),
+        "mean_latency": float(served.mean()),
+        "launches": len(fills),
+        "fill_mean": float(np.mean(fills)),
+        "queue_depth_mean": float(np.mean(depths)),
+        "queue_depth_max": int(np.max(depths)),
+        "utilization": float(busy / makespan) if makespan > 0 else 1.0,
+        "service_mean": float(np.mean(services)),
+        "served": int(served.size),
+        "shed": int(shed),
+        "shed_fraction": float(shed / n),
+    }
+
+
+def _simulate_queue(
+    service_fn: Callable[[int], float],
+    arrivals: np.ndarray,
+    max_batch: int,
+    max_wait: float,
+    shed_after: float = math.inf,
+    t0: float = 0.0,
+) -> Dict[str, object]:
+    """The raw queue simulation behind :func:`run_load` — identical
+    close/shed rules, but returning the UNREDUCED per-request latency
+    array plus the busy/fill/depth ledgers, and starting with the
+    server free at ``t0`` (so a windowed replay can carry a server's
+    free time across window boundaries). :func:`run_load` is exactly
+    this with ``t0=0`` reduced to the percentile report; the autoscale
+    replay (:mod:`rcmarl_tpu.serve.autoscale`) merges the raw arrays
+    across fleet members for exact merged percentiles."""
     if max_batch < 1:
         raise ValueError(f"max_batch={max_batch} must be >= 1")
     if max_wait < 0.0:
@@ -156,7 +205,7 @@ def run_load(
     n = arrivals.shape[0]
     lat = np.full(n, np.nan, dtype=np.float64)
     i = 0
-    t = 0.0
+    t = float(t0)
     busy = 0.0
     shed = 0
     fills: List[int] = []
@@ -196,30 +245,14 @@ def run_load(
         fills.append(fill)
         t = close_t + s
         i = j
-    served = lat[~np.isnan(lat)]
-    if served.size == 0:
-        raise ValueError(
-            f"run_load shed every request (shed_after={shed_after}): the "
-            "deadline is shorter than one service time — no latency to "
-            "report"
-        )
-    makespan = t - float(arrivals[0])
-    p50, p95, p99 = np.percentile(served, [50.0, 95.0, 99.0])
     return {
-        "requests": int(n),
-        "p50": float(p50),
-        "p95": float(p95),
-        "p99": float(p99),
-        "mean_latency": float(served.mean()),
-        "launches": len(fills),
-        "fill_mean": float(np.mean(fills)),
-        "queue_depth_mean": float(np.mean(depths)),
-        "queue_depth_max": int(np.max(depths)),
-        "utilization": float(busy / makespan) if makespan > 0 else 1.0,
-        "service_mean": float(np.mean(services)),
-        "served": int(served.size),
-        "shed": int(shed),
-        "shed_fraction": float(shed / n),
+        "lat": lat,
+        "busy": busy,
+        "fills": fills,
+        "depths": depths,
+        "services": services,
+        "shed": shed,
+        "t_end": t,
     }
 
 
@@ -295,32 +328,50 @@ def _pad_fill(obs_pool, fill: int):
 
 
 def serve_service_fn(
-    cfg, block, max_batch: int, mode: str = "sample", seed: int = 0
+    cfg,
+    block,
+    max_batch: int,
+    mode: str = "sample",
+    seed: int = 0,
+    serve_impl: str = "xla",
 ) -> Callable[[int], float]:
-    """A measured service model over the compiled
-    :func:`~rcmarl_tpu.serve.engine.serve_block` program at the padded
-    ``(max_batch, N, obs_dim)`` shape: compile + warm once here, then
-    each call is ONE wall-clock-timed launch (device-fetch barrier).
+    """A measured service model over the compiled serving program at
+    the padded ``(max_batch, N, obs_dim)`` shape: compile + warm once
+    here, then each call is ONE wall-clock-timed launch (device-fetch
+    barrier). ``serve_impl`` selects the arm the launches are billed on
+    — the XLA :func:`~rcmarl_tpu.serve.engine.serve_block` chain or the
+    fused Pallas program
+    (:func:`~rcmarl_tpu.ops.pallas_serve.fused_serve_block`; bitwise
+    the same actions, so the queue curves differ only in service time).
     The returned closure is what :func:`run_load` bills batches with."""
     import jax
 
+    from rcmarl_tpu.ops.pallas_serve import fused_serve_block, resolve_serve_impl
     from rcmarl_tpu.serve.engine import serve_block, serve_keys
+
+    impl = resolve_serve_impl(serve_impl)
+
+    def launch(obs, key):
+        if impl == "xla":
+            return serve_block(cfg, block, obs, key, mode=mode)
+        return fused_serve_block(
+            cfg, block, obs, key, mode=mode,
+            interpret=(impl == "pallas_interpret"),
+        )
 
     obs = jax.random.normal(
         jax.random.PRNGKey(seed), (max_batch, cfg.n_agents, cfg.obs_dim)
     )
     key = serve_keys(seed, 0)
     # compile + one warm execution OUTSIDE the billed launches
-    jax.device_get(serve_block(cfg, block, obs, key, mode=mode)[0])
+    jax.device_get(launch(obs, key)[0])
     counter = {"launch": 0}
 
     def service(fill: int) -> float:
         counter["launch"] += 1
         k = serve_keys(seed, counter["launch"])
         t0 = time.perf_counter()
-        actions, _ = serve_block(
-            cfg, block, _pad_fill(obs, fill), k, mode=mode
-        )
+        actions, _ = launch(_pad_fill(obs, fill), k)
         jax.device_get(actions)
         return time.perf_counter() - t0
 
@@ -334,33 +385,46 @@ def fleet_service_fn(
     max_batch: int,
     mode: str = "sample",
     seed: int = 0,
+    serve_impl: str = "xla",
 ) -> Callable[[int], float]:
     """The fleet twin of :func:`serve_service_fn`: one timed launch of
-    the compiled :func:`~rcmarl_tpu.serve.fleet.fleet_block` program at
-    the padded shape, with a round-robin route (DATA — the route could
-    change per launch without a recompile; the harness keeps it fixed
-    so the billed cost is the steady-state one)."""
+    the compiled :func:`~rcmarl_tpu.serve.fleet.fleet_block` program
+    (or its fused Pallas twin
+    :func:`~rcmarl_tpu.ops.pallas_serve.fused_fleet_block`, per
+    ``serve_impl``) at the padded shape, with a round-robin route
+    (DATA — the route could change per launch without a recompile; the
+    harness keeps it fixed so the billed cost is the steady-state
+    one)."""
     import jax
     import jax.numpy as jnp
 
+    from rcmarl_tpu.ops.pallas_serve import fused_fleet_block, resolve_serve_impl
     from rcmarl_tpu.serve.engine import serve_keys
     from rcmarl_tpu.serve.fleet import fleet_block
+
+    impl = resolve_serve_impl(serve_impl)
+
+    def launch(obs, key, route):
+        if impl == "xla":
+            return fleet_block(cfg, fleet, obs, key, route, mode=mode)
+        return fused_fleet_block(
+            cfg, fleet, obs, key, route, mode=mode,
+            interpret=(impl == "pallas_interpret"),
+        )
 
     obs = jax.random.normal(
         jax.random.PRNGKey(seed), (max_batch, cfg.n_agents, cfg.obs_dim)
     )
     route = jnp.arange(max_batch, dtype=jnp.int32) % n_members
     key = serve_keys(seed, 0)
-    jax.device_get(fleet_block(cfg, fleet, obs, key, route, mode=mode)[0])
+    jax.device_get(launch(obs, key, route)[0])
     counter = {"launch": 0}
 
     def service(fill: int) -> float:
         counter["launch"] += 1
         k = serve_keys(seed, counter["launch"])
         t0 = time.perf_counter()
-        actions, _ = fleet_block(
-            cfg, fleet, _pad_fill(obs, fill), k, route, mode=mode
-        )
+        actions, _ = launch(_pad_fill(obs, fill), k, route)
         jax.device_get(actions)
         return time.perf_counter() - t0
 

@@ -46,6 +46,10 @@ def jit_entry_points() -> Dict[str, object]:
     package is a diagnostic/benchmark standalone. Imported lazily so
     ``utils`` stays cheap to import.
     """
+    from rcmarl_tpu.ops.pallas_serve import (
+        fused_fleet_block,
+        fused_serve_block,
+    )
     from rcmarl_tpu.parallel.gossip import gossip_mix_block
     from rcmarl_tpu.pipeline.trainer import (
         learner_block,
@@ -71,6 +75,8 @@ def jit_entry_points() -> Dict[str, object]:
         "consensus_block": consensus_block,
         "serve_block": serve_block,
         "fleet_block": fleet_block,
+        "fused_serve_block": fused_serve_block,
+        "fused_fleet_block": fused_fleet_block,
         "eval_block": eval_block,
         "actor_block": actor_block,
         "learner_block": learner_block,
@@ -313,6 +319,21 @@ def lowered_entry_points(
                 elif name == "fleet_block":
                     fleet, obs, skey, route = fleet_entry_inputs(cfg)
                     lowered = fn.lower(cfg, fleet, obs, skey, route)
+                elif name == "fused_serve_block":
+                    # off-TPU the fused program only lowers interpreted
+                    # (Mosaic is TPU-only) — the correctness arm, which
+                    # is exactly what the CPU-side audits pin
+                    block, obs, skey = serve_entry_inputs(cfg)
+                    lowered = fn.lower(
+                        cfg, block, obs, skey,
+                        interpret=jax.default_backend() != "tpu",
+                    )
+                elif name == "fused_fleet_block":
+                    fleet, obs, skey, route = fleet_entry_inputs(cfg)
+                    lowered = fn.lower(
+                        cfg, fleet, obs, skey, route,
+                        interpret=jax.default_backend() != "tpu",
+                    )
                 elif name in ("eval_block", "actor_block"):
                     lowered = fn.lower(
                         cfg, state.params, state.desired, key, state.initial
@@ -418,6 +439,20 @@ def _traced_entry(cfg, with_diag: bool, name: str):
             fleet, obs, skey, route = fleet_entry_inputs(cfg)
             closed, out_shape = jax.make_jaxpr(
                 lambda fl, o, k, r: fn(cfg, fl, o, k, r), return_shape=True
+            )(fleet, obs, skey, route)
+        elif name == "fused_serve_block":
+            block, obs, skey = serve_entry_inputs(cfg)
+            interp = jax.default_backend() != "tpu"
+            closed, out_shape = jax.make_jaxpr(
+                lambda bl, o, k: fn(cfg, bl, o, k, interpret=interp),
+                return_shape=True,
+            )(block, obs, skey)
+        elif name == "fused_fleet_block":
+            fleet, obs, skey, route = fleet_entry_inputs(cfg)
+            interp = jax.default_backend() != "tpu"
+            closed, out_shape = jax.make_jaxpr(
+                lambda fl, o, k, r: fn(cfg, fl, o, k, r, interpret=interp),
+                return_shape=True,
             )(fleet, obs, skey, route)
         elif name in ("eval_block", "actor_block"):
             closed, out_shape = jax.make_jaxpr(
@@ -936,5 +971,146 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
         - out["gather"]
         - out["consensus"]
         - out["phase1_fits"]
+    )
+    return out
+
+
+def serve_tags(cfg, batch: int, mode: str) -> Dict[str, int]:
+    """The static knobs a serving crossover policy would key on, for
+    tagging serve micro-breakdown rows: the request batch, the agent
+    count, the action fan-out, and the per-launch action volume."""
+    return {
+        "batch": int(batch),
+        "n_agents": cfg.n_agents,
+        "n_actions": cfg.n_actions,
+        "actions_per_launch": int(batch) * cfg.n_agents,
+        "greedy": int(mode == "greedy"),
+    }
+
+
+def profile_serve(
+    cfg,
+    block=None,
+    *,
+    batch: int = 512,
+    mode: str = "sample",
+    serve_impl: str = "auto",
+    reps: int = 3,
+    load_requests: int = 512,
+    seed: int = 0,
+) -> Dict[str, float]:
+    """Time the components of ONE serving launch separately, AS THE
+    ACTIVE ``serve_impl`` ARM RUNS THEM.
+
+    The serving-side sibling of :func:`profile_consensus`: where that
+    breaks a consensus epoch into the pieces its crossover policies
+    tune, this breaks a serve launch into the stages the one-kernel
+    serving path fuses —
+
+    - ``forward`` — the stacked actor forward alone (pad + per-agent
+      MLP probs over the whole request batch).
+    - ``key_derivation`` — the per-(request, agent) counter-based key
+      derivation alone (``fold_in(fold_in(key, b), n)`` over B×N).
+    - ``sample`` — the categorical draw alone, given precomputed keys
+      and probabilities.
+    - ``serve`` — the WHOLE launch as the resolved arm actually runs
+      it: the XLA :func:`~rcmarl_tpu.serve.engine.serve_block` chain,
+      or the fused Pallas program
+      (:func:`~rcmarl_tpu.ops.pallas_serve.fused_serve_block`).
+    - ``queue_wait`` — mean time a request spends QUEUED (not being
+      served) in a short seeded closed-loop replay at ~half the
+      measured per-launch capacity, through the same resolved arm
+      (``mean_latency - service_mean`` of the
+      :func:`~rcmarl_tpu.serve.load.run_load` report).
+
+    Attribution follows the :func:`profile_consensus` honesty
+    discipline: under the fused arm there are NO separate
+    forward/key/sample launches — the kernel runs all three
+    VMEM-resident inside one program — so those keys are an honest 0.0
+    and the whole chain is attributed to ``serve``. Greedy mode zeroes
+    ``key_derivation``/``sample`` on every arm (the greedy program
+    never runs them).
+    """
+    from rcmarl_tpu.models.mlp import pad_features
+    from rcmarl_tpu.ops.pallas_serve import (
+        fused_serve_block,
+        resolve_serve_impl,
+    )
+    from rcmarl_tpu.serve.engine import (
+        batch_probs,
+        serve_block,
+        serve_request_keys,
+        stack_actor_rows,
+    )
+    from rcmarl_tpu.serve.load import (
+        poisson_arrivals,
+        run_load,
+        serve_service_fn,
+    )
+
+    impl = resolve_serve_impl(serve_impl)
+    if block is None:
+        from rcmarl_tpu.training.trainer import init_train_state
+
+        block = stack_actor_rows(
+            init_train_state(cfg, jax.random.PRNGKey(cfg.seed)).params, cfg
+        )
+    B, N = int(batch), cfg.n_agents
+    obs = jax.random.uniform(
+        jax.random.PRNGKey(seed + 7), (B, N, cfg.obs_dim), jnp.float32
+    )
+    key = jax.random.PRNGKey(seed)
+    width = int(block[0][0].shape[-2])
+    out: Dict[str, float] = {}
+
+    # ---- the whole launch, exactly as the resolved arm runs it
+    if impl == "xla":
+        serve_arm = lambda bl, o, k: serve_block(cfg, bl, o, k, mode=mode)
+    else:
+        interp = impl == "pallas_interpret"
+        serve_arm = lambda bl, o, k: fused_serve_block(
+            cfg, bl, o, k, mode=mode, interpret=interp
+        )
+    out["serve"] = _timeit(serve_arm, block, obs, key, reps=reps)
+
+    # ---- per-stage splits: real launches on the XLA arm; honest 0.0
+    # under the fused arm (the stages happen in-register inside
+    # ``serve`` — there is no separate launch to time)
+    if impl == "xla":
+        fwd = jax.jit(
+            lambda bl, o: batch_probs(cfg, bl, pad_features(o, width))
+        )
+        out["forward"] = _timeit(fwd, block, obs, reps=reps)
+        if mode == "greedy":
+            out["key_derivation"] = 0.0
+            out["sample"] = 0.0
+        else:
+            derive = jax.jit(lambda k: serve_request_keys(k, B, N))
+            out["key_derivation"] = _timeit(derive, key, reps=reps)
+            sample = jax.jit(
+                lambda ks, pr: jax.vmap(jax.vmap(jax.random.categorical))(
+                    ks, jnp.log(pr)
+                ).astype(jnp.int32)
+            )
+            out["sample"] = _timeit(
+                sample, derive(key), fwd(block, obs), reps=reps
+            )
+    else:
+        out["forward"] = 0.0
+        out["key_derivation"] = 0.0
+        out["sample"] = 0.0
+
+    # ---- queue wait under load, through the SAME resolved arm: a
+    # short seeded Poisson replay at ~half the per-launch capacity
+    # (comfortably below the knee, so this measures batching-window
+    # wait rather than saturation)
+    service = serve_service_fn(
+        cfg, block, B, mode=mode, seed=seed, serve_impl=impl
+    )
+    rate = 0.5 * B / max(out["serve"], 1e-9)
+    arrivals = poisson_arrivals(seed, load_requests, rate)
+    report = run_load(service, arrivals, B, max_wait=out["serve"])
+    out["queue_wait"] = max(
+        0.0, report["mean_latency"] - report["service_mean"]
     )
     return out

@@ -373,6 +373,26 @@ grep -q "m1:last-good" "$prod_log"
 grep -q "rejected" "$prod_log"
 echo "production-serving smoke cell OK"
 
+# One-kernel serving smoke cell (round 16): the fused serve arm + the
+# SLO autoscale replay through the real CLI, outside the pytest budget
+# — serve the tier's tiny checkpoint on the fused interpret arm (the
+# CLI pins actions AND probs BITWISE vs the XLA serve_block chain on
+# the real batch before anything is timed, so the grepped row's
+# fused_parity is proven, not asserted), then replay the seeded
+# 1x->10x->1x swing through the SLO control loop on the same
+# invocation: the autoscaled arm must hold the SLO (the summary-line
+# grep) and the serve_autoscale row must land. rc=0 throughout.
+fused_log="$smoke_dir/fused_serve.log"
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m rcmarl_tpu serve \
+    --checkpoint "$prod_dir/member0.npz" \
+    --serve_impl pallas_interpret --batch 16 --steps 2 --reps 1 \
+    --autoscale 400 --max_scale 8 | tee "$fused_log"
+grep -q '"serve_impl": "pallas_interpret"' "$fused_log"
+grep -q '"fused_parity": "bitwise"' "$fused_log"
+grep -q '"serve_autoscale"' "$fused_log"
+grep -q "autoscale: SLO held" "$fused_log"
+echo "one-kernel serving smoke cell OK"
+
 # Pipeline smoke cell: the async actor-learner pipeline end to end
 # through the real CLI — a depth-2 pipelined run with a sparse publish
 # cadence must exit rc=0 with the staleness counters on the summary

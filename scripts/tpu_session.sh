@@ -186,6 +186,46 @@ run_step "11. chaos campaign on-chip refit (chaos --check)" \
     timeout 1800 python -m rcmarl_tpu chaos --check \
     --baseline RESILIENCE.jsonl
 
+# The one-kernel serving path (PR 16): the committed fused-serve rows
+# are interpret-mode (headline:false) and the serve_path bytes gate is
+# the BlockSpec DMA model — this is the REAL-LOWERING refit: (12) the
+# fused-vs-XLA serve A/B on a fresh checkpoint (the CLI verifies
+# actions+probs BITWISE on the real batch before timing, so the rows
+# carry fused_parity proven on-chip), (12b) the per-arm serve
+# micro-breakdown (forward/key-derivation/sample splits on the XLA arm
+# vs the whole-kernel fused time), plus the SLO autoscale replay over
+# REAL on-chip launch times riding the last serve invocation (the
+# committed autoscale_slo.json is a CPU-measured service model). These
+# rows are what lets --serve_impl auto adopt the fused program with a
+# measured win.
+run_step "12. one-kernel serve refit (fused vs XLA, bitwise-gated)" \
+    bash -c 'set -o pipefail; d=$(mktemp -d); \
+      timeout 900 python - "$d" <<'"'"'PY'"'"'
+import sys
+from pathlib import Path
+from rcmarl_tpu.config import Config
+from rcmarl_tpu.training.trainer import train
+from rcmarl_tpu.utils.checkpoint import save_checkpoint
+cfg = Config(seed=100)
+state, _ = train(cfg, n_episodes=100)
+save_checkpoint(Path(sys.argv[1]) / "deployed.npz", state, cfg)
+PY
+      for impl in xla pallas; do
+        timeout 900 python -m rcmarl_tpu serve \
+          --checkpoint "$d"/deployed.npz --serve_impl "$impl" \
+          --batch 4096 --steps 30 --reps 3 --out BENCH_SERVE.jsonl \
+          || exit 1
+      done
+      timeout 900 python -m rcmarl_tpu serve \
+        --checkpoint "$d"/deployed.npz --serve_impl pallas \
+        --batch 4096 --steps 20 --reps 3 \
+        --autoscale 2000 --max_scale 16 --out BENCH_SERVE.jsonl'
+
+run_step "12b. serve micro-breakdown arms (forward/key/sample splits)" \
+    timeout 1800 python -m rcmarl_tpu profile \
+    --serve_micro --serve_impl xla pallas \
+    --serve_batch 4096 --out PERF.jsonl
+
 echo "== session summary =="
 rc=0
 for name in "${step_order[@]}"; do

@@ -1,0 +1,208 @@
+"""SLO-driven autoscaling contracts (rcmarl_tpu.serve.autoscale).
+
+The pins that make the control loop trustworthy:
+
+- the headline evidence claim: under the seeded 1x->10x->1x offered-load
+  swing the autoscaled fleet holds the p99 SLO in EVERY window while the
+  static scale-1 baseline saturates on the same plan;
+- scale-down HYSTERESIS: down moves wait out consecutive low-demand
+  windows and project the smaller fleet's demand first — no flapping;
+- never-resizes-mid-batch: scale changes land exactly at window
+  boundaries (every resize's ``after_window`` accounting) and no request
+  is lost across a resize;
+- the chaos ``serve_overload@autoscale`` cell: sustained 4x-capacity
+  overload is survived by scaling out, with a shed cost strictly under
+  the static deadline-shedding arm's.
+
+Everything runs on injected deterministic service models — replays are
+bit-for-bit reproducible from ``(seed, plan, controller)`` alone, no
+wall clock anywhere.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from rcmarl_tpu.serve.autoscale import (
+    HYSTERESIS,
+    SLOController,
+    autoscale_replay,
+    summary_line,
+    swing_arrivals,
+)
+
+SERVICE_S = 0.001
+MAX_BATCH = 16
+MAX_WAIT = 0.002
+SLO = 0.006
+#: half a scale-1 member's batch capacity — the swing's 10x peak then
+#: offers 5x what the static fleet can serve
+BASE_RATE = 0.5 * MAX_BATCH / SERVICE_S
+
+
+def _swing(seg=4000, seed=0):
+    # 4000 requests/segment = 10 control windows per 1x segment — the
+    # committed autoscale_slo.json plan (a faster ramp outruns the
+    # one-window control lag by construction, not by a controller bug)
+    return swing_arrivals(seed, BASE_RATE, seg)
+
+
+def _replay(controller, arrivals=None, **kw):
+    arrivals = _swing() if arrivals is None else arrivals
+    kw.setdefault("window", 0.05)
+    kw.setdefault("max_batch", MAX_BATCH)
+    kw.setdefault("max_wait", MAX_WAIT)
+    kw.setdefault("slo_p99", SLO)
+    return autoscale_replay(
+        lambda fill: SERVICE_S, arrivals, controller, **kw
+    )
+
+
+class TestSwingEvidence:
+    def test_autoscaled_holds_slo_static_saturates(self):
+        """The committed autoscale_slo.json claim, as a pinned test:
+        same seeded plan, same service model — the controller-driven
+        fleet keeps every window's p99 under the SLO shed-free while
+        the static scale-1 arm blows through it at the peak."""
+        auto = _replay(SLOController(slo_p99=SLO, max_scale=16))
+        static = _replay(None)
+        assert auto["slo_held"]
+        assert auto["shed"] == 0
+        assert auto["max_scale_used"] >= 5  # the 10x peak needs >= 5x
+        assert not static["slo_held"]
+        static_peak = max(w["p99"] for w in static["windows"])
+        assert static_peak > 10 * SLO  # saturation, not a near miss
+        assert summary_line(auto).startswith("autoscale: SLO held")
+        assert "SLO violated" in summary_line(static)
+
+    def test_replay_is_deterministic(self):
+        a = _replay(SLOController(slo_p99=SLO, max_scale=16))
+        b = _replay(SLOController(slo_p99=SLO, max_scale=16))
+        assert a == b
+
+    def test_scale_comes_back_down_after_the_peak(self):
+        """The trough after the swing releases capacity: hysteresis
+        steps the fleet back down once demand stays low."""
+        auto = _replay(SLOController(slo_p99=SLO, max_scale=16))
+        assert auto["final_scale"] < auto["max_scale_used"]
+        assert any(r["reason"] == "scale-down" for r in auto["resizes"])
+
+
+class TestControllerDecisions:
+    def _report(self, p99=0.001, demand=0.5, shed=0):
+        return {"p99": p99, "demand": demand, "shed": shed}
+
+    def test_breach_doubles_and_shed_doubles(self):
+        c = SLOController(slo_p99=SLO, max_scale=8)
+        c.scale = 2
+        assert c.decide(self._report(p99=2 * SLO)) == "p99-breach"
+        assert c.scale == 4
+        assert c.decide(self._report(shed=3)) == "shed"
+        assert c.scale == 8
+
+    def test_demand_scale_up_is_proportional(self):
+        """A ramp that doubles offered load gets a resized fleet, not
+        one more member: the next scale lands demand back at the
+        low-water mark."""
+        c = SLOController(slo_p99=SLO, max_scale=16)
+        c.scale = 2
+        assert c.decide(self._report(demand=0.9)) == "high-demand"
+        # ceil(0.9 * 2 / 0.35) = 6 — not 3
+        assert c.scale == 6
+
+    def test_scale_down_waits_out_hysteresis(self):
+        c = SLOController(slo_p99=SLO, max_scale=8)
+        c.scale = 4
+        low = self._report(demand=0.1)
+        for _ in range(HYSTERESIS - 1):
+            assert c.decide(low) is None
+            assert c.scale == 4
+        assert c.decide(low) == "scale-down"
+        assert c.scale == 3  # ONE step, not a collapse
+
+    def test_hysteresis_resets_on_a_hot_window(self):
+        c = SLOController(slo_p99=SLO, max_scale=8)
+        c.scale = 4
+        low = self._report(demand=0.1)
+        for _ in range(HYSTERESIS - 1):
+            c.decide(low)
+        c.decide(self._report(demand=0.7))  # resets the healthy streak
+        for _ in range(HYSTERESIS - 1):
+            assert c.decide(low) is None
+        assert c.decide(low) == "scale-down"
+
+    def test_no_step_down_when_projection_would_overload(self):
+        """Demand under the low mark but the SMALLER fleet's projected
+        demand over it: hold — the anti-flap projection gate."""
+        c = SLOController(slo_p99=SLO, max_scale=8)
+        c.scale = 2
+        # projected = 0.3 * 2 / 1 = 0.6 >= low mark 0.35 -> hold
+        for _ in range(HYSTERESIS + 2):
+            assert c.decide(self._report(demand=0.3)) is None
+        assert c.scale == 2
+
+    def test_envelope_and_validation(self):
+        c = SLOController(slo_p99=SLO, min_scale=1, max_scale=2)
+        assert c.decide(self._report(p99=2 * SLO)) == "p99-breach"
+        assert c.decide(self._report(p99=2 * SLO)) is None  # at ceiling
+        assert c.scale == 2
+        with pytest.raises(ValueError, match="slo_p99"):
+            SLOController(slo_p99=0.0)
+        with pytest.raises(ValueError, match="min_scale"):
+            SLOController(slo_p99=1.0, min_scale=3, max_scale=2)
+        with pytest.raises(ValueError, match="hysteresis"):
+            SLOController(slo_p99=1.0, hysteresis=0)
+
+
+class TestResizeBoundaries:
+    def test_never_resizes_mid_window_and_no_request_lost(self):
+        """Structural pin of never-resizes-mid-batch: every window row
+        reports exactly ONE scale, that scale equals the trajectory
+        implied by the ``after_window`` resize log (a resize after
+        window w is first visible in window w+1), and served + shed
+        covers every arrival — no request can vanish at a boundary."""
+        auto = _replay(SLOController(slo_p99=SLO, max_scale=16))
+        scale = auto["windows"][0]["scale"]
+        resized_at = {r["after_window"]: r["to"] for r in auto["resizes"]}
+        prev_w = None
+        for row in auto["windows"]:
+            if prev_w is not None:
+                for w in range(prev_w, row["window"]):
+                    scale = resized_at.get(w, scale)
+            assert row["scale"] == scale
+            prev_w = row["window"]
+        assert auto["served"] + auto["shed"] == auto["requests"]
+
+    def test_windowed_static_percentiles_match_unwindowed_run(self):
+        """A static scale-1 windowed replay is the SAME queue as one
+        un-windowed :func:`run_load` pass over the plan — windowing is
+        accounting, never simulation drift."""
+        from rcmarl_tpu.serve.load import run_load
+
+        arrivals = _swing(seg=200)
+        windowed = _replay(None, arrivals=arrivals)
+        flat = run_load(
+            lambda fill: SERVICE_S, arrivals, MAX_BATCH, MAX_WAIT
+        )
+        lat = np.concatenate(
+            [[w["p99"]] for w in windowed["windows"]]
+        )
+        # the flat run's p99 must sit inside the windowed envelope
+        assert lat.min() - 1e-9 <= flat["p99"] <= lat.max() + 1e-9
+        assert windowed["served"] == flat["served"]
+
+
+class TestChaosAutoscaleCell:
+    def test_serve_overload_autoscale_survives(self):
+        """The registry cell end to end: sustained 4x-capacity overload
+        is SURVIVED by scaling out — SLO restored by the final window,
+        shed cost strictly under the static deadline-shedding arm."""
+        from rcmarl_tpu.chaos.campaign import run_cell
+
+        row = run_cell("serve_overload", "autoscale")
+        assert row["outcome"] == "survived"
+        c = row["counters"]
+        assert c["max_scale_used"] > 1
+        assert c["shed_fraction"] < c["static_shed_fraction"]
+        assert c["final_p99_ms"] <= c["slo_ms"]

@@ -89,7 +89,8 @@ class TestRegistry:
         assert _select_cells(["link_nan@0.5"]) == [("link_nan", "0.5")]
         both = _select_cells(["serve_overload"])
         assert set(both) == {("serve_overload", "noshed"),
-                             ("serve_overload", "shed")}
+                             ("serve_overload", "shed"),
+                             ("serve_overload", "autoscale")}
         with pytest.raises(ValueError, match="matches no registry cell"):
             _select_cells(["no_such_point"])
         with pytest.raises(ValueError, match="matches no registry cell"):
@@ -208,8 +209,16 @@ class TestRealCellsThroughCLI:
         rows = read_resilience(ledger)
         assert {(r["point"], r["intensity"]) for r in rows} == {
             ("serve_overload", "noshed"), ("serve_overload", "shed"),
-            ("publish_poison", "nan"),
+            ("serve_overload", "autoscale"), ("publish_poison", "nan"),
         }
+        autoscale = next(r for r in rows if r["intensity"] == "autoscale")
+        assert autoscale["outcome"] == "survived"
+        assert autoscale["counters"]["max_scale_used"] > 1
+        # the scaled fleet undercuts the static arm's shed cost
+        assert (
+            autoscale["counters"]["shed_fraction"]
+            < autoscale["counters"]["static_shed_fraction"]
+        )
         shed = next(r for r in rows if r["intensity"] == "shed")
         assert shed["outcome"] == "survived"
         assert shed["counters"]["shed_fraction"] > 0

@@ -1,0 +1,229 @@
+"""One-kernel serving (rcmarl_tpu.ops.pallas_serve): the fused
+forward + key-derivation + sample Pallas program vs the XLA serve arm.
+
+The bitwise contract (interpret mode on this CPU host): probabilities
+AND action streams from ONE fused launch are pinned BITWISE against
+the XLA :func:`~rcmarl_tpu.serve.engine.serve_block` /
+:func:`~rcmarl_tpu.serve.fleet.fleet_block` chains across the
+{sample, greedy} x {f32, bf16-dot} x {solo, fleet} matrix, including
+batch sizes that do NOT divide the kernel's tile height (the exact-grid
+rule) and an odd action fan-out (the threefry odd-counter padding
+path). The heavier cells (bf16, the 96-row batch) ride the slow marker
+with the rest of the interpret-mode kernel matrix; real lowerings ride
+the queued TPU session (scripts/tpu_session.sh step 12), and the
+HBM-traffic claim is carried by the AUDIT.jsonl ``serve_path`` rows
+(lint --cost), whose BlockSpec arithmetic is pinned here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+from rcmarl_tpu.ops.pallas_serve import (
+    SERVE_IMPLS,
+    _tile_rows,
+    fused_fleet_block,
+    fused_serve_block,
+    fused_serve_dma_bytes,
+    resolve_serve_impl,
+)
+from rcmarl_tpu.serve.engine import (
+    ServeEngine,
+    serve_block,
+    stack_actor_rows,
+)
+from rcmarl_tpu.serve.fleet import fleet_block, fleet_stack
+from rcmarl_tpu.training.trainer import init_train_state
+from rcmarl_tpu.utils.checkpoint import save_checkpoint
+
+
+def tiny_cfg(**overrides):
+    base = dict(
+        n_agents=3,
+        agent_roles=(Roles.COOPERATIVE,) * 3,
+        in_nodes=circulant_in_nodes(3, 3),
+        nrow=3,
+        ncol=3,
+        n_episodes=4,
+        n_ep_fixed=2,
+        max_ep_len=4,
+        n_epochs=2,
+        H=1,
+    )
+    base.update(overrides)
+    return Config(**base)
+
+
+CFG = tiny_cfg()
+BLOCK = stack_actor_rows(init_train_state(CFG, jax.random.PRNGKey(0)).params, CFG)
+KEY = jax.random.PRNGKey(9)
+
+
+def _obs(cfg, batch, seed=5):
+    return jax.random.normal(
+        jax.random.PRNGKey(seed), (batch, cfg.n_agents, cfg.obs_dim)
+    )
+
+
+def _assert_bitwise(cfg, block, obs, key, mode, block_b=128):
+    fused_a, fused_p = fused_serve_block(
+        cfg, block, obs, key, mode=mode, block_b=block_b, interpret=True
+    )
+    ref_a, ref_p = serve_block(cfg, block, obs, key, mode=mode)
+    np.testing.assert_array_equal(np.asarray(fused_a), np.asarray(ref_a))
+    np.testing.assert_array_equal(np.asarray(fused_p), np.asarray(ref_p))
+
+
+class TestFusedSoloParity:
+    @pytest.mark.parametrize("mode", ["sample", "greedy"])
+    def test_bitwise_vs_xla_serve_block(self, mode):
+        """The headline contract: actions AND probs from ONE fused
+        launch are bitwise the XLA chain's, on the default f32 arm."""
+        _assert_bitwise(CFG, BLOCK, _obs(CFG, 6), KEY, mode)
+
+    def test_batch_not_dividing_tile_stays_bitwise(self):
+        """A prime batch (7) forces a 1-row tile via the exact-grid
+        rule — per-request keys must still use the GLOBAL request
+        index, so every row stays bitwise across grid steps."""
+        _assert_bitwise(CFG, BLOCK, _obs(CFG, 7), KEY, "sample", block_b=4)
+
+    def test_even_action_fanout_stays_bitwise(self):
+        """n_actions=4 exercises the even threefry counter split (the
+        default 5 covers the odd zero-padded path)."""
+        cfg = tiny_cfg(n_actions=4)
+        block = stack_actor_rows(
+            init_train_state(cfg, jax.random.PRNGKey(0)).params, cfg
+        )
+        _assert_bitwise(cfg, block, _obs(cfg, 6), KEY, "sample")
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("mode", ["sample", "greedy"])
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    def test_matrix_dtype_by_mode_b96(self, mode, dtype):
+        """The full interpret-mode matrix cell: a multi-tile batch (96
+        rows, 32-row tiles) on both compute dtypes. bf16 parity holds
+        BITWISE because both arms run the identical op sequence (one
+        ``batch_probs`` core) — there is no second implementation to
+        round differently."""
+        cfg = tiny_cfg(compute_dtype=dtype)
+        block = stack_actor_rows(
+            init_train_state(cfg, jax.random.PRNGKey(0)).params, cfg
+        )
+        _assert_bitwise(cfg, block, _obs(cfg, 96), KEY, mode, block_b=32)
+
+
+class TestFusedFleetParity:
+    def _fleet(self, cfg, n=2):
+        return fleet_stack(
+            [
+                stack_actor_rows(
+                    init_train_state(cfg, jax.random.PRNGKey(f)).params, cfg
+                )
+                for f in range(n)
+            ]
+        )
+
+    def test_bitwise_vs_xla_fleet_block(self):
+        fleet = self._fleet(CFG)
+        obs = _obs(CFG, 6)
+        route = jnp.array([0, 1, 1, 0, 1, 0], jnp.int32)
+        fused_a, fused_p = fused_fleet_block(
+            CFG, fleet, obs, KEY, route, interpret=True
+        )
+        ref_a, ref_p = fleet_block(CFG, fleet, obs, KEY, route)
+        np.testing.assert_array_equal(np.asarray(fused_a), np.asarray(ref_a))
+        np.testing.assert_array_equal(np.asarray(fused_p), np.asarray(ref_p))
+
+    def test_routed_member_bitwise_vs_its_solo_serve(self):
+        """The transitive pin: a request routed to member f samples
+        exactly what f would serve SOLO through the XLA arm — fleet
+        serving of one member is indistinguishable from solo serving
+        it, fused or not."""
+        fleet = self._fleet(CFG)
+        obs = _obs(CFG, 6)
+        route = jnp.arange(6, dtype=jnp.int32) % 2
+        fused_a, fused_p = fused_fleet_block(
+            CFG, fleet, obs, KEY, route, interpret=True
+        )
+        for f in range(2):
+            solo = stack_actor_rows(
+                init_train_state(CFG, jax.random.PRNGKey(f)).params, CFG
+            )
+            ref_a, ref_p = serve_block(CFG, solo, obs, KEY)
+            idx = np.nonzero(np.asarray(route) == f)[0]
+            np.testing.assert_array_equal(
+                np.asarray(fused_a)[idx], np.asarray(ref_a)[idx]
+            )
+            np.testing.assert_array_equal(
+                np.asarray(fused_p)[idx], np.asarray(ref_p)[idx]
+            )
+
+
+class TestServeImplPolicy:
+    def test_auto_resolves_by_platform(self):
+        assert resolve_serve_impl("auto", platform="tpu") == "pallas"
+        assert resolve_serve_impl("auto", platform="cpu") == "xla"
+
+    def test_explicit_arms_pass_through(self):
+        for impl in SERVE_IMPLS[1:]:
+            assert resolve_serve_impl(impl, platform="tpu") == impl
+
+    def test_unknown_impl_is_loud(self):
+        with pytest.raises(ValueError, match="serve_impl"):
+            resolve_serve_impl("vectorized")
+
+    def test_engine_fused_arm_serves_xla_actions(self, tmp_path):
+        """ServeEngine(serve_impl='pallas_interpret') is bitwise the
+        default XLA engine on the same checkpoint — the arm is a
+        program choice, never a behavior choice."""
+        path = tmp_path / "checkpoint.npz"
+        save_checkpoint(path, init_train_state(CFG, jax.random.PRNGKey(0)), CFG)
+        obs = _obs(CFG, 6)
+        a_ref, p_ref = ServeEngine(path).serve(obs, step=0)
+        a_fused, p_fused = ServeEngine(
+            path, serve_impl="pallas_interpret"
+        ).serve(obs, step=0)
+        np.testing.assert_array_equal(np.asarray(a_fused), np.asarray(a_ref))
+        np.testing.assert_array_equal(np.asarray(p_fused), np.asarray(p_ref))
+
+
+class TestDmaLedgerArithmetic:
+    def test_tile_rows_exact_grid(self):
+        assert _tile_rows(96, 128) == 96
+        assert _tile_rows(96, 32) == 32
+        assert _tile_rows(7, 4) == 1  # prime batch: 1-row tiles
+        assert _tile_rows(12, 5) == 4  # largest divisor <= block_b
+
+    def test_bytes_are_exact_blockspec_sums(self):
+        """The ledger row's bytes are deterministic arithmetic over the
+        kernel's BlockSpecs — recompute one cell by hand."""
+        cfg = CFG
+        N, A = cfg.n_agents, cfg.n_actions
+        dims = [cfg.obs_dim, *cfg.hidden, A]
+        B, bb = 96, 32
+        params = sum(
+            (i * o + o) * 4.0 for i, o in zip(dims[:-1], dims[1:])
+        ) * N
+        expect = (
+            B * N * dims[0] * 4.0  # obs read once
+            + params * (B // bb)  # block re-DMAd per tile
+            + B * N * 4.0  # actions
+            + B * N * A * 4.0  # probs
+            + 8.0 * (B // bb)  # key words per tile
+        )
+        got = fused_serve_dma_bytes(cfg, B, mode="sample", block_b=bb)
+        assert got == expect
+
+    def test_greedy_drops_key_traffic_and_fleet_adds_route(self):
+        base = fused_serve_dma_bytes(CFG, 96, mode="sample", block_b=32)
+        greedy = fused_serve_dma_bytes(CFG, 96, mode="greedy", block_b=32)
+        assert base - greedy == 8.0 * 3  # key words per tile, 3 tiles
+        fleet = fused_serve_dma_bytes(
+            CFG, 96, mode="sample", n_members=2, block_b=32
+        )
+        assert fleet > base  # F x the param stack + the route read
