@@ -70,6 +70,7 @@ __all__ = [
     "episodes_to_threshold",
     "quality_table",
     "episode_throughput_from_bench",
+    "gala_section",
     "write_quality_md",
     "plot_quality_crossing",
 ]
@@ -770,6 +771,90 @@ def gossip_readmission_section(artifact_path) -> list:
     return lines
 
 
+def gala_section(artifact_path) -> list:
+    """QUALITY.md lines for the pipelined-gossip-fleet experiment,
+    rendered from the committed ``scripts/gala_experiment.py`` artifact
+    (``simulation_results/gala_composed.json``): the composed topology
+    (pipeline x gossip x canary) next to its flat pieces, with the
+    degradation bands side by side. Empty when the artifact does not
+    exist."""
+    import json
+
+    p = Path(artifact_path)
+    if not p.exists():
+        return []
+    data = json.loads(p.read_text())
+    cfg = data["config"]
+    v = data["verdict"]
+    lines = [
+        "",
+        "## Pipelined gossip fleets (composed degradation)",
+        "",
+        "`--replicas R --pipeline_depth D` composes the gossip replica "
+        "layer with the async pipeline and the canary-gated deploy "
+        "publish into one topology (README \"Pipelined gossip "
+        "fleets\"). The committed composed experiment (`" + p.name + "`, "
+        "`scripts/gala_experiment.py`: "
+        f"R={cfg['replicas']} replicas, full graph, "
+        f"gossip_H={cfg['gossip_H']}, depth={cfg['pipeline_depth']}, "
+        f"mix every {cfg['gossip_every']} blocks, canary band "
+        f"{cfg['canary_band']}, replica {cfg['byzantine']} "
+        "always-NaN) runs the Byzantine cell FLAT and COMPOSED so the "
+        "degradation envelopes sit side by side:",
+        "",
+        "| arm | mix | depth | healthy replicas finite | team return "
+        "(first\u2192last window) | rollbacks | deploy rejects |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for row in data["arms"]:
+        n_ok = sum(
+            1
+            for r, h in enumerate(row["replica_healthy"])
+            if h and r not in set(row["byzantine"])
+        )
+        n_exp = row["replicas"] - len(row["byzantine"])
+        ret = (
+            f"{row['team_return_first']} \u2192 {row['team_return_last']}"
+            if row["team_return_last"] is not None
+            else "poisoned (NaN)"
+        )
+        canary = row.get("canary")
+        rej = canary["deploy_rejects"] if canary else "\u2014"
+        lines.append(
+            f"| {row['arm']} | {row['mix']} | {row['pipeline_depth']} "
+            f"| {n_ok}/{n_exp} | {ret} | {row['rollbacks']} | {rej} |"
+        )
+    lines += [
+        "",
+        "Reading: the composed Byzantine arm must hold the SAME "
+        "chaos-band contract against its composed clean twin that the "
+        "flat arm holds against its own \u2014 composition degrading no "
+        "worse than its pieces "
+        f"(flat in band: {v['flat_in_band']}, composed in band: "
+        f"{v['composed_in_band']}; the RESILIENCE.jsonl "
+        "`gala_byzantine` cells gate this every CI run). The mean-mix "
+        "arm is the same documented fail it is flat "
+        f"(poisoned: {v['mean_poisoned']}) \u2014 but the canary-gated "
+        "deploy publisher rejects every poisoned winner, so serving "
+        "keeps the last good policy even while training is lost "
+        f"(serving contained: {v['serving_contained']}; the "
+        "`gala_canary_race` cell).",
+    ]
+    perf = data.get("perf")
+    if perf:
+        lines += [
+            "",
+            f"Composed throughput on this host ({perf['platform']}): "
+            f"{perf['env_steps_per_sec']} env steps/s across the fleet "
+            f"(the `gala_composed` row in PERF.jsonl"
+            + (", headline:false \u2014 a serial CPU core runs every "
+               "replica's two tiers back to back"
+               if perf["platform"] == "cpu" else "")
+            + ").",
+        ]
+    return lines
+
+
 def autoscale_slo_section(artifact_path) -> list:
     """QUALITY.md lines for the autoscale-SLO experiment, rendered from
     the committed ``scripts/autoscale_experiment.py`` artifact
@@ -1203,6 +1288,10 @@ def write_quality_md(
         Path(out_path).parent / "simulation_results/canary_gate.json"
     )
     lines += canary_section(canary_artifact)
+    gala_artifact = (
+        Path(out_path).parent / "simulation_results/gala_composed.json"
+    )
+    lines += gala_section(gala_artifact)
     autoscale_artifact = (
         Path(out_path).parent / "simulation_results/autoscale_slo.json"
     )
@@ -1226,6 +1315,12 @@ def write_quality_md(
             "- `simulation_results/gossip_byzantine.json` — the "
             "Byzantine gossip-replica experiment behind the replica-"
             "level degradation section (`scripts/gossip_experiment.py`)"
+        )
+    if gala_artifact.exists():
+        lines.append(
+            "- `simulation_results/gala_composed.json` — the composed "
+            "pipelined-gossip-fleet experiment behind the composed "
+            "degradation section (`scripts/gala_experiment.py`)"
         )
     if bf16_artifact.exists():
         lines.append(

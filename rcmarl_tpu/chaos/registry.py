@@ -651,6 +651,238 @@ def _run_pipeline_faulted(intensity: str) -> dict:
 
 
 # --------------------------------------------------------------------------
+# composed: pipelined gossip fleets (pipeline x gossip x canary in ONE
+# topology — the cells that prove composition degrades no worse than
+# its pieces)
+# --------------------------------------------------------------------------
+
+
+def _gala_cfg(**overrides):
+    base = dict(
+        replicas=4,
+        gossip_every=2,
+        gossip_graph="full",
+        gossip_H=1,
+        pipeline_depth=2,
+        canary_band=0.5,
+        n_episodes=8,
+    )
+    base.update(overrides)
+    return _tiny(**base)
+
+
+def _gala_cell(cfg, readmit_after: int = 0) -> dict:
+    """One composed pipelined-gossip-canary run under ``cfg``'s replica
+    fault plan, classified like :func:`_gossip_cell` with one extra
+    prong: a non-finite SERVED policy is an unconditional failure (the
+    canary/deploy gate is part of the composed containment contract)."""
+    import numpy as np
+
+    from rcmarl_tpu.parallel.gala import train_gala
+
+    states, df = train_gala(cfg, readmit_after=readmit_after)
+    g = df.attrs["gossip"]
+    c = df.attrs["canary"]
+    byz = set(g["byzantine"])
+    healthy = [
+        ok for r, ok in enumerate(g["replica_healthy"]) if r not in byz
+    ]
+    returns = np.asarray(df["True_team_returns"].values, dtype=float)
+    final = _final_return(df)
+    counters = {
+        k: g[k]
+        for k in ("rounds", "rollbacks", "excluded", "readmitted",
+                  "nonfinite", "deficit")
+    }
+    counters["skipped"] = sum(df.attrs["guard"]["replica_skipped"])
+    counters["deploys"] = c["deploys"]
+    counters["deploy_rejects"] = c["deploy_rejects"]
+    clean_cfg = cfg.replace(
+        fault_plan=None, replica_fault_plan=None, consensus_sanitize=False
+    )
+    clean_key = ("gala_clean", clean_cfg)
+    if clean_key not in _CLEAN_CACHE:
+        from rcmarl_tpu.parallel.gala import train_gala as tg
+
+        _, cdf = tg(clean_cfg, guard=False)
+        _CLEAN_CACHE[clean_key] = _final_return(cdf)
+    clean = _CLEAN_CACHE[clean_key]
+    if (
+        not all(healthy)
+        or not np.isfinite(returns[-RETURN_WINDOW:]).all()
+        or not c["deploy_healthy"]
+    ):
+        outcome = "failed"
+        final = final if math.isfinite(final) else None
+    elif (
+        g["rollbacks"] > 0
+        or any(g["quarantined"])
+        or counters["skipped"] > 0
+        or not _within_band(final, clean)
+    ):
+        outcome = "degraded"
+    else:
+        outcome = "survived"
+    return {
+        "outcome": outcome,
+        "counters": counters,
+        "final_return": final,
+        "clean_return": clean,
+        "detail": (
+            f"R={cfg.replicas} {cfg.gossip_graph} graph, "
+            f"gossip_H={cfg.gossip_H}, mix={cfg.gossip_mix}, "
+            f"depth={cfg.pipeline_depth}, band={cfg.canary_band}, "
+            f"readmit_after={readmit_after}"
+        ),
+    }
+
+
+def _run_gala_byzantine(intensity: str) -> dict:
+    """The replica_byzantine cell INSIDE a depth-2 pipelined fleet with
+    a canary-gated deploy: trimmed-mean gossip at H=1 must keep the
+    composed run inside the same clean band the flat cell holds."""
+    from rcmarl_tpu.faults import ReplicaFaultPlan
+
+    return _gala_cell(
+        _gala_cfg(
+            replica_fault_plan=ReplicaFaultPlan(
+                byzantine_replicas=(3,), byzantine_mode=intensity
+            )
+        )
+    )
+
+
+def _run_gala_byzantine_mean(intensity: str) -> dict:
+    """The documented-fail comparison arm, composed: the same Byzantine
+    replica against the UNHARDENED plain-mean mix poisons every replica
+    segment downstream of the first round."""
+    from rcmarl_tpu.faults import ReplicaFaultPlan
+
+    return _gala_cell(
+        _gala_cfg(
+            gossip_mix="mean",
+            replica_fault_plan=ReplicaFaultPlan(
+                byzantine_replicas=(3,), byzantine_mode=intensity
+            ),
+        )
+    )
+
+
+def _run_gala_window(intensity: str) -> dict:
+    """Stale/poisoned actor windows feeding ONE replica's learner inside
+    the fleet (the composed seam of pipeline_window): the fault burns
+    exactly that replica's redraw/skip budget, and a skipping replica
+    flaps through quarantine and streak readmission — counters exact,
+    every other replica untouched."""
+    from rcmarl_tpu.parallel.gala import train_gala
+
+    persistent = intensity == "persistent"
+
+    def window_fault(r, b, attempt, fresh, m):
+        if r == 1 and b == 1 and (persistent or attempt == 0):
+            return _nan_bomb_window(fresh, m)
+        return fresh, m
+
+    cfg = _tiny(
+        replicas=2, pipeline_depth=2, gossip_every=2,
+        gossip_graph="full", gossip_H=0,
+        n_episodes=12 if persistent else 8,
+    )
+    states, df = train_gala(
+        cfg, guard=True, max_retries=2, window_fault=window_fault,
+        readmit_after=1 if persistent else 0,
+    )
+    g = df.attrs["guard"]
+    go = df.attrs["gossip"]
+    p = df.attrs["pipeline"]
+    if not _params_ok(states):
+        raise CellFailed("poisoned window reached a replica's params")
+    if persistent:
+        ok = (
+            g["replica_redraws"] == [0, 2]
+            and g["replica_skipped"] == [0, 1]
+            and go["excluded"] == 1
+            and go["readmitted"] == 1
+            and go["quarantined"] == [0, 0]
+            and go["rollbacks"] == 0
+        )
+        outcome = "degraded"  # one replica-block lost + one mix sat out
+    else:
+        ok = (
+            g["replica_redraws"] == [0, 1]
+            and g["replica_skipped"] == [0, 0]
+            and go["excluded"] == 0
+            and go["rollbacks"] == 0
+        )
+        outcome = "survived"
+    if not ok:
+        raise CellFailed(
+            f"composed window-guard accounting broke: guard={g}, "
+            f"gossip={ {k: go[k] for k in ('excluded', 'readmitted', 'quarantined', 'rollbacks')} }"
+        )
+    final = _final_return(df)
+    return {
+        "outcome": outcome,
+        "counters": {
+            "redraws": sum(g["replica_redraws"]),
+            "skipped": sum(g["replica_skipped"]),
+            "excluded": go["excluded"],
+            "readmitted": go["readmitted"],
+            "publishes": p["publishes"],
+        },
+        "final_return": final if math.isfinite(final) else None,
+        "clean_return": None,
+        "detail": (
+            f"{intensity} all-NaN window at replica 1 block 1, R=2 "
+            "depth 2, max_retries 2"
+            + (", readmit_after 1" if persistent else "")
+        ),
+    }
+
+
+def _run_gala_canary_race(intensity: str) -> dict:
+    """A poisoned mix racing the canary-gated deploy publish at the SAME
+    segment boundary: mean-mix + a NaN Byzantine replica poisons the
+    winner's params in the instant between its (finite, eligible)
+    segment metrics and the deploy offer. Training is documented-lost
+    (that is gala_byzantine_mean's row); THIS cell's contract is the
+    serving gate — every poisoned offer must be rejected and the served
+    policy must stay finite last-good."""
+    from rcmarl_tpu.faults import ReplicaFaultPlan, params_finite
+    from rcmarl_tpu.parallel.gala import train_gala
+
+    cfg = _gala_cfg(
+        gossip_mix="mean",
+        replica_fault_plan=ReplicaFaultPlan(
+            byzantine_replicas=(3,), byzantine_mode=intensity
+        ),
+    )
+    states, df = train_gala(cfg)
+    c = df.attrs["canary"]
+    if not c["deploy_healthy"]:
+        raise CellFailed("poisoned mix reached the served policy")
+    if c["deploy_rejects"] + c["rejects"] < 1:
+        raise CellFailed(
+            f"no deploy-side rejection fired against the poisoned "
+            f"winner: {c}"
+        )
+    return {
+        "outcome": "survived",
+        "counters": {
+            k: c[k]
+            for k in ("evals", "accepts", "rejects", "deploys",
+                      "deploy_rejects")
+        },
+        "final_return": None,
+        "clean_return": None,
+        "detail": (
+            "mean-mix NaN poisoning raced the deploy publish; gate "
+            "rejected, served policy stayed finite"
+        ),
+    }
+
+
+# --------------------------------------------------------------------------
 # serving: stale candidates (canary) + request-level overload
 # --------------------------------------------------------------------------
 
@@ -1009,6 +1241,48 @@ CHAOS_POINTS: Tuple[ChaosPoint, ...] = (
         "learner-side guard + publisher validation",
         "tests/test_pipeline.py::TestPipelined", (("depth2", "survived"),),
         _run_pipeline_faulted,
+    ),
+    ChaosPoint(
+        "gala_byzantine", "composed",
+        "an always-adversarial learner replica INSIDE a pipelined "
+        "gossip fleet with a canary-gated deploy",
+        "ReplicaFaultPlan through train_gala (pipeline x gossip x canary)",
+        "trimmed-mean gossip mix at gossip_H + per-replica pipeline "
+        "guard + deploy validation",
+        "tests/test_gala.py",
+        (("nan", "survived"), ("sign_flip", "survived")),
+        _run_gala_byzantine,
+    ),
+    ChaosPoint(
+        "gala_byzantine_mean", "composed",
+        "the same composed Byzantine replica against the UNHARDENED "
+        "plain-mean mix",
+        "ReplicaFaultPlan + gossip_mix='mean' through train_gala",
+        "none — the documented comparison arm one NaN replica poisons",
+        "tests/test_gala.py", (("nan", "failed"),),
+        _run_gala_byzantine_mean,
+    ),
+    ChaosPoint(
+        "gala_window", "composed",
+        "stale/poisoned actor windows feeding one replica's learner "
+        "inside the fleet (flapping through quarantine + readmission)",
+        "train_gala(window_fault=...) (the composed chaos seam)",
+        "per-replica window guard (bounded redraws, skip) + mix "
+        "exclusion + sticky quarantine + streak readmission",
+        "tests/test_gala.py::TestComposedGuards",
+        (("transient", "survived"), ("persistent", "degraded")),
+        _run_gala_window,
+    ),
+    ChaosPoint(
+        "gala_canary_race", "composed",
+        "a poisoned mean-mix racing the canary-gated deploy publish at "
+        "the same segment boundary",
+        "ReplicaFaultPlan + gossip_mix='mean' + canary_band through "
+        "train_gala",
+        "deploy-side params_finite validation + canary gate, served "
+        "policy keeps last good",
+        "tests/test_gala.py (canary prongs)", (("nan", "survived"),),
+        _run_gala_canary_race,
     ),
     ChaosPoint(
         "serve_canary", "serving",

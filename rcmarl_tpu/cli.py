@@ -383,6 +383,27 @@ def _add_gossip_flags(p: argparse.ArgumentParser) -> None:
         "(rcmarl_tpu.parallel.gossip, run-local knob like the serve "
         "flags — not a Config field)",
     )
+    gl = p.add_argument_group(
+        "pipelined gossip fleet (--replicas + --pipeline_depth composed)"
+    )
+    gl.add_argument(
+        "--canary_band",
+        type=float,
+        default=0.0,
+        help="composed-topology deploy gate: after each gossip segment "
+        "the winning replica's policy is offered to the fleet-facing "
+        "deploy publisher, and with band > 0 a CanaryGate rejects any "
+        "candidate whose frozen return falls more than this relative "
+        "band below the incumbent (0 = gate off: every finite winner "
+        "publishes; requires --replicas > 0 AND --pipeline_depth > 0)",
+    )
+    gl.add_argument(
+        "--canary_blocks",
+        type=int,
+        default=1,
+        help="frozen-policy evaluation blocks per composed canary "
+        "decision (rcmarl_tpu.serve.canary eval cadence)",
+    )
     rf = p.add_argument_group(
         "replica faults (per directed gossip link per round)"
     )
@@ -525,6 +546,11 @@ def config_from_args(args) -> Config:
         gossip_mix=getattr(args, "gossip_mix", "trimmed"),
         gossip_seed=getattr(args, "gossip_seed", 0),
         replica_fault_plan=replica_fault_plan_from_args(args),
+        # the serve parser exposes its OWN --canary_band (watcher-side,
+        # default None) — `or 0.0` keeps a serve-args Namespace mapping
+        # onto the Config default instead of a None type error
+        canary_band=getattr(args, "canary_band", 0.0) or 0.0,
+        canary_blocks=getattr(args, "canary_blocks", 1),
     )
 
 
@@ -686,7 +712,40 @@ def cmd_train(argv) -> int:
             from rcmarl_tpu.utils.profiling import trace as profiler_trace
 
             stack.enter_context(profiler_trace(args.trace_dir))
-        if cfg.replicas:
+        if cfg.replicas and cfg.pipeline_depth:
+            from rcmarl_tpu.parallel.gala import train_gala
+
+            def gala_cb(s, b, meta):
+                # fires once per gossip SEGMENT, the gossip_cb cadence
+                every = args.checkpoint_every
+                seg = meta.get("segment_blocks", 1)
+                if every and (b + 1) // every > (b + 1 - seg) // every:
+                    save_checkpoint(
+                        out / "checkpoint.npz",
+                        s,
+                        cfg,
+                        meta={k: meta[k] for k in
+                              ("replicas", "gossip_round", "excluded")},
+                    )
+
+            state, sim_data = train_gala(
+                cfg,
+                states=state,
+                verbose=not args.quiet,
+                block_callback=gala_cb,
+                guard={"auto": None, "on": True, "off": False}[args.guard],
+                max_retries=args.max_retries,
+                start_round=int(ckpt_meta.get("gossip_round", 0)),
+                excluded=ckpt_meta.get("excluded"),
+                readmit_after=args.gossip_readmit_after,
+            )
+            g = sim_data.attrs["gossip"]
+            final_meta = {
+                "replicas": g["replicas"],
+                "gossip_round": g["gossip_round"],
+                "excluded": g["excluded_mask"],
+            }
+        elif cfg.replicas:
             from rcmarl_tpu.parallel.gossip import train_gossip
 
             def gossip_cb(s, b, meta):
@@ -744,7 +803,13 @@ def cmd_train(argv) -> int:
                 max_retries=args.max_retries,
             )
     dt = time.perf_counter() - t0
-    if "pipeline" in sim_data.attrs:
+    if "gala" in sim_data.attrs:
+        # the composed fleet's ONE merged counters line (staleness +
+        # gossip + canary) — the CI smoke cell greps this
+        from rcmarl_tpu.parallel.gala import gala_summary
+
+        print(gala_summary(sim_data.attrs))
+    elif "pipeline" in sim_data.attrs:
         from rcmarl_tpu.pipeline.trainer import pipeline_summary
 
         print(pipeline_summary(sim_data.attrs["pipeline"]))

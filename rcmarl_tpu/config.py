@@ -432,6 +432,21 @@ class Config:
     # the staleness quality cell sweeps (QUALITY.md).
     pipeline_depth: int = 0
     publish_every: int = 1
+    # --- composed pipelined gossip fleet (parallel/gala.py) ---
+    # Setting replicas > 0 AND pipeline_depth > 0 selects the composed
+    # GALA topology: R gossiping learner replicas, each fed by its own
+    # actor tier running pipeline_depth blocks ahead, trimmed gossip
+    # mixes at segment boundaries, and (optionally) the winning
+    # replica's policy admitted into serving through a CanaryGate.
+    # canary_band: relative return band for the composed run's canary
+    # admission gate (0.0, the default, disables the gate — every
+    # finite winner publishes). canary_blocks: frozen-policy evaluation
+    # blocks per canary decision. Both are composed-topology knobs:
+    # canary_band > 0 outside replicas>0 && pipeline_depth>0 is
+    # rejected loudly (solo serving has its own --canary_band on the
+    # serve parser; this one gates the TRAINING-side deploy publisher).
+    canary_band: float = 0.0
+    canary_blocks: int = 1
     # --- matmul compute precision ---
     # 'float32' (default): true-fp32 dots, the reference-parity path.
     # 'bfloat16': opt-in scale-out mode — matmul inputs in the MXU's
@@ -579,14 +594,39 @@ class Config:
             )
         if self.replicas < 0:
             raise ValueError(f"replicas={self.replicas} must be >= 0")
-        if self.replicas and self.pipeline_depth:
+        if self.canary_band < 0:
             raise ValueError(
-                f"pipeline_depth={self.pipeline_depth} with "
-                f"replicas={self.replicas}: the pipelined gossip-replica "
-                "learner tier is queued for the on-chip session "
-                "(tpu_session.sh) — run the replica set synchronously "
-                "(pipeline_depth=0) or pipeline a solo learner"
+                f"canary_band={self.canary_band} must be >= 0 "
+                "(0 = composed deploy gate off)"
             )
+        if self.canary_blocks < 1:
+            raise ValueError(
+                f"canary_blocks={self.canary_blocks} must be >= 1 "
+                "(frozen-policy evaluation blocks per canary decision)"
+            )
+        if self.canary_band and not (self.replicas and self.pipeline_depth):
+            raise ValueError(
+                f"canary_band={self.canary_band} gates the composed "
+                "pipelined-gossip deploy publisher (parallel/gala.py); "
+                "it requires replicas > 0 AND pipeline_depth > 0 "
+                "(solo serving has its own serve-parser --canary_band)"
+            )
+        if self.replicas and self.pipeline_depth and self.gossip_every:
+            # The composed topology drains each replica's in-flight
+            # actor windows before a mix round (mixed params would
+            # otherwise race queued windows rolled under pre-mix
+            # policies with no counter owning the skew). A segment
+            # shorter than the pipeline depth would drain the queue
+            # every round and never reach steady state.
+            if self.pipeline_depth > self.gossip_every:
+                raise ValueError(
+                    f"pipeline_depth={self.pipeline_depth} > "
+                    f"gossip_every={self.gossip_every}: composed "
+                    "pipelined-gossip segments must be at least as long "
+                    "as the pipeline depth (the actor tier drains at "
+                    "each mix boundary; a shorter segment never "
+                    "pipelines). Raise gossip_every or lower the depth."
+                )
         if self.gossip_every < 0:
             raise ValueError(
                 f"gossip_every={self.gossip_every} must be >= 0 "

@@ -77,6 +77,23 @@ def tiny_gossip_cfg(**overrides):
     return tiny_cfg(**base)
 
 
+def tiny_gala_cfg(**overrides):
+    """The composed pipelined-gossip-fleet audit variant: the gossip
+    shape (4 replicas, full graph, H=1, Byzantine NaN replica 3) with
+    each replica's actor tier running 2 blocks ahead, a mix every 2
+    blocks (Config requires ``pipeline_depth <= gossip_every``), and
+    a live canary deploy gate — the canonical shape the gala_mix_block
+    cost row and the composed retrace case compile."""
+    base = dict(
+        pipeline_depth=2,
+        gossip_every=2,
+        canary_band=0.5,
+        canary_blocks=1,
+    )
+    base.update(overrides)
+    return tiny_gossip_cfg(**base)
+
+
 def census_cfg(**overrides):
     """The collective-census variant: 4 cooperative agents on a
     circulant degree-3 ring, so the agent axis tiles evenly over a

@@ -63,6 +63,7 @@ _ANCHORS = {
     "update_block": "rcmarl_tpu/training/update.py",
     "train_block": "rcmarl_tpu/training/trainer.py",
     "gossip_mix_block": "rcmarl_tpu/parallel/gossip.py",
+    "gala_mix_block": "rcmarl_tpu/parallel/gala.py",
     "fit_block": "rcmarl_tpu/training/update.py",
     "consensus_block": "rcmarl_tpu/training/update.py",
     "consensus_trunk": "rcmarl_tpu/ops/pallas_consensus.py",
@@ -180,6 +181,7 @@ def cost_arms() -> Dict[str, tuple]:
     from rcmarl_tpu.lint.configs import (
         tiny_cfg,
         tiny_faulted_cfg,
+        tiny_gala_cfg,
         tiny_gossip_cfg,
         tiny_mixed_cfg,
     )
@@ -189,6 +191,14 @@ def cost_arms() -> Dict[str, tuple]:
             tiny_gossip_cfg(),
             False,
             ("gossip_mix_block",),
+        ),
+        # the composed fleet's stack->mix->unstack launch over solo
+        # replica trees (rcmarl_tpu.parallel.gala) at the same
+        # canonical 4-replica shape
+        "gala": (
+            tiny_gala_cfg(),
+            False,
+            ("gala_mix_block",),
         ),
         "dual": (
             tiny_cfg(netstack=False),

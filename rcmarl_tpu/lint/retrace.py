@@ -212,6 +212,7 @@ def audit_retrace(
     fitstack_dtypes: bool = True,
     fused_epoch: bool = True,
     fused_serve: bool = True,
+    gala: bool = True,
 ) -> List[Finding]:
     """``lint --retrace``: prove exactly-once compilation on tiny runs.
 
@@ -318,6 +319,11 @@ def audit_retrace(
     auditor.findings.extend(_audit_serve(auditor, steady_blocks))
     auditor.findings.extend(_audit_fleet(auditor, steady_blocks))
     _audit_pipeline(auditor, steady_blocks)
+    if gala:
+        # the composed pipelined-gossip-fleet case — ``gala=False``
+        # lets the tier-1 pytest wrapper shed it to the slow twin /
+        # CI graftlint cell, the fused_epoch pattern
+        _audit_gala(auditor, steady_blocks)
     if fused_serve:
         # the ONE-KERNEL serving path (interpret arm) + the autoscale
         # resize discipline — ``fused_serve=False`` lets the tier-1
@@ -352,6 +358,34 @@ def _audit_pipeline(auditor: "RetraceAuditor", steady_blocks: int) -> None:
             cfg,
             n_episodes=cfg.n_ep_fixed * (steady_blocks + 1),
             state=state,
+        )
+
+
+def _audit_gala(auditor: "RetraceAuditor", steady_blocks: int) -> None:
+    """The COMPOSED compile-once case: a 4-replica pipelined gossip
+    fleet (each replica a depth-2 actor/learner pipeline, a trimmed mix
+    every 2 blocks, Byzantine NaN replica 3, canary-gated deploy) warms
+    up across one full mix round + canary publish, then a resumed
+    steady run must re-dispatch the same executables — actor_block,
+    learner_block, gala_mix_block, eval_block — with ZERO recompiles:
+    published params, mix payloads, exclusion masks, and canary
+    candidates are all data, so neither a mix, a publish, nor a canary
+    eval may ever be a compile."""
+    from rcmarl_tpu.lint.configs import tiny_gala_cfg
+    from rcmarl_tpu.parallel.gala import train_gala
+
+    cfg = tiny_gala_cfg()
+    # warmup: compiles the pipeline pair + the composed mix + the
+    # canary eval (two blocks = one mixed segment, one deploy round)
+    states, df = train_gala(cfg, n_episodes=cfg.n_ep_fixed * 2)
+    with auditor.expect_no_compiles(
+        context="pipelined gossip fleet across mix + canary rounds"
+    ):
+        train_gala(
+            cfg,
+            n_episodes=cfg.n_ep_fixed * (steady_blocks + 1),
+            states=states,
+            start_round=df.attrs["gossip"]["gossip_round"],
         )
 
 

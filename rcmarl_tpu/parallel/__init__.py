@@ -3,6 +3,12 @@ from rcmarl_tpu.parallel.distributed import (  # noqa: F401
     initialize,
     multihost_mesh,
 )
+from rcmarl_tpu.parallel.gala import (  # noqa: F401
+    gala_fingerprint,
+    gala_mix_block,
+    gala_summary,
+    train_gala,
+)
 from rcmarl_tpu.parallel.gossip import (  # noqa: F401
     gossip_mix_block,
     replica_in_nodes,

@@ -1,0 +1,766 @@
+"""Pipelined gossip fleets — pipeline × gossip × canary, one topology.
+
+The repo's three hardened parallel axes were mutually exclusive by
+construction: the async actor-learner pipeline
+(:mod:`rcmarl_tpu.pipeline`), Byzantine-resilient gossip learners
+(:mod:`rcmarl_tpu.parallel.gossip`), and canary-gated publishing
+(:mod:`rcmarl_tpu.serve.canary`). This module composes them into the
+GALA architecture (gossip-based actor-learner, arXiv:1906.04585, with
+TorchBeast's queue discipline, arXiv:1910.03552):
+
+- **R per-replica pipelines** — each of ``cfg.replicas`` learner
+  replicas owns a SOLO async pipeline: its own actor tier
+  (:func:`rcmarl_tpu.serve.engine.actor_block` dispatched
+  ``cfg.pipeline_depth`` blocks ahead through a
+  :class:`~rcmarl_tpu.pipeline.queue.BlockQueue`), its own
+  :class:`~rcmarl_tpu.pipeline.publish.PolicyPublisher`, its own
+  key chain, window-redraw guard, and staleness counters. The replicas
+  dispatch the EXISTING solo jitted entries (``actor_block``,
+  ``learner_block``/``learner_block_donated``) — R dispatches of the
+  same compiled executables per block, zero new steady-state programs
+  on the training path.
+- **gossip mixes at segment boundaries** — every ``cfg.gossip_every``
+  blocks each replica's actor tier DRAINS (Config validates
+  ``pipeline_depth <= gossip_every``, so steady-state pipelining is
+  never lost to the drain) and the replicas' parameter trees mix
+  through :data:`gala_mix_block`: the replica trees stack to the
+  ``(R, P_total)`` block, run the exact
+  :func:`~rcmarl_tpu.parallel.gossip._gossip_mix_block` exchange →
+  fault injection → trimmed mix, and unstack back to solo trees — ONE
+  launch per round, the registered jitted entry point of the composed
+  topology. Post-mix parameters are force-republished to every actor
+  tier, so acting params are data and a mix is never a compile.
+- **canary-gated deploy** — after every segment the WINNING replica
+  (best segment mean return among healthy, non-quarantined,
+  non-Byzantine replicas) is offered to a deploy
+  :class:`~rcmarl_tpu.pipeline.publish.PolicyPublisher` with
+  ``validate=True`` and, when ``cfg.canary_band > 0``, a
+  :class:`~rcmarl_tpu.serve.canary.CanaryGate` bound as the admission
+  callable: a finite-but-regressed winner is rejected at the gate, a
+  poisoned winner at the finiteness guard, and the serving fleet keeps
+  the last good policy either way. ``deploy.acting`` IS the
+  fleet-facing policy (the in-memory twin of the checkpoint chain).
+
+**Resilience composes, not coexists.** Per-replica window redraws and
+learner retries/skips run inside each replica's pipeline exactly as in
+the solo pipelined trainer; per-replica rollback / exclusion / sticky
+quarantine / readmission run at segment boundaries exactly as in the
+synchronous gossip trainer — a replica whose segment ends with
+non-finite params/metrics rolls back alone to its last good post-mix
+state, and a replica that SKIPPED blocks this segment (the pipeline
+guard already contained the poison) is excluded from the next mix
+without a rollback. All counters merge onto one ``df.attrs`` surface
+(``pipeline`` / ``guard`` / ``gossip`` / ``canary``) and one summary
+line (:func:`gala_summary` — the CI smoke cell's grep target).
+
+**RNG discipline.** Each replica's segment walks its key chain from
+the replica's STORED key — a segment boundary behaves exactly like a
+checkpoint-resume boundary, so a skip's or rollback's stored-key fold
+takes effect at the next segment precisely as it would on resume (the
+solo pipeline applies in-run folds only at resume too; within a
+segment the dispatch chain stays unperturbed, the solo contract).
+
+**Degenerate arms delegate.** ``pipeline_depth == 0`` IS the
+synchronous gossip trainer (:func:`~rcmarl_tpu.parallel.gossip.
+train_gossip` — and with ``gossip_every == 0`` therefore bitwise the
+independent seed-axis run, the existing pin chain); ``replicas == 1``
+IS the solo pipelined trainer (:func:`~rcmarl_tpu.pipeline.trainer.
+train_pipelined`). Both pins hold by CONSTRUCTION — delegation, not a
+hand-maintained twin loop — and are still pinned leaf-for-leaf in
+tests/test_gala.py as the regression net.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rcmarl_tpu.config import Config
+from rcmarl_tpu.parallel.gossip import (
+    _ROLLBACK_STREAM,
+    _gossip_mix_block,
+    _segment_lengths,
+    replica_seeds,
+)
+from rcmarl_tpu.pipeline.publish import PolicyPublisher
+from rcmarl_tpu.pipeline.queue import BlockQueue
+from rcmarl_tpu.pipeline.trainer import (
+    _REDRAW_STREAM,
+    _skip_stored_key,
+    _window_healthy,
+    learner_block,
+    learner_block_donated,
+)
+
+
+def _gala_mix_block(cfg: Config, params, prev_params, round_idx, exclude):
+    """ONE composed gossip round over a TUPLE of R solo parameter trees.
+
+    The replicas of a composed run live as solo trees (each drives its
+    own pipeline through the solo jitted entries), so the mix stacks
+    them to the replica-axis layout, runs the EXACT synchronous
+    exchange → fault injection → trimmed mix
+    (:func:`~rcmarl_tpu.parallel.gossip._gossip_mix_block` — one
+    ``(R, n_in, P_total)`` gather/trim/clip/mean), and unstacks the
+    result back to a tuple of solo trees. Stack and unstack fuse into
+    the mix launch: the whole round stays ONE program
+    (:data:`gala_mix_block`, the composed topology's registered entry
+    point).
+
+    Args mirror the synchronous mix: ``params``/``prev_params`` are
+    length-R tuples of solo AgentParams (``prev_params`` is the stale
+    replay payload — pass ``params`` again when no plan needs it),
+    ``round_idx`` a () int32, ``exclude`` an (R,) bool guard-exclusion
+    mask. Returns ``(tuple of R mixed solo trees, FaultDiag)``.
+    """
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *params)
+    prev = jax.tree.map(lambda *xs: jnp.stack(xs), *prev_params)
+    mixed, diag = _gossip_mix_block(cfg, stacked, prev, round_idx, exclude)
+    outs = tuple(
+        jax.tree.map(lambda x, r=r: x[r], mixed)
+        for r in range(cfg.replicas)
+    )
+    return outs, diag
+
+
+#: The composed topology's jitted mix entry point — registered in
+#: :func:`rcmarl_tpu.utils.profiling.jit_entry_points`, audited by the
+#: retrace / cost lint arms like every steady-state program. Compiles
+#: once per Config; every mix round re-dispatches the same executable.
+gala_mix_block = partial(jax.jit, static_argnums=0)(_gala_mix_block)
+
+
+def gala_fingerprint(cfg: Config) -> str:
+    """The ``cost_fingerprint`` of a composed measurement: one hash over
+    the three steady-state programs a composed run dispatches (the
+    actor-tier rollout block, the donated learner block, the composed
+    mix), abstract lowering only — the
+    :func:`~rcmarl_tpu.pipeline.trainer.pipeline_fingerprint` ledger
+    convention extended to the three-program arm."""
+    from rcmarl_tpu.pipeline.trainer import pipeline_fingerprint
+    from rcmarl_tpu.training.trainer import init_train_state
+    from rcmarl_tpu.utils.profiling import program_fingerprint
+
+    params = tuple(
+        jax.eval_shape(
+            lambda k: init_train_state(cfg, k).params, jax.random.PRNGKey(0)
+        )
+        for _ in range(cfg.replicas)
+    )
+    mix = gala_mix_block.lower(
+        cfg,
+        params,
+        params,
+        jnp.zeros((), jnp.int32),
+        jnp.zeros((cfg.replicas,), bool),
+    )
+    return program_fingerprint(pipeline_fingerprint(cfg) + mix.as_text())
+
+
+def gala_summary(attrs: dict) -> str:
+    """THE one merged counters line of a composed run (cmd_train prints
+    it; the CI smoke cell greps staleness + gossip + canary off it)."""
+    p = attrs["pipeline"]
+    g = attrs["gossip"]
+    c = attrs["canary"]
+    return (
+        f"gala: {g['replicas']} replicas × depth {p['depth']} — "
+        f"staleness mean {p['staleness_mean']:.2f} / max "
+        f"{p['staleness_max']}, {p['publishes']} publishes, "
+        f"{p['rejects']} rejects | gossip: {g['rounds']} rounds, "
+        f"{g['rollbacks']} rollbacks, {g['excluded']} exclusions, "
+        f"{sum(g['quarantined'])} quarantined, healthy "
+        f"{sum(g['replica_healthy'])}/{g['replicas']} | canary: "
+        f"{c['accepts']} accepted, {c['rejects']} rejected over "
+        f"{c['evals']} evals, {c['deploys']} deploys, "
+        f"{c['deploy_rejects'] + c['canary_rejects']} deploy rejects"
+    )
+
+
+def _stack_states(states_list):
+    """Solo TrainStates -> the replica-stacked layout every replica
+    trainer returns (checkpoint meta carries ``replicas``, so the
+    stacked file round-trips through the gossip resume path)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states_list)
+
+
+def _unstack_states(states, R: int):
+    """Replica-stacked TrainState -> list of R solo TrainStates (fresh
+    buffers — slicing gathers, so the solo trees are donation-safe)."""
+    return [jax.tree.map(lambda x, r=r: x[r], states) for r in range(R)]
+
+
+def train_gala(
+    cfg: Config,
+    n_episodes: Optional[int] = None,
+    states=None,
+    verbose: bool = False,
+    block_callback=None,
+    guard: Optional[bool] = None,
+    max_retries: int = 1,
+    window_fault=None,
+    start_round: int = 0,
+    excluded=None,
+    readmit_after: int = 0,
+):
+    """Host-looped composed run: R gossiping pipelined learner replicas
+    behind one canary-gated deploy publisher (see module docstring).
+
+    The :func:`~rcmarl_tpu.parallel.gossip.train_gossip` signature and
+    return contract (replica-stacked TrainState + non-Byzantine-mean
+    DataFrame) merged with the pipelined trainer's guard knobs:
+
+    Args:
+      guard: per-block pipeline guard AND per-segment replica guard
+        (``None`` auto-enables under any active fault plan, both
+        levels together — the composed run has one threat model).
+      max_retries: the pipeline guard's per-block redraw/retry budget.
+      window_fault: the composed chaos seam —
+        ``window_fault(replica, block, attempt, fresh, metrics)``,
+        the solo pipeline's transit seam with the replica index
+        prepended, so the chaos campaign can poison ONE replica's
+        actor tier inside a live fleet.
+      states / start_round / excluded / readmit_after: the gossip
+        resume/quarantine protocol, verbatim.
+
+    ``df.attrs`` carries the MERGED counter surface: ``pipeline``
+    (per-dispatch staleness across all replicas, publishes/rejects
+    summed — mix-round force republishes included), ``guard`` (summed
+    retries/redraws/skips plus per-replica breakdowns), ``gossip``
+    (the synchronous trainer's full key set), ``canary`` (gate +
+    deploy-publisher counters), and ``gala`` (the topology marker
+    cmd_train keys the merged summary line on).
+    """
+    R = cfg.replicas
+    depth = cfg.pipeline_depth
+    if R < 1:
+        raise ValueError(
+            f"train_gala needs cfg.replicas >= 1 (got {R}); the solo "
+            "pipelined trainer is rcmarl_tpu.pipeline.trainer."
+            "train_pipelined"
+        )
+    n_eps = cfg.n_episodes if n_episodes is None else n_episodes
+    if n_eps % cfg.n_ep_fixed != 0:
+        raise ValueError(
+            f"n_episodes={n_eps} must be a multiple of "
+            f"n_ep_fixed={cfg.n_ep_fixed}"
+        )
+    if max_retries < 0:
+        raise ValueError(f"max_retries={max_retries} must be >= 0")
+    if readmit_after < 0:
+        raise ValueError(f"readmit_after={readmit_after} must be >= 0")
+
+    if depth == 0:
+        # ---- the synchronous-gossip reference arm IS the synchronous
+        # gossip trainer: delegate, so the (R, depth=0) pin — and,
+        # through its own pin, the (R, depth=0, gossip_every=0) pin to
+        # train_parallel — is bitwise by construction
+        if window_fault is not None:
+            raise ValueError(
+                "window_fault is the decoupled tiers' transit seam; "
+                "the depth-0 synchronous handoff has no actor->learner "
+                "transit to fault (run pipeline_depth >= 1)"
+            )
+        from rcmarl_tpu.parallel.gossip import train_gossip
+
+        states, df = train_gossip(
+            cfg,
+            n_episodes=n_eps,
+            states=states,
+            verbose=verbose,
+            block_callback=block_callback,
+            guard=guard,
+            start_round=start_round,
+            excluded=excluded,
+            readmit_after=readmit_after,
+        )
+        n_blocks = n_eps // cfg.n_ep_fixed
+        df.attrs["pipeline"] = {
+            "depth": 0,
+            "publish_every": cfg.publish_every,
+            "blocks": n_blocks,
+            "staleness": [0] * n_blocks,
+            "staleness_mean": 0.0,
+            "staleness_max": 0,
+            "publishes": n_blocks,
+            "rejects": 0,
+        }
+        return states, df
+
+    if R == 1:
+        # ---- a one-replica fleet IS the solo pipelined trainer (a
+        # self-mix is an identity): delegate, so the (depth>0, R=1)
+        # pin is bitwise by construction; the returned state gains the
+        # replica axis so the checkpoint layout matches the fleet path
+        from rcmarl_tpu.pipeline.trainer import train_pipelined
+
+        wf = None
+        if window_fault is not None:
+            wf = lambda b, a, f, m: window_fault(0, b, a, f, m)  # noqa: E731
+        solo = None if states is None else _unstack_states(states, 1)[0]
+        solo, df = train_pipelined(
+            cfg,
+            n_episodes=n_eps,
+            state=solo,
+            verbose=verbose,
+            block_callback=(
+                None
+                if block_callback is None
+                else lambda s, b: block_callback(
+                    _stack_states([s]),
+                    b,
+                    {"replicas": 1, "gossip_round": start_round,
+                     "excluded": [0], "segment_blocks": 1},
+                )
+            ),
+            guard=guard,
+            max_retries=max_retries,
+            window_fault=wf,
+        )
+        df.attrs["gossip"] = {
+            "rounds": 0, "rollbacks": 0, "excluded": 0, "readmitted": 0,
+            "nonfinite": 0, "deficit": 0, "replicas": 1,
+            "gossip_every": cfg.gossip_every, "graph": cfg.gossip_graph,
+            "mix": cfg.gossip_mix, "H": cfg.gossip_H, "byzantine": [],
+            "replica_healthy": [True], "gossip_round": int(start_round),
+            "excluded_mask": [0], "readmit_after": readmit_after,
+            "quarantined": [0],
+        }
+        return _stack_states([solo]), df
+
+    # ---- the composed fleet
+    from rcmarl_tpu.faults import params_finite, tree_all_finite
+    from rcmarl_tpu.serve.engine import actor_block
+    from rcmarl_tpu.training.trainer import (
+        _block_healthy,
+        init_train_state,
+        metrics_to_dataframe,
+    )
+
+    n_blocks = n_eps // cfg.n_ep_fixed
+    if guard is None:
+        guard = (
+            cfg.fault_plan is not None and cfg.fault_plan.active
+        ) or (
+            cfg.replica_fault_plan is not None
+            and cfg.replica_fault_plan.active
+        )
+    with_diag = cfg.fault_plan is not None and cfg.fault_plan.active
+    donate = not guard
+    learner = learner_block if guard else learner_block_donated
+
+    if states is None:
+        state = [
+            init_train_state(cfg, jax.random.PRNGKey(s))
+            for s in replica_seeds(cfg)
+        ]
+    else:
+        # slicing the stacked resume state gathers into fresh buffers,
+        # so the caller's state stays alive whatever the donate policy
+        state = _unstack_states(states, R)
+
+    plan = cfg.replica_fault_plan
+    byz = set(plan.byzantine_replicas) if plan is not None else set()
+    stale_replay = plan is not None and plan.active and float(plan.stale_p) > 0
+    carried = (
+        np.zeros(R, bool) if excluded is None else np.asarray(excluded, bool)
+    )
+    excluded_mask = carried if readmit_after == 0 else np.zeros(R, bool)
+    quarantine = carried.copy() if readmit_after > 0 else np.zeros(R, bool)
+    streak = np.zeros(R, np.int64)
+    round_idx = int(start_round)
+
+    # ---- per-replica pipeline plumbing (the solo trainer's, times R)
+    publisher = [
+        PolicyPublisher(state[r].params, cfg.publish_every, copy=donate)
+        for r in range(R)
+    ]
+    desired0 = [jnp.copy(state[r].desired) for r in range(R)]
+    initial0 = [jnp.copy(state[r].initial) for r in range(R)]
+    staleness = [[] for _ in range(R)]
+    rep_stats = [
+        {"retries": 0, "redraws": 0, "skipped": 0, "nonfinite": 0,
+         "deficit": 0}
+        for _ in range(R)
+    ]
+    all_metrics = [[] for _ in range(R)]
+
+    # ---- the canary-gated deploy publisher (the fleet-facing policy)
+    gate = None
+    if cfg.canary_band:
+        from rcmarl_tpu.serve.canary import CanaryGate
+
+        gate = CanaryGate(
+            cfg,
+            desired0[0],
+            initial0[0],
+            band=cfg.canary_band,
+            blocks=cfg.canary_blocks,
+            eval_seed=cfg.gossip_seed,
+        )
+        gate.set_incumbent(state[0].params)
+    deploy = PolicyPublisher(
+        state[0].params,
+        1,
+        copy=donate,
+        validate=True,
+        canary=gate.admit if gate is not None else None,
+    )
+
+    # gossip-level rollback targets / stale-replay payloads: post-mix
+    # snapshots. With guard on the learner keeps inputs alive, so the
+    # states themselves are safe to hold; the donated (unguarded) loop
+    # consumes its state buffers, so stale payloads must be copies.
+    last_good = list(state) if guard else None
+    prev_payload = (
+        [jax.tree.map(jnp.copy, state[r].params) for r in range(R)]
+        if stale_replay
+        else None
+    )
+
+    stats_g = {
+        "rounds": 0, "rollbacks": 0, "excluded": 0, "readmitted": 0,
+        "nonfinite": 0, "deficit": 0,
+    }
+    deploy_round = 0
+    blocks_done = 0
+
+    def _run_segment(r: int, start: int, seg_len: int):
+        """One replica's pipelined segment: the solo pipelined loop over
+        blocks [start, start+seg_len), chain walked from the replica's
+        stored key (the resume discipline — see module docstring),
+        queue drained by construction at the boundary."""
+        st = state[r]
+        pub = publisher[r]
+        stats = rep_stats[r]
+        chain = [st.key]
+        keys = []
+
+        def block_keys(j_local: int):
+            while len(keys) <= j_local:
+                nk, kr, ku = jax.random.split(chain[-1], 3)
+                chain.append(nk)
+                keys.append((kr, ku))
+            return keys[j_local]
+
+        queue = BlockQueue(depth)
+        seg_metrics = []
+
+        def dispatch_actor(j_local: int) -> None:
+            k_roll, _ = block_keys(j_local)
+            fresh, m = actor_block(
+                cfg, pub.acting, desired0[r], k_roll, initial0[r]
+            )
+            staleness[r].append(start + j_local - pub.published_block)
+            queue.put((j_local, fresh, m))
+
+        for j in range(min(depth, seg_len)):
+            dispatch_actor(j)
+        for bl in range(seg_len):
+            b = start + bl  # the global block index
+            j, fresh, m = queue.get()
+            assert j == bl, f"pipeline order broke: got block {j} at {bl}"
+            if window_fault is not None:
+                fresh, m = window_fault(r, b, 0, fresh, m)
+            _, k_upd = block_keys(bl)
+            new_key = chain[bl + 1]
+            attempt = 0
+            accepted = True
+            diag = None
+            window_ok = True
+            if guard:
+                window_ok = _window_healthy(fresh, m)
+                redraw = 0
+                while not window_ok and redraw < max_retries:
+                    redraw += 1
+                    stats["redraws"] += 1
+                    if verbose:
+                        print(
+                            f"| replica {r} block {b + 1} | non-finite "
+                            f"rollout window — redrawing (redraw "
+                            f"{redraw}/{max_retries})"
+                        )
+                    k_roll = jax.random.fold_in(
+                        jax.random.fold_in(chain[bl], _REDRAW_STREAM),
+                        redraw,
+                    )
+                    fresh, m = actor_block(
+                        cfg, pub.acting, desired0[r], k_roll, initial0[r]
+                    )
+                    if window_fault is not None:
+                        fresh, m = window_fault(r, b, redraw, fresh, m)
+                    window_ok = _window_healthy(fresh, m)
+            if not window_ok:
+                stats["skipped"] += 1
+                if verbose:
+                    print(
+                        f"| replica {r} block {b + 1} | rollout window "
+                        f"still non-finite after {max_retries} redraws "
+                        "— skipping (no learner launch)"
+                    )
+                st = _skip_stored_key(st, b)
+                accepted = False
+            else:
+                while True:
+                    if attempt:
+                        k_upd = jax.random.fold_in(chain[bl], attempt)
+                    diag = None
+                    if with_diag:
+                        new_state, diag = learner(
+                            cfg, st, fresh, k_upd, new_key, with_diag=True
+                        )
+                    else:
+                        new_state = learner(cfg, st, fresh, k_upd, new_key)
+                    if not guard or _block_healthy(new_state, m):
+                        st = new_state
+                        break
+                    if attempt < max_retries:
+                        attempt += 1
+                        stats["retries"] += 1
+                        if verbose:
+                            print(
+                                f"| replica {r} block {b + 1} | "
+                                f"non-finite learner output — rolling "
+                                f"back (retry {attempt}/{max_retries})"
+                            )
+                        continue
+                    stats["skipped"] += 1
+                    if verbose:
+                        print(
+                            f"| replica {r} block {b + 1} | still "
+                            f"non-finite after {max_retries} retries — "
+                            "skipping (params rolled back)"
+                        )
+                    st = _skip_stored_key(st, b)
+                    accepted = False
+                    break
+            if diag is not None:
+                stats["nonfinite"] += int(diag.nonfinite)
+                stats["deficit"] += int(diag.deficit)
+            seg_metrics.append(m)
+            all_metrics[r].append(m)
+            if accepted:
+                pub.offer(st.params, b + 1)
+            if bl + depth < seg_len:
+                dispatch_actor(bl + depth)
+        state[r] = st
+        return seg_metrics
+
+    for seg_len, mix_after in _segment_lengths(n_blocks, cfg.gossip_every):
+        seg_start = blocks_done
+        skipped_before = [rep_stats[r]["skipped"] for r in range(R)]
+        seg_metrics = [_run_segment(r, seg_start, seg_len) for r in range(R)]
+        blocks_done += seg_len
+        healthy = np.ones(R, bool)
+        if guard:
+            for r in range(R):
+                finite = bool(
+                    tree_all_finite(
+                        (state[r].params, tuple(seg_metrics[r]))
+                    )
+                )
+                skipped_seg = rep_stats[r]["skipped"] - skipped_before[r]
+                # a replica whose pipeline guard SKIPPED blocks this
+                # segment already contained its poison (params rolled
+                # back block-locally, nothing published) — no gossip
+                # rollback, but its params sit out the next mix; a
+                # replica that ends the segment NON-FINITE (guard off
+                # at the block level never happens here, but metrics
+                # can go non-finite under an unsanitized plan) rolls
+                # back to its last good post-mix state
+                healthy[r] = finite and skipped_seg == 0
+                if not finite:
+                    stats_g["rollbacks"] += 1
+                    lg = last_good[r]
+                    state[r] = lg._replace(
+                        key=jax.random.fold_in(
+                            lg.key, _ROLLBACK_STREAM + round_idx
+                        ),
+                        block=lg.block + seg_len,
+                    )
+                    # the actor tier must not keep acting on the
+                    # poisoned publish chain
+                    publisher[r].offer(
+                        state[r].params, blocks_done, force=True
+                    )
+            if readmit_after > 0:
+                streak = np.where(quarantine & healthy, streak + 1, streak)
+                readmit = quarantine & healthy & (streak >= readmit_after)
+                if readmit.any():
+                    stats_g["readmitted"] += int(readmit.sum())
+                    quarantine &= ~readmit
+                    streak[readmit] = 0
+                quarantine |= ~healthy
+                streak[~healthy] = 0
+            else:
+                excluded_mask = excluded_mask | ~healthy
+        if mix_after:
+            mix_exclude = excluded_mask | quarantine
+            params_tuple = tuple(state[r].params for r in range(R))
+            prev_tuple = (
+                tuple(prev_payload) if stale_replay else params_tuple
+            )
+            mixed, diag = gala_mix_block(
+                cfg,
+                params_tuple,
+                prev_tuple,
+                jnp.asarray(round_idx, jnp.int32),
+                jnp.asarray(mix_exclude),
+            )
+            stats_g["rounds"] += 1
+            stats_g["excluded"] += int(mix_exclude.sum())
+            stats_g["nonfinite"] += int(diag.nonfinite)
+            stats_g["deficit"] += int(diag.deficit)
+            excluded_mask = np.zeros(R, bool)
+            round_idx += 1
+            for r in range(R):
+                state[r] = state[r]._replace(params=mixed[r])
+                # the mix is a publish event whatever the cadence: the
+                # actor tier must act on post-mix params, or queued
+                # windows would roll under a policy no learner holds
+                publisher[r].offer(state[r].params, blocks_done, force=True)
+            if guard:
+                for r in range(R):
+                    # only a finite post-mix tree may become the new
+                    # rollback target (the mean arm's poisoned mix must
+                    # not become the "good" state)
+                    if bool(params_finite(state[r].params)):
+                        last_good[r] = state[r]
+            if stale_replay:
+                prev_payload = [
+                    jax.tree.map(jnp.copy, state[r].params) for r in range(R)
+                ]
+        # ---- the canary-gated deploy: the winning replica's (post-mix)
+        # policy is offered to the fleet after every segment
+        deploy_round += 1
+        seg_means = np.full(R, np.nan)
+        for r in range(R):
+            tt = np.concatenate(
+                [np.asarray(m.true_team_returns) for m in seg_metrics[r]]
+            )
+            if np.isfinite(tt).any():
+                seg_means[r] = np.nanmean(tt)
+        eligible = [
+            r
+            for r in range(R)
+            if healthy[r]
+            and not quarantine[r]
+            and r not in byz
+            and np.isfinite(seg_means[r])
+        ]
+        if eligible:
+            winner = max(eligible, key=lambda r: seg_means[r])
+            deploy.offer(state[winner].params, deploy_round)
+        if verbose:
+            keep = [r for r in range(R) if r not in byz] or list(range(R))
+            import warnings as _warnings
+
+            with _warnings.catch_warnings():
+                _warnings.filterwarnings(
+                    "ignore", message="Mean of empty slice"
+                )
+                seg_return = float(np.nanmean(seg_means[np.array(keep)]))
+            print(
+                f"| blocks {blocks_done}/{n_blocks} | round {round_idx} "
+                f"| team return {seg_return:.3f}"
+                + (" | mixed" if mix_after else "")
+            )
+        if block_callback is not None:
+            block_callback(
+                _stack_states(state),
+                blocks_done - 1,
+                {
+                    "replicas": R,
+                    "gossip_round": round_idx,
+                    "excluded": [
+                        int(x) for x in (excluded_mask | quarantine)
+                    ],
+                    "segment_blocks": seg_len,
+                    "pipeline_depth": depth,
+                },
+            )
+
+    # ---- merge the metrics: one row per episode, the non-Byzantine
+    # replicas' nanmean (the synchronous gossip trainer's convention)
+    import warnings as _warnings
+
+    metrics = [
+        jax.tree.map(
+            lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
+            *all_metrics[r],
+        )
+        for r in range(R)
+    ]
+    stacked = jax.tree.map(lambda *xs: np.stack(xs), *metrics)
+    keep = [r for r in range(R) if r not in byz] or list(range(R))
+    with _warnings.catch_warnings():
+        _warnings.filterwarnings("ignore", message="Mean of empty slice")
+        mean_metrics = jax.tree.map(
+            lambda l: np.nanmean(l[np.array(keep)], axis=0), stacked
+        )
+    df = metrics_to_dataframe(mean_metrics)
+
+    # ---- the merged counter surface
+    flat_staleness = [s for r in range(R) for s in staleness[r]]
+    df.attrs["pipeline"] = {
+        "depth": depth,
+        "publish_every": cfg.publish_every,
+        "blocks": n_blocks,
+        "staleness": flat_staleness,
+        "staleness_mean": (
+            sum(flat_staleness) / len(flat_staleness)
+            if flat_staleness
+            else 0.0
+        ),
+        "staleness_max": max(flat_staleness, default=0),
+        "publishes": sum(p.counters["publishes"] for p in publisher),
+        "rejects": sum(p.counters["rejects"] for p in publisher),
+    }
+    if guard or with_diag:
+        df.attrs["guard"] = {
+            "retries": sum(s["retries"] for s in rep_stats),
+            "redraws": sum(s["redraws"] for s in rep_stats),
+            "skipped": sum(s["skipped"] for s in rep_stats),
+            "nonfinite": sum(s["nonfinite"] for s in rep_stats),
+            "deficit": sum(s["deficit"] for s in rep_stats),
+            "replica_retries": [s["retries"] for s in rep_stats],
+            "replica_redraws": [s["redraws"] for s in rep_stats],
+            "replica_skipped": [s["skipped"] for s in rep_stats],
+        }
+    healthy_final = [
+        bool(params_finite(state[r].params)) for r in range(R)
+    ]
+    df.attrs["gossip"] = {
+        **stats_g,
+        "replicas": R,
+        "gossip_every": cfg.gossip_every,
+        "graph": cfg.gossip_graph,
+        "mix": cfg.gossip_mix,
+        "H": cfg.gossip_H,
+        "byzantine": sorted(byz),
+        "replica_healthy": healthy_final,
+        "gossip_round": round_idx,
+        "excluded_mask": [int(x) for x in (excluded_mask | quarantine)],
+        "readmit_after": readmit_after,
+        "quarantined": [int(x) for x in quarantine],
+    }
+    df.attrs["canary"] = {
+        "band": cfg.canary_band,
+        "blocks": cfg.canary_blocks,
+        "evals": gate.counters["evals"] if gate is not None else 0,
+        "accepts": gate.counters["accepts"] if gate is not None else 0,
+        "rejects": gate.counters["rejects"] if gate is not None else 0,
+        "incumbent_return": (
+            gate.incumbent_return if gate is not None else None
+        ),
+        "deploys": deploy.counters["publishes"],
+        "deploy_rejects": deploy.counters["rejects"],
+        "canary_rejects": deploy.counters["canary_rejects"],
+        "deploy_healthy": bool(params_finite(deploy.acting)),
+    }
+    df.attrs["gala"] = {"replicas": R, "depth": depth}
+    return _stack_states(state), df

@@ -84,17 +84,26 @@ class PolicyPublisher:
         # XLA's ordinary dependency ordering — never a host sync
         return jax.tree.map(jnp.copy, params)
 
-    def offer(self, params: Any, learner_block: int) -> bool:
+    def offer(
+        self, params: Any, learner_block: int, *, force: bool = False
+    ) -> bool:
         """Offer the learner's parameters after ``learner_block``
         completed blocks; publish iff this is a publish boundary and
         (under ``validate``) the candidate is fully finite.
+
+        ``force=True`` waives only the cadence check — the composed
+        fleet's gossip mix and rollback are publish events whatever
+        ``publish_every`` says (an actor tier acting on pre-mix params
+        would roll windows under a policy no learner holds), but a
+        forced candidate still runs the full finiteness and canary
+        guards. Cadence is a throttle; the guards are the contract.
 
         Returns True iff the acting reference was swapped. A rejected
         candidate leaves the actor tier on the last good parameters
         with ``rejects`` incremented — the watcher's degradation
         contract, one level down the stack.
         """
-        if learner_block % self.publish_every != 0:
+        if not force and learner_block % self.publish_every != 0:
             return False
         if self.validate:
             from rcmarl_tpu.faults import params_finite

@@ -50,6 +50,7 @@ def jit_entry_points() -> Dict[str, object]:
         fused_fleet_block,
         fused_serve_block,
     )
+    from rcmarl_tpu.parallel.gala import gala_mix_block
     from rcmarl_tpu.parallel.gossip import gossip_mix_block
     from rcmarl_tpu.pipeline.trainer import (
         learner_block,
@@ -71,6 +72,7 @@ def jit_entry_points() -> Dict[str, object]:
         "train_block": train_block,
         "train_block_donated": train_block_donated,
         "gossip_mix_block": gossip_mix_block,
+        "gala_mix_block": gala_mix_block,
         "fit_block": fit_block,
         "consensus_block": consensus_block,
         "serve_block": serve_block,
@@ -227,6 +229,33 @@ def gossip_entry_inputs(cfg):
     return _GOSSIP_INPUT_CACHE[cfg]
 
 
+_GALA_INPUT_CACHE: dict = {}
+
+
+def gala_entry_inputs(cfg):
+    """(tuple of R solo params, round, exclude): real tiny inputs for
+    lowering the composed-fleet mix entry point — the SAME replica
+    parameters :func:`gossip_entry_inputs` stacks, kept as the solo
+    trees the composed trainer actually holds (``cfg.replicas`` must
+    be set), memoized per config."""
+    if cfg not in _GALA_INPUT_CACHE:
+        import jax.numpy as jnp
+
+        from rcmarl_tpu.parallel.gossip import replica_seeds
+        from rcmarl_tpu.training.trainer import init_train_state
+
+        params = tuple(
+            init_train_state(cfg, jax.random.PRNGKey(s)).params
+            for s in replica_seeds(cfg)
+        )
+        _GALA_INPUT_CACHE[cfg] = (
+            params,
+            jnp.zeros((), jnp.int32),
+            jnp.zeros((cfg.replicas,), bool),
+        )
+    return _GALA_INPUT_CACHE[cfg]
+
+
 _SERVE_INPUT_CACHE: dict = {}
 
 #: Canonical serving batch for the audit arms — tiny (the cost rows'
@@ -306,12 +335,15 @@ def lowered_entry_points(
         cache_key = (cfg, with_diag, name)
         if cache_key not in _ENTRY_LOWERED_CACHE:
             fn = entries[name]
-            if name != "gossip_mix_block":
+            if name not in ("gossip_mix_block", "gala_mix_block"):
                 state, batch, fresh, key = entry_point_inputs(cfg)
             with _warnings.catch_warnings(record=True) as caught:
                 _warnings.simplefilter("always")
                 if name == "gossip_mix_block":
                     params, rnd, excl = gossip_entry_inputs(cfg)
+                    lowered = fn.lower(cfg, params, params, rnd, excl)
+                elif name == "gala_mix_block":
+                    params, rnd, excl = gala_entry_inputs(cfg)
                     lowered = fn.lower(cfg, params, params, rnd, excl)
                 elif name == "serve_block":
                     block, obs, skey = serve_entry_inputs(cfg)
@@ -424,6 +456,13 @@ def _traced_entry(cfg, with_diag: bool, name: str):
         fn = getattr(entries[name], "__wrapped__", entries[name])
         if name == "gossip_mix_block":
             params, rnd, excl = gossip_entry_inputs(cfg)
+            closed, out_shape = jax.make_jaxpr(
+                lambda p, q, r, e: fn(cfg, p, q, r, e), return_shape=True
+            )(params, params, rnd, excl)
+            _ENTRY_JAXPR_CACHE[cache_key] = (closed, out_shape)
+            return _ENTRY_JAXPR_CACHE[cache_key]
+        if name == "gala_mix_block":
+            params, rnd, excl = gala_entry_inputs(cfg)
             closed, out_shape = jax.make_jaxpr(
                 lambda p, q, r, e: fn(cfg, p, q, r, e), return_shape=True
             )(params, params, rnd, excl)

@@ -438,6 +438,31 @@ print("pipeline depth-0 bitwise pin OK (ragged+faulted+guarded)")
 PY
 echo "pipeline smoke cell OK"
 
+# Pipelined-gossip-fleet smoke cell (composed topology): a 2-replica
+# fleet, each learner fed by its own depth-2 actor tier, trimmed-mean
+# mixed every 2 blocks, under agent-level NaN bombs with sanitize and
+# the per-replica guard, publishing the winner through the canary-gated
+# deploy — the whole composed wire-up end to end (CLI flags -> Config
+# -> train_gala -> per-replica BlockQueue/publishers -> gala_mix_block
+# -> CanaryGate deploy -> checkpoint with gossip meta), which
+# tests/test_gala.py covers only layer by layer. Must exit rc=0 with
+# the ONE merged counters line (gala: ... | gossip: ... | canary: ...)
+# on the summary. R=2 on the full replica graph has gossip in-degree 2,
+# so the replica-level trim rides gossip_H=0 here; the H=1 composed
+# Byzantine arm is gated by the RESILIENCE.jsonl gala_byzantine cells.
+gala_log="$smoke_dir/gala.log"
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
+    --n_agents 3 --in_degree 3 --nrow 3 --ncol 3 \
+    --n_episodes 8 --n_ep_fixed 2 --max_ep_len 6 --n_epochs 2 --H 1 \
+    --replicas 2 --gossip_graph full --gossip_H 0 --gossip_every 2 \
+    --pipeline_depth 2 --canary_band 0.5 \
+    --fault_nan_p 0.1 --sanitize \
+    --summary_dir "$smoke_dir" --quiet | tee "$gala_log"
+grep -q "gala: 2 replicas" "$gala_log"
+grep -q "canary:" "$gala_log"
+grep -q "staleness mean" "$gala_log"
+echo "pipelined-gossip-fleet smoke cell OK"
+
 # Env-zoo smoke cell: every NEW environment of the registry trains end
 # to end through the real CLI (finite return curves, rc=0 — the
 # acceptance wire-up CLI -> Config.env -> registry -> generic rollout

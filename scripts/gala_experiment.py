@@ -1,0 +1,299 @@
+#!/usr/bin/env python
+"""Pipelined-gossip-fleet experiment — the composed PR's QUALITY evidence.
+
+Runs the COMPOSED topology (rcmarl_tpu.parallel.gala: R gossiping
+learner replicas, each fed by its own depth-D actor tier, trimmed-mean
+mixed every K blocks, the winner canary-gate-deployed) next to its
+PIECES, and proves composition degrades no worse than the pieces:
+
+- ``composed clean`` vs ``composed byz trimmed``: one always-NaN
+  Byzantine replica inside the pipelined fleet — the healthy R−1
+  replicas must stay finite and the last-window return must stay
+  inside the chaos band of the composed clean twin (the same band the
+  FLAT gossip Byzantine cell holds);
+- ``flat clean`` vs ``flat byz trimmed``: the pipeline_depth=0 pieces,
+  for the side-by-side degradation deltas;
+- ``composed byz mean``: the plain-mean comparison arm — the same
+  single NaN replica must poison it (documented fail), while the
+  canary-gated deploy publisher must still reject every poisoned
+  winner (serving containment holds even when training is lost).
+
+Also times the warm composed block for the PERF.jsonl composed
+steps/s row (headline:false on CPU — a serial core runs the tiers
+back to back).
+
+Artifacts:
+  --json_out   full per-arm results (committed:
+               simulation_results/gala_composed.json — QUALITY.md
+               renders its evidence section from this file)
+  --perf_out   append the composed steps/s JSONL row (PERF.jsonl)
+
+Usage (the committed evidence was generated with the defaults):
+  JAX_PLATFORMS=cpu python scripts/gala_experiment.py \
+      --json_out simulation_results/gala_composed.json \
+      --perf_out PERF.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from datetime import datetime
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: The chaos-cell band (rcmarl_tpu.chaos.registry.RETURN_BAND): a
+#: faulted arm within this relative band of its clean twin counts as
+#: functionally intact.
+BAND = 0.5
+
+
+def build_cfg(args, mix: str, byzantine: tuple, mode: str, depth: int):
+    from rcmarl_tpu.config import Config
+    from rcmarl_tpu.faults import ReplicaFaultPlan
+
+    plan = (
+        ReplicaFaultPlan(byzantine_replicas=byzantine, byzantine_mode=mode)
+        if byzantine
+        else None
+    )
+    return Config(
+        n_episodes=args.n_episodes,
+        n_ep_fixed=args.n_ep_fixed,
+        replicas=args.replicas,
+        gossip_graph="full",
+        gossip_H=args.gossip_H,
+        gossip_every=args.gossip_every,
+        gossip_mix=mix,
+        replica_fault_plan=plan,
+        pipeline_depth=depth,
+        canary_band=args.canary_band if depth else 0.0,
+        slow_lr=0.002,
+    )
+
+
+def _train(cfg):
+    if cfg.pipeline_depth:
+        from rcmarl_tpu.parallel.gala import train_gala
+
+        return train_gala(cfg)
+    from rcmarl_tpu.parallel.gossip import train_gossip
+
+    return train_gossip(cfg)
+
+
+def run_arm(args, label: str, mix: str, byzantine: tuple, mode: str,
+            depth: int) -> dict:
+    import numpy as np
+
+    cfg = build_cfg(args, mix, byzantine, mode, depth)
+    t0 = time.perf_counter()
+    states, df = _train(cfg)
+    dt = time.perf_counter() - t0
+    g = df.attrs["gossip"]
+    ret = np.asarray(df["True_team_returns"], float)
+    w = min(100, len(ret) // 4)
+    first = float(np.nanmean(ret[:w]))
+    last = float(np.nanmean(ret[-w:]))
+    healthy = g["replica_healthy"]
+    row = {
+        "arm": label,
+        "mix": mix,
+        "byzantine": list(byzantine),
+        "byzantine_mode": mode if byzantine else None,
+        "pipeline_depth": depth,
+        "replicas": args.replicas,
+        "gossip_H": args.gossip_H,
+        "gossip_every": args.gossip_every,
+        "rounds": g["rounds"],
+        "rollbacks": g["rollbacks"],
+        "excluded": g["excluded"],
+        "replica_healthy": healthy,
+        "healthy_ok": bool(
+            all(
+                healthy[r]
+                for r in range(args.replicas)
+                if r not in set(byzantine)
+            )
+        ),
+        "team_return_first": None if np.isnan(first) else round(first, 3),
+        "team_return_last": None if np.isnan(last) else round(last, 3),
+        "window_episodes": w,
+        "wall_seconds": round(dt, 1),
+    }
+    if depth:
+        p = df.attrs["pipeline"]
+        c = df.attrs["canary"]
+        row["staleness_mean"] = p["staleness_mean"]
+        row["publishes"] = p["publishes"]
+        # the guard family is only present when the guard ran (clean
+        # unguarded arms have nothing to count)
+        row["skipped"] = sum(
+            df.attrs.get("guard", {}).get(
+                "replica_skipped", [0] * args.replicas
+            )
+        )
+        row["canary"] = {
+            k: c[k]
+            for k in ("evals", "accepts", "rejects", "deploys",
+                      "deploy_rejects", "deploy_healthy")
+        }
+    return row
+
+
+def _within_band(final, clean) -> bool:
+    if final is None or clean is None:
+        return False
+    return abs(final - clean) <= BAND * max(1.0, abs(clean))
+
+
+def time_composed_block(args) -> dict:
+    """Warm composed steps/s — resume a warmed fleet for one more run
+    and report env steps per wall second (the PERF.jsonl composed row;
+    headline:false on CPU, the serial-core caveat of the pipeline
+    rows)."""
+    import jax
+
+    from rcmarl_tpu.parallel.gala import gala_fingerprint, train_gala
+
+    cfg = build_cfg(args, "trimmed", (), "nan", args.pipeline_depth)
+    warm_eps = 2 * cfg.n_ep_fixed
+    states, df = train_gala(cfg, n_episodes=warm_eps)  # compile + warm
+    t0 = time.perf_counter()
+    states, _ = train_gala(
+        cfg, n_episodes=warm_eps, states=states,
+        start_round=df.attrs["gossip"]["gossip_round"],
+    )
+    jax.block_until_ready(states.params)
+    dt = time.perf_counter() - t0
+    steps = warm_eps * cfg.max_ep_len * cfg.replicas
+    return {
+        "kind": "gala_composed",
+        "config": "ref5_gala",
+        "replicas": cfg.replicas,
+        "pipeline_depth": cfg.pipeline_depth,
+        "gossip_every": cfg.gossip_every,
+        "gossip_H": cfg.gossip_H,
+        "canary_band": cfg.canary_band,
+        "n_agents": cfg.n_agents,
+        "hidden": list(cfg.hidden),
+        "env_steps_per_sec": round(steps / dt, 1),
+        "sec_per_block": round(dt / (warm_eps // cfg.n_ep_fixed), 4),
+        "cost_fingerprint": gala_fingerprint(cfg),
+        "workload": {"episodes": warm_eps, "block_steps":
+                     cfg.n_ep_fixed * cfg.max_ep_len},
+        "platform": jax.devices()[0].platform,
+        "headline": jax.devices()[0].platform != "cpu",
+        "timestamp": datetime.now().isoformat(timespec="seconds"),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--replicas", type=int, default=4)
+    p.add_argument("--gossip_H", type=int, default=1)
+    p.add_argument("--gossip_every", type=int, default=4)
+    p.add_argument("--pipeline_depth", type=int, default=2)
+    p.add_argument("--canary_band", type=float, default=0.5)
+    p.add_argument("--n_episodes", type=int, default=400)
+    p.add_argument("--n_ep_fixed", type=int, default=50)
+    p.add_argument("--json_out", type=str, default=None)
+    p.add_argument("--perf_out", type=str, default=None)
+    args = p.parse_args()
+
+    byz = (args.replicas - 1,)
+    d = args.pipeline_depth
+    arms = [
+        ("flat clean", "trimmed", (), "nan", 0),
+        ("flat byz trimmed", "trimmed", byz, "nan", 0),
+        ("composed clean", "trimmed", (), "nan", d),
+        ("composed byz trimmed", "trimmed", byz, "nan", d),
+        ("composed byz mean", "mean", byz, "nan", d),
+    ]
+
+    results = []
+    for label, mix, b, mode, depth in arms:
+        print(f"== {label}", file=sys.stderr)
+        row = run_arm(args, label, mix, b, mode, depth)
+        results.append(row)
+        print(json.dumps(row))
+
+    perf = time_composed_block(args)
+    print(json.dumps(perf))
+    if args.perf_out:
+        with open(args.perf_out, "a") as f:
+            f.write(json.dumps(perf) + "\n")
+
+    by = {r["arm"]: r for r in results}
+    # verdict: (1) every trimmed arm keeps its healthy replicas finite;
+    # (2) the composed Byzantine arm holds the SAME chaos band vs its
+    # clean twin that the flat arm holds vs its own — composition
+    # degrades no worse than the pieces; (3) the mean arm is poisoned
+    # (else the comparison is vacuous) while its canary-gated deploy
+    # publisher rejected every poisoned winner (serving containment).
+    trimmed_ok = all(
+        r["healthy_ok"] for r in results if r["mix"] == "trimmed"
+    )
+    flat_in_band = _within_band(
+        by["flat byz trimmed"]["team_return_last"],
+        by["flat clean"]["team_return_last"],
+    )
+    composed_in_band = _within_band(
+        by["composed byz trimmed"]["team_return_last"],
+        by["composed clean"]["team_return_last"],
+    )
+    mean_row = by["composed byz mean"]
+    mean_poisoned = (
+        not mean_row["healthy_ok"]
+        or mean_row["rollbacks"] > 0
+        or mean_row["team_return_last"] is None
+    )
+    serving_contained = (
+        mean_row["canary"]["deploy_healthy"]
+        and (mean_row["canary"]["deploy_rejects"]
+             + mean_row["canary"]["rejects"]) >= 1
+    )
+    verdict = {
+        "trimmed_ok": trimmed_ok,
+        "flat_in_band": flat_in_band,
+        "composed_in_band": composed_in_band,
+        "mean_poisoned": mean_poisoned,
+        "serving_contained": serving_contained,
+    }
+    print(f"verdict: {verdict}", file=sys.stderr)
+
+    if args.json_out:
+        out = Path(args.json_out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(
+            json.dumps(
+                {
+                    "generated_by": "python scripts/gala_experiment.py",
+                    "config": {
+                        "replicas": args.replicas,
+                        "gossip_H": args.gossip_H,
+                        "gossip_every": args.gossip_every,
+                        "pipeline_depth": args.pipeline_depth,
+                        "canary_band": args.canary_band,
+                        "gossip_graph": "full",
+                        "n_episodes": args.n_episodes,
+                        "byzantine": list(byz),
+                    },
+                    "arms": results,
+                    "perf": perf,
+                    "verdict": verdict,
+                },
+                indent=1,
+            )
+            + "\n"
+        )
+        print(f"wrote {out}", file=sys.stderr)
+
+    return 0 if all(verdict.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -226,6 +226,18 @@ run_step "12b. serve micro-breakdown arms (forward/key/sample splits)" \
     --serve_micro --serve_impl xla pallas \
     --serve_batch 4096 --out PERF.jsonl
 
+# The pipelined gossip fleet (PR 17): the committed gala_composed
+# steps/s row is a CPU fallback (headline:false — a serial core runs
+# every replica's two tiers back to back, so it measures host-loop
+# overhead, not fleet overlap). This is the on-chip refit: the full
+# composed experiment (flat vs composed Byzantine bands + the mean
+# documented-fail arm + serving containment) at its committed defaults,
+# re-appending a headline composed steps/s row to PERF.jsonl.
+run_step "13. pipelined-gossip-fleet refit (composed steps/s, on-chip)" \
+    timeout 3600 python scripts/gala_experiment.py \
+    --json_out simulation_results/gala_composed_tpu.json \
+    --perf_out PERF.jsonl
+
 echo "== session summary =="
 rc=0
 for name in "${step_order[@]}"; do

@@ -135,6 +135,8 @@ class TestMaskedAggregation:
     just the valid prefix (reference accepts arbitrary adjacency lists,
     main.py:28)."""
 
+    # ~7s (10-trial compile sweep) — tier-1 870s wall-budget shed
+    @pytest.mark.slow
     def test_matches_unpadded_prefix(self):
         rng = np.random.default_rng(5)
         for trial in range(10):

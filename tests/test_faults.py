@@ -624,6 +624,9 @@ class TestCheckpointIntegrity:
 
 
 class TestSweepIsolation:
+    # ~11s — tier-1 870s wall-budget shed; the nonfinite-cell isolation
+    # twin below stays fast
+    @pytest.mark.slow
     def test_one_failing_cell_does_not_abort_matrix(self, tmp_path, monkeypatch):
         """`sweep` retries a failing cell once, records it, skips it, and
         still completes (and writes) every other cell; rc is nonzero so

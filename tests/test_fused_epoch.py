@@ -259,6 +259,9 @@ class TestFusedEpoch:
     def test_epoch_bitwise_faulted_sanitize_mixed(self):
         self._pin_epoch(self.KW)
 
+    # ~15s — tier-1 870s wall-budget shed; the non-ragged epoch pins
+    # above stay fast and ci_tier1.sh's smoke cell covers the wire-up
+    @pytest.mark.slow
     def test_epoch_bitwise_ragged(self):
         kw = dict(self.KW)
         kw.update(

@@ -1,0 +1,204 @@
+"""Pipelined gossip fleets (rcmarl_tpu.parallel.gala).
+
+Four contracts:
+
+1. **Degenerate pins** — the composed trainer IS its pieces at the
+   degenerate corners, leaf-for-leaf bitwise: ``pipeline_depth=0`` +
+   ``gossip_every=0`` is the independent seed-axis run
+   (``train_parallel``), ``replicas=1`` is the solo pipelined trainer
+   (``train_pipelined``). Delegation makes these hold by construction;
+   the pins here are the regression net against that delegation ever
+   being replaced by a drifting twin loop.
+2. **Composed guards, exact counters** — a scripted window fault on ONE
+   replica's actor tier burns that replica's redraw/skip budget alone
+   (per-replica counters exact), and a skipping replica sits out the
+   next mix (exclusion) or enters sticky quarantine with
+   streak-counted readmission — the solo pipeline's and the gossip
+   trainer's fault machinery composing without interference.
+3. **Merged surface** — one ``df.attrs`` carries pipeline + guard +
+   gossip + canary counter families and :func:`gala_summary` renders
+   the ONE line the CI smoke cell greps.
+4. **Config contract** — the composed knobs validate loudly
+   (tests/test_pipeline.py pins the depth<=gossip_every rule) and
+   round-trip through the checkpoint JSON.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rcmarl_tpu.config import Config
+from rcmarl_tpu.lint.configs import tiny_cfg
+from rcmarl_tpu.parallel.gala import gala_summary, train_gala
+from rcmarl_tpu.parallel.gossip import replica_seeds
+from rcmarl_tpu.parallel.seeds import train_parallel
+from rcmarl_tpu.pipeline.trainer import train_pipelined
+
+
+def _assert_trees_bitwise(a, b, unstack: bool = False):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        la = np.asarray(la)
+        if unstack:
+            la = la[0]
+        np.testing.assert_array_equal(la, np.asarray(lb))
+
+
+def _bomb_replica(target_r: int, target_b: int, persistent: bool):
+    """A scripted composed-seam fault: NaN-bomb replica ``target_r``'s
+    rollout window at global block ``target_b`` (every attempt when
+    persistent, only the first draw when transient)."""
+
+    def window_fault(r, b, attempt, fresh, m):
+        if r == target_r and b == target_b and (persistent or attempt == 0):
+            fresh = jax.tree.map(
+                lambda l: (
+                    jnp.full_like(l, jnp.nan)
+                    if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+                    else l
+                ),
+                fresh,
+            )
+        return fresh, m
+
+    return window_fault
+
+
+class TestDegeneratePins:
+    def test_depth0_every0_is_bitwise_train_parallel(self):
+        """R replicas, no pipeline, no mixing ≡ the independent
+        seed-axis run, leaf for leaf (params AND the delegated
+        degenerate pipeline attrs)."""
+        cfg = tiny_cfg(replicas=2, pipeline_depth=0, gossip_every=0,
+                       gossip_H=0, gossip_degree=2)
+        states, df = train_gala(cfg, n_episodes=4)
+        ref_states, _ = train_parallel(
+            tiny_cfg(), seeds=list(replica_seeds(cfg)), n_blocks=2
+        )
+        _assert_trees_bitwise(states, ref_states)
+        p = df.attrs["pipeline"]
+        assert p["depth"] == 0 and p["staleness"] == [0, 0]
+        assert p["publishes"] == 2 and p["rejects"] == 0
+
+    def test_depth2_R1_is_bitwise_train_pipelined(self):
+        """A one-replica fleet ≡ the solo pipelined trainer with the
+        replica axis prepended (a self-mix is an identity)."""
+        cfg = tiny_cfg(replicas=1, pipeline_depth=2, gossip_every=2,
+                       gossip_degree=1, gossip_H=0)
+        g_states, g_df = train_gala(cfg)
+        p_states, p_df = train_pipelined(tiny_cfg(pipeline_depth=2))
+        _assert_trees_bitwise(g_states, p_states, unstack=True)
+        assert (
+            g_df.attrs["pipeline"]["staleness"]
+            == p_df.attrs["pipeline"]["staleness"]
+        )
+        g = g_df.attrs["gossip"]
+        assert g["replicas"] == 1 and g["rounds"] == 0
+
+    def test_window_fault_rejected_at_depth0(self):
+        with pytest.raises(ValueError, match="window_fault"):
+            train_gala(
+                tiny_cfg(replicas=2, pipeline_depth=0, gossip_H=0,
+                         gossip_degree=2),
+                window_fault=lambda r, b, a, f, m: (f, m),
+            )
+
+    def test_replicas_zero_rejected(self):
+        with pytest.raises(ValueError, match="replicas"):
+            train_gala(tiny_cfg(pipeline_depth=2))
+
+
+class TestComposedGuards:
+    def test_transient_bomb_burns_one_replicas_redraw_only(self):
+        """A transient NaN window on replica 1 costs exactly ONE redraw
+        on replica 1 and nothing anywhere else — no skip, no learner
+        retry, no exclusion, every block published; and the merged
+        attrs surface + summary line carry all four counter families."""
+        cfg = tiny_cfg(
+            replicas=2, pipeline_depth=2, gossip_every=2, gossip_H=0,
+            gossip_graph="full", canary_band=0.5,
+        )
+        states, df = train_gala(
+            cfg, guard=True, max_retries=2,
+            window_fault=_bomb_replica(1, 1, persistent=False),
+        )
+        g = df.attrs["guard"]
+        assert g["replica_redraws"] == [0, 1]
+        assert g["replica_skipped"] == [0, 0]
+        assert g["replica_retries"] == [0, 0]
+        go = df.attrs["gossip"]
+        assert go["rounds"] == 1  # 3 blocks, mix after block 2
+        assert go["excluded"] == 0 and go["rollbacks"] == 0
+        p = df.attrs["pipeline"]
+        # every replica publishes every block + one force republish
+        # at the mix round
+        assert p["rejects"] == 0
+        assert p["publishes"] == 2 * p["blocks"] + 2
+        c = df.attrs["canary"]
+        assert c["deploys"] >= 1 and c["deploy_healthy"]
+        line = gala_summary(df.attrs)
+        assert "gala: 2 replicas" in line
+        assert "gossip: 1 rounds" in line and "canary:" in line
+        assert jax.tree.leaves(states.params)[0].shape[0] == 2
+
+    def test_persistent_bomb_skips_and_excludes_one_replica(self):
+        """A persistent NaN window on replica 0 terminates in bounded
+        redraws then a SKIP on replica 0 alone (block-level containment,
+        params rolled back, nothing published for that block), and the
+        skipping replica sits out the next mix — one exclusion, zero
+        gossip rollbacks (the pipeline guard already owned the fault)."""
+        cfg = tiny_cfg(
+            replicas=2, pipeline_depth=2, gossip_every=2, gossip_H=0,
+            gossip_graph="full",
+        )
+        _, df = train_gala(
+            cfg, guard=True, max_retries=2,
+            window_fault=_bomb_replica(0, 1, persistent=True),
+        )
+        g = df.attrs["guard"]
+        assert g["replica_redraws"] == [2, 0]
+        assert g["replica_skipped"] == [1, 0]
+        go = df.attrs["gossip"]
+        assert go["excluded"] == 1 and go["rollbacks"] == 0
+        assert go["replica_healthy"] == [True, True]  # params stay finite
+        p = df.attrs["pipeline"]
+        # replica 0's skipped block published nothing
+        assert p["publishes"] == 2 * p["blocks"] + 2 - 1
+
+    @pytest.mark.slow
+    def test_sticky_quarantine_and_streak_readmission(self):
+        """With ``readmit_after=1`` a skipping replica enters sticky
+        quarantine (out of EVERY later mix, not just the next), then
+        re-enters after one consecutive healthy segment — counters
+        exact, end state fully readmitted."""
+        cfg = tiny_cfg(
+            replicas=2, pipeline_depth=2, gossip_every=2, gossip_H=0,
+            gossip_graph="full", n_episodes=12,
+        )
+        _, df = train_gala(
+            cfg, guard=True, max_retries=1, readmit_after=1,
+            window_fault=_bomb_replica(1, 0, persistent=True),
+        )
+        go = df.attrs["gossip"]
+        # segment 1: replica 1 skips -> quarantined (1 exclusion at the
+        # round-1 mix); segment 2: healthy streak hits readmit_after
+        # BEFORE the round-2 mix -> readmitted, mixes again
+        assert df.attrs["guard"]["replica_skipped"] == [0, 1]
+        assert go["readmitted"] == 1
+        assert go["quarantined"] == [0, 0]
+        assert go["excluded"] == 1
+        assert go["rounds"] == 3  # 6 blocks / gossip_every=2
+
+
+class TestConfigContract:
+    def test_composed_config_json_roundtrip(self):
+        from rcmarl_tpu.utils.checkpoint import (
+            _config_to_json,
+            config_from_json,
+        )
+
+        cfg = tiny_cfg(
+            replicas=2, pipeline_depth=2, gossip_every=2, gossip_H=0,
+            gossip_graph="full", canary_band=0.25, canary_blocks=2,
+        )
+        assert config_from_json(_config_to_json(cfg)) == cfg

@@ -217,6 +217,9 @@ class TestGossipMix:
 
 
 class TestGossipTrain:
+    # ~14s — tier-1 870s wall-budget shed; the gala depth-0 delegation
+    # pin (tests/test_gala.py) re-proves the gossip_every=0 corner fast
+    @pytest.mark.slow
     def test_no_mix_is_bitwise_independent_seed_axis(self):
         """ReplicaFaultPlan=None + gossip_every=0 ≡ parallel/seeds.py,
         leaf for leaf (params AND metrics)."""

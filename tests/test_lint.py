@@ -148,7 +148,8 @@ class TestRetraceAuditor:
         from rcmarl_tpu.lint.retrace import audit_retrace
 
         findings = audit_retrace(
-            fitstack_dtypes=False, fused_epoch=False, fused_serve=False
+            fitstack_dtypes=False, fused_epoch=False, fused_serve=False,
+            gala=False,
         )
         assert findings == [], "\n".join(str(f) for f in findings)
 

@@ -358,6 +358,9 @@ class TestBF16Compute:
         with pytest.raises(ValueError, match="compute_dtype"):
             Config(compute_dtype="float16")
 
+    # ~17s — tier-1 870s wall-budget shed; the bf16 kernel/dtype pins
+    # above stay fast
+    @pytest.mark.slow
     def test_bf16_trains_end_to_end(self):
         from rcmarl_tpu.config import Config
         from rcmarl_tpu.training.trainer import init_train_state, train_block

@@ -92,6 +92,9 @@ class TestFusedSoloParity:
         index, so every row stays bitwise across grid steps."""
         _assert_bitwise(CFG, BLOCK, _obs(CFG, 7), KEY, "sample", block_b=4)
 
+    # ~8s — tier-1 870s wall-budget shed; the default odd-fanout parity
+    # pins stay fast
+    @pytest.mark.slow
     def test_even_action_fanout_stays_bitwise(self):
         """n_actions=4 exercises the even threefry counter split (the
         default 5 covers the odd zero-padded path)."""

@@ -132,6 +132,8 @@ class TestPhaseReset:
     goal layout carry over; Adam moments, buffer, block counter, and RNG
     reset exactly as a phase-1 init from the same seed."""
 
+    # ~12s — tier-1 870s wall-budget shed
+    @pytest.mark.slow
     def test_reset_semantics(self):
         from rcmarl_tpu.parallel.seeds import (
             init_states,

@@ -94,9 +94,26 @@ class TestConfigValidation:
         with pytest.raises(ValueError, match="publish_every"):
             Config(publish_every=0)
 
-    def test_replica_pipeline_combination_rejected(self):
-        with pytest.raises(ValueError, match="gossip-replica"):
-            Config(replicas=2, pipeline_depth=2)
+    def test_replica_pipeline_combination_validated(self):
+        """The composed topology replaced the old loud rejection: the
+        combination is legal iff each gossip segment is at least as
+        long as the pipeline depth (the actor tier drains at every mix
+        boundary), and the composed canary knobs validate."""
+        cfg = Config(
+            replicas=2, pipeline_depth=2, gossip_every=2, gossip_H=0,
+            gossip_degree=2,
+        )
+        assert cfg.replicas == 2 and cfg.pipeline_depth == 2
+        with pytest.raises(ValueError, match="gossip_every"):
+            Config(replicas=2, pipeline_depth=3, gossip_every=2,
+                   gossip_H=0, gossip_degree=2)
+        with pytest.raises(ValueError, match="canary_band"):
+            Config(canary_band=0.1)  # composed-only knob
+        with pytest.raises(ValueError, match="canary_band"):
+            Config(canary_band=-0.1, replicas=2, pipeline_depth=2,
+                   gossip_every=2, gossip_H=0, gossip_degree=2)
+        with pytest.raises(ValueError, match="canary_blocks"):
+            Config(canary_blocks=0)
 
 
 # --------------------------------------------------------------------------

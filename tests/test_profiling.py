@@ -78,6 +78,9 @@ def test_profile_phases_covers_training_subprograms():
     assert all(v > 0 for v in times.values())
 
 
+# ~22s — tier-1 870s wall-budget shed; still runs under
+# `pytest tests/` (no -m filter)
+@pytest.mark.slow
 def test_profile_consensus_covers_components_and_tags():
     """The consensus micro-breakdown: one timing per component the
     crossover policies tune, plus the (n_in, H, volume) tags refits key
@@ -136,6 +139,8 @@ def _check_micro_keys(times, adv):
     assert tags["gathered_numel"] == 3 * 2 * 137
 
 
+# ~37s — tier-1 870s wall-budget shed
+@pytest.mark.slow
 def test_trace_writes_artifacts(tmp_path):
     logdir = tmp_path / "trace"
     with trace(str(logdir)):

@@ -213,6 +213,9 @@ class TestThreeWayAutoPolicy:
             resolve_impl("topk", 4, H=1)
 
 
+# ~16s — tier-1 870s wall-budget shed; the primitive select-vs-sort
+# pins above stay fast
+@pytest.mark.slow
 def test_end_to_end_block_select_vs_sort():
     """One full update block: consensus_impl='xla' (selection) must
     reproduce consensus_impl='xla_sort' exactly — the bounds are
