@@ -100,6 +100,11 @@ class CellSpec(NamedTuple):
     coop/greedy/malicious: (N,) bool role masks (faulty = none of the
     three: it transmits frozen nets and needs no branch of its own).
     H: () int32 trim parameter. common_reward: () bool.
+    task_scale: () float32 congestion-toll multiplier — the Diff-DAC
+    task axis (``Config.task_axis``): each replica trains the
+    congestion world at its own load level, all from one compiled
+    program. 1.0 multiplies bitwise-exactly, so every non-task cell
+    keeps the historical reward stream bit-for-bit.
     """
 
     coop: jnp.ndarray
@@ -107,6 +112,7 @@ class CellSpec(NamedTuple):
     malicious: jnp.ndarray
     H: jnp.ndarray
     common_reward: jnp.ndarray
+    task_scale: jnp.ndarray
 
 
 class Batch(NamedTuple):
@@ -151,7 +157,8 @@ def coop_local_critic_fit(
     fwd = _fwd(cfg)
     target = r + cfg.gamma * fwd(critic, ns)
     return fit_mse_full_batch(
-        critic, fwd, s, target, mask, cfg.coop_fit_steps, cfg.fast_lr
+        critic, fwd, s, target, mask, cfg.coop_fit_steps, cfg.fast_lr,
+        clip=cfg.fit_clip,
     )
 
 
@@ -162,7 +169,8 @@ def coop_local_tr_fit(
     same 5-step full-batch SGD, target = local reward (no bootstrap).
     Returns (message_params, first_step_loss)."""
     return fit_mse_full_batch(
-        tr, _fwd(cfg), sa, r, mask, cfg.coop_fit_steps, cfg.fast_lr
+        tr, _fwd(cfg), sa, r, mask, cfg.coop_fit_steps, cfg.fast_lr,
+        clip=cfg.fit_clip,
     )
 
 
@@ -180,6 +188,7 @@ def adv_critic_fit(
     return fit_mse_minibatch(
         key, critic, fwd, s, target, mask,
         cfg.adv_fit_epochs, cfg.adv_fit_batch, cfg.fast_lr,
+        clip=cfg.fit_clip,
     )
 
 
@@ -192,6 +201,7 @@ def adv_tr_fit(
     return fit_mse_minibatch(
         key, tr, _fwd(cfg), sa, r_target, mask,
         cfg.adv_fit_epochs, cfg.adv_fit_batch, cfg.fast_lr,
+        clip=cfg.fit_clip,
     )
 
 
@@ -253,7 +263,8 @@ def coop_pair_fit(stack2, x2, targets2, mask, cfg: Config):
 
     def fit_one(p, x, t):
         return fit_mse_full_batch(
-            p, fwd, x, t, mask, cfg.coop_fit_steps, cfg.fast_lr
+            p, fwd, x, t, mask, cfg.coop_fit_steps, cfg.fast_lr,
+            clip=cfg.fit_clip,
         )
 
     per_agent = jax.vmap(fit_one, in_axes=(0, None, 0))
@@ -275,6 +286,7 @@ def adv_pair_fit(keys2, stack2, x2, targets2, mask, cfg: Config):
         return fit_mse_minibatch(
             k, p, fwd, x, t, mask,
             cfg.adv_fit_epochs, cfg.adv_fit_batch, cfg.fast_lr,
+            clip=cfg.fit_clip,
         )
 
     per_agent = jax.vmap(fit_one, in_axes=(0, 0, None, 0))
@@ -340,12 +352,12 @@ def fused_fit_rows(keys_rows, params_rows, x_rows, targets_rows, mask,
 
         return pallas_fit_scan(
             keys_rows, params_rows, _fwd(cfg), x_rows, targets_rows,
-            mask, schedule, cfg.fast_lr,
+            mask, schedule, cfg.fast_lr, cfg.fit_clip,
             interpret=impl == "pallas_interpret",
         )
     return fused_fit_scan(
         keys_rows, params_rows, _fwd(cfg), x_rows, targets_rows, mask,
-        schedule, cfg.fast_lr,
+        schedule, cfg.fast_lr, cfg.fit_clip,
     )
 
 

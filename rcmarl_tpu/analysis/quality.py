@@ -999,6 +999,98 @@ def chaos_campaign_section(ledger_path) -> list:
     return lines
 
 
+def mega_population_section(artifact_path) -> list:
+    """QUALITY.md lines for the mega-population sparse-consensus
+    experiment, rendered from the committed
+    ``scripts/mega_population.py`` artifact
+    (``simulation_results/mega_population.json``). Empty when the
+    artifact does not exist."""
+    p = Path(artifact_path)
+    if not p.exists():
+        return []
+    d = json.loads(p.read_text())
+    cfg = d["config"]
+    clean = next(a for a in d["arms"] if a["adversaries"] == 0)
+    lines = [
+        "",
+        "## Mega-population: sparse consensus at n=256 under attack",
+        "",
+        "The n-scale twin of the adaptive-adversary cell above, with "
+        "consensus riding the SPARSE time-varying exchange "
+        "(`ops/exchange.py`, README \"Mega-population scenarios\") and "
+        "the `fit_clip` stability rail on. Two gates per arm: the "
+        "return band (as every other cell), and `values_sane` — the "
+        "largest |parameter| across the COOPERATIVE agents' consensus "
+        "critic+TR rows, gated at 100x the clean arm's magnitude. The "
+        "second gate exists because the first is BLIND here: Adam's "
+        "scale invariance normalizes blown-up advantages away in the "
+        "actor step, so arms whose value nets are poisoned by orders "
+        "of magnitude still sample near-identical actions for the "
+        "whole committed horizon. The committed run "
+        f"(`{p.name}`, `scripts/mega_population.py`: "
+        f"{cfg['scenario']}, {cfg['episodes']} episodes, seed "
+        f"{cfg['seed']}, scale {cfg['adaptive_scale']}, measured on "
+        f"{d['platform']}):",
+        "",
+        f"| arm | H | adversaries | final return (last {cfg['window']}) "
+        "| coop consensus max \\|param\\| | verdict |",
+        "|---|---|---|---|---|---|",
+    ]
+    for a in d["arms"]:
+        sane = a["values_sane"]
+        if a["collapsed_at_episode"] is not None:
+            verdict = (
+                f"**collapsed** (non-finite at episode "
+                f"{a['collapsed_at_episode']})"
+            )
+        elif a["adversaries"] == 0:
+            verdict = (
+                "— (clean band source; "
+                f"{'improved' if a.get('improved') else 'DID NOT improve'} "
+                f"{a['first_window']} → {a['final_return']})"
+            )
+        elif a["within_clean_band"] and sane:
+            verdict = "returns in band, values sane"
+        elif a["within_clean_band"]:
+            verdict = "**returns in band, VALUES POISONED**"
+        else:
+            verdict = "**DEGRADED — outside the clean band**"
+        lines.append(
+            f"| {a['label']} | {a['H']} | {a['adversaries']} | "
+            f"{a['final_return']} | {a['consensus_abs_max']} | "
+            f"{verdict} |"
+        )
+    lines += [
+        "",
+        "Reading: training IMPROVES at n=256 on the sparse path (the "
+        "clean arm's verdict), and the consensus-magnitude column is a "
+        "graded provisioning ladder the return column is blind to. The "
+        "provisioned trim holds both gates BY CONSTRUCTION — with "
+        "total colluders <= H, no neighborhood can ever contain more "
+        "than H of them, under any schedule. The H=1 arm is "
+        "under-provisioned: whenever both colluders land in one "
+        "resampled neighborhood they beat a 1-per-side trim, and the "
+        "measured magnitude is visibly elevated over clean — the leak "
+        "is real, merely slow enough at 2 colluders to stay bounded "
+        "over this horizon. It does NOT stay bounded as the colluder "
+        "count grows: at 8 colluders the same under-provisioning "
+        "(including H=2, which a few >=3-colluder neighborhoods per 60 "
+        "resamples defeat) compounds geometrically to non-finite, "
+        "because each leaked payload (scale x the healthy spread) "
+        "widens the next epoch's spread and per-block resampling mixes "
+        "the exposure across ALL agents. The H=0 arm is the blindness "
+        "finding at full strength: its returns sit IN the clean band — "
+        "Adam normalizes the blown-up advantages away in the actor "
+        "step — while its healthy agents' value nets are non-finite. "
+        "The trimmed-mean guarantee is <=H Byzantine PER NEIGHBORHOOD, "
+        "not per population — provision `H` against the worst possible "
+        "neighborhood, and gate deployments on value-net magnitude, "
+        "never on returns alone (the sparse exchange itself changes "
+        "nothing here: the gather is bitwise the dense one).",
+    ]
+    return lines
+
+
 def adaptive_adversary_section(artifact_path) -> list:
     """QUALITY.md lines for the adaptive colluding-adversary
     experiment, rendered from the committed
@@ -1298,6 +1390,10 @@ def write_quality_md(
     lines += autoscale_slo_section(autoscale_artifact)
     resilience_ledger = Path(out_path).parent / "RESILIENCE.jsonl"
     lines += chaos_campaign_section(resilience_ledger)
+    megapop_artifact = (
+        Path(out_path).parent / "simulation_results/mega_population.json"
+    )
+    lines += mega_population_section(megapop_artifact)
     lines += [
         "",
         "## Related artifacts",
@@ -1368,6 +1464,12 @@ def write_quality_md(
         lines.append(
             "- `RESILIENCE.jsonl` — the CI-gated chaos-campaign ledger "
             "behind the chaos section (`python -m rcmarl_tpu chaos`)"
+        )
+    if megapop_artifact.exists():
+        lines.append(
+            "- `simulation_results/mega_population.json` — the n=256 "
+            "sparse-consensus attack arms behind the mega-population "
+            "section (`scripts/mega_population.py`)"
         )
     # like cmd_parity's related-artifacts list: only link the robustness
     # companion when it exists, and never from itself

@@ -259,6 +259,73 @@ def _run_adaptive(intensity: str) -> dict:
     }
 
 
+def _run_mega_sparse(intensity: str) -> dict:
+    """``h{H}``: adaptive collusion at POPULATION scale over the sparse
+    time-varying graph — 248 cooperators + 8 Adaptive colluders at
+    n=256, trimmed consensus over random-geometric degree-9
+    neighborhoods resampled every block (gather indices flow as DATA
+    through :func:`rcmarl_tpu.ops.exchange.sparse_gather`, with
+    ``validate_graph`` guarding every resample on the real host-loop
+    path). Survival = the trim holds the clean twin's band where each
+    neighborhood sees colluders only through the sparse schedule — the
+    n-scale point the tiny 3-ring adaptive cell cannot represent."""
+    import numpy as np
+
+    from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+    from rcmarl_tpu.training.trainer import train
+
+    H = int(intensity.removeprefix("h"))
+    n, n_adv = 256, 8
+    base = dict(
+        n_agents=n,
+        agent_roles=(Roles.COOPERATIVE,) * (n - n_adv)
+        + (Roles.ADAPTIVE,) * n_adv,
+        in_nodes=circulant_in_nodes(n, 5),
+        nrow=16,
+        ncol=16,
+        hidden=(4,),
+        graph_schedule="random_geometric",
+        graph_degree=9,
+        H=H,
+        fit_clip=1.0,
+        adaptive_scale=10.0,
+        n_episodes=_TRAIN_EPS,
+        n_ep_fixed=2,
+        max_ep_len=4,
+        n_epochs=1,
+    )
+    cfg = Config(**base)
+    clean_key = ("mega_sparse_clean", H)
+    if clean_key not in _CLEAN_CACHE:
+        _, df = train(
+            cfg.replace(agent_roles=(Roles.COOPERATIVE,) * n),
+            n_episodes=_TRAIN_EPS,
+        )
+        _CLEAN_CACHE[clean_key] = _final_return(df)
+    clean = _CLEAN_CACHE[clean_key]
+    state, df = train(cfg, n_episodes=_TRAIN_EPS, guard=False)
+    returns = np.asarray(df["True_team_returns"].values, dtype=float)
+    final = _final_return(df)
+    if not _params_ok(state) or not np.isfinite(returns).all():
+        outcome = "failed"
+        final = None
+    elif not _within_band(final, clean):
+        outcome = "degraded"
+    else:
+        outcome = "survived"
+    return {
+        "outcome": outcome,
+        "counters": {},
+        "final_return": final,
+        "clean_return": clean,
+        "detail": (
+            f"{n_adv} Adaptive colluders at n={n}, scale 10, H={H}, "
+            "random_geometric degree 9 (sparse data-graph exchange), "
+            "guard off"
+        ),
+    }
+
+
 # --------------------------------------------------------------------------
 # gossip: Byzantine replicas, replica-link bombs, flapping + readmission
 # --------------------------------------------------------------------------
@@ -1165,6 +1232,18 @@ CHAOS_POINTS: Tuple[ChaosPoint, ...] = (
         "tests/test_envs.py (adaptive cells), QUALITY.md adaptive section",
         (("h1", "survived"), ("h0", "failed")),
         _run_adaptive,
+    ),
+    ChaosPoint(
+        "mega_sparse_adaptive", "consensus",
+        "adaptive collusion at population scale (n=256) over the sparse "
+        "time-varying random-geometric graph",
+        "Roles.ADAPTIVE x8 + graph_schedule='random_geometric' "
+        "(ops/exchange.py sparse data-graph gather)",
+        "H-trimming per scheduled neighborhood + validate_graph on "
+        "every resample",
+        "tests/test_exchange.py, QUALITY.md mega-population section",
+        (("h1", "survived"),),
+        _run_mega_sparse,
     ),
     ChaosPoint(
         "replica_byzantine", "gossip",

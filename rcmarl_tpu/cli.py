@@ -222,6 +222,44 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         "seed; resumed runs replay their exact graph sequence)",
     )
     p.add_argument(
+        "--congestion_weight",
+        type=float,
+        default=1.0,
+        help="congestion-world toll per OTHER agent sharing a cell "
+        "(envs/congestion.py; 1.0 = the env's historical default, "
+        "bit-for-bit)",
+    )
+    p.add_argument(
+        "--fit_clip",
+        type=float,
+        default=0.0,
+        help="global-gradient-norm ceiling for the phase-I critic/TR "
+        "SGD fits (0.0 = off, bit-for-bit the reference program). The "
+        "mega-population stability rail: past n~64 the fixed fast_lr "
+        "exceeds the raw full-batch fit's SGD stability bound and "
+        "clean training diverges; the n>=256 cells use 1.0",
+    )
+    t = p.add_argument_group("Diff-DAC multitask axis")
+    t.add_argument(
+        "--task_axis",
+        action="store_true",
+        help="turn the vmapped replica axis into a TASK axis (Diff-DAC): "
+        "replica r trains the congestion world at load level "
+        "--task_levels[r] (traced data — one compiled program for the "
+        "whole task family), with the gossip mix doubling as the "
+        "cross-task consensus step. Requires --replicas >= 2, "
+        "--env congestion, a static graph schedule, no pipeline tier, "
+        "and the XLA consensus family",
+    )
+    t.add_argument(
+        "--task_levels",
+        nargs="+",
+        type=float,
+        default=None,
+        help="one positive congestion-toll multiplier per replica "
+        "(default: an even spread over [0.5, 2.0])",
+    )
+    p.add_argument(
         "--adaptive_scale",
         type=float,
         default=10.0,
@@ -510,6 +548,10 @@ def config_from_args(args) -> Config:
         graph_degree=getattr(args, "graph_degree", 0),
         graph_seed=getattr(args, "graph_seed", 0),
         adaptive_scale=getattr(args, "adaptive_scale", 10.0),
+        congestion_weight=getattr(args, "congestion_weight", 1.0),
+        fit_clip=getattr(args, "fit_clip", 0.0),
+        task_axis=getattr(args, "task_axis", False),
+        task_levels=tuple(getattr(args, "task_levels", None) or ()),
         n_actions=args.n_actions,
         n_states=args.n_states,
         n_episodes=args.n_episodes,
@@ -1124,8 +1166,49 @@ def cmd_sweep(argv) -> int:
         "parallel/matrix.py) instead of one program per cell; requires "
         "consensus_impl xla/auto",
     )
+    g = p.add_argument_group("time-varying communication graphs")
+    g.add_argument(
+        "--graph_schedule",
+        type=str,
+        default="static",
+        choices=list(GRAPH_SCHEDULES),
+        help="communication-graph schedule for every cell: static "
+        "(default) = the fixed scenario topology, bit-for-bit the seed "
+        "behavior; random_geometric = the sparse scheduled exchange "
+        "(gather indices as DATA — ops/exchange.py). Scheduled cells "
+        "run one host-looped train() per seed (the vmapped seed "
+        "program cannot regenerate the per-block resample); "
+        "incompatible with --fused",
+    )
+    g.add_argument(
+        "--graph_every",
+        type=int,
+        default=1,
+        help="resample the time-varying graph every K blocks",
+    )
+    g.add_argument(
+        "--graph_degree",
+        type=int,
+        default=0,
+        help="in-degree (incl. self) of the resampled graph; 0 = reuse "
+        "the scenario's static n_in (needs 2H <= degree-1)",
+    )
+    g.add_argument(
+        "--graph_seed",
+        type=int,
+        default=0,
+        help="graph-schedule namespace (independent of the training "
+        "seeds; resumed runs replay their exact graph sequence)",
+    )
     _add_fault_flags(p)
     args = p.parse_args(argv)
+    if args.fused and args.graph_schedule != "static":
+        raise SystemExit(
+            "--fused cannot run a time-varying graph_schedule: the fused "
+            "matrix is one device-scanned program and cannot regenerate "
+            "the per-block host resample — drop --fused (scheduled "
+            "cells run per-seed host loops)"
+        )
     if args.n_episodes <= 0 or args.n_episodes % args.n_ep_fixed != 0:
         raise SystemExit(
             f"--n_episodes={args.n_episodes} must be a positive multiple of "
@@ -1134,8 +1217,12 @@ def cmd_sweep(argv) -> int:
     if args.phases < 1:
         raise SystemExit(f"--phases={args.phases} must be >= 1")
 
-    from rcmarl_tpu.parallel.seeds import reset_states_for_phase, train_parallel
-    from rcmarl_tpu.training.trainer import metrics_to_dataframe
+    from rcmarl_tpu.parallel.seeds import (
+        reset_state_for_phase,
+        reset_states_for_phase,
+        train_parallel,
+    )
+    from rcmarl_tpu.training.trainer import metrics_to_dataframe, train
 
     def cell_config(scen: str, H: int) -> Config:
         labels, is_global = scenario_labels(scen)
@@ -1158,6 +1245,10 @@ def cmd_sweep(argv) -> int:
             compute_dtype=args.compute_dtype,
             fault_plan=fault_plan_from_args(args),
             consensus_sanitize=args.sanitize,
+            graph_schedule=args.graph_schedule,
+            graph_every=args.graph_every,
+            graph_degree=args.graph_degree,
+            graph_seed=args.graph_seed,
         )
 
     out_root = Path(args.out)
@@ -1175,8 +1266,55 @@ def cmd_sweep(argv) -> int:
     if args.fused:
         return _sweep_fused(args, cell_config, cell_done, out_root)
 
+    def run_cell_scheduled(cfg: Config, scen: str, H: int) -> None:
+        """The time-varying-graph cell: one host-looped solo train() per
+        seed (the vmapped seed program cannot regenerate the per-block
+        host resample), same restart protocol at phase boundaries, same
+        finite guard rail BEFORE any artifact is written, same raw_data
+        artifacts."""
+        import jax
+
+        t0 = time.perf_counter()
+        for seed in args.seeds:
+            scfg = cfg.replace(seed=seed)
+            state, dfs = None, []
+            for _ in range(args.phases):
+                if state is not None:
+                    state = reset_state_for_phase(scfg, state, seed)
+                state, df = train(
+                    scfg, n_episodes=args.n_episodes, state=state
+                )
+                dfs.append(df)
+            params_ok = all(
+                bool(np.all(np.isfinite(np.asarray(l))))
+                for l in jax.tree.leaves(state.params)
+                if np.issubdtype(np.asarray(l).dtype, np.floating)
+            )
+            if not params_ok or not all(
+                bool(np.isfinite(df.to_numpy()).all()) for df in dfs
+            ):
+                raise _CellUnhealthy(
+                    f"{scen} H={H} seed={seed}: non-finite params/metrics "
+                    "(diverged or fault-poisoned; for fault-injection "
+                    "sweeps run with --sanitize)"
+                )
+            for ph, df in enumerate(dfs):
+                _write_sim_data(out_root, scen, H, seed, df, args.phase + ph)
+        dt = time.perf_counter() - t0
+        total_eps = args.n_episodes * args.phases
+        sps = len(args.seeds) * total_eps * cfg.max_ep_len / dt
+        print(
+            f"{scen} H={H}: {len(args.seeds)} seeds x {total_eps} eps "
+            f"({args.phases} phase(s), {cfg.graph_schedule} graph, "
+            f"degree {cfg.resolved_graph_degree}) in {dt:.1f}s "
+            f"({sps:.0f} env-steps/s aggregate)"
+        )
+
     def run_cell(scen: str, H: int) -> None:
         cfg = cell_config(scen, H)
+        if cfg.graph_schedule != "static":
+            run_cell_scheduled(cfg, scen, H)
+            return
         n_blocks = args.n_episodes // cfg.n_ep_fixed
         # all seeds of a cell run as ONE sharded/vmapped program
         states, phase_metrics, dt = _run_phases(
@@ -1284,6 +1422,23 @@ BENCH_CONFIGS = {
         H=1,
         roles=("Cooperative",) * 12 + ("Greedy",) * 2 + ("Malicious",) * 2,
     ),
+    # Mega-population cells (round 18): the static circulant in-degree
+    # stays tiny (the compiled anchor topology) while consensus rides
+    # the sparse random-geometric schedule as DATA (ops/exchange.py) —
+    # past DENSE_DEGREE_LIMIT a dense static graph refuses to construct,
+    # so these cells measure the O(n·deg·P) exchange, never the n² one.
+    # Scheduled cells route through the host-looped train() in
+    # cmd_bench (the device scan cannot regenerate the per-block
+    # resample); pair with `--env congestion pursuit` for the env-zoo
+    # scale-up rows.
+    "n256_sparse": dict(
+        n_agents=256, hidden=(16, 16), degree=4, H=2,
+        schedule="random_geometric", graph_degree=9, fit_clip=1.0,
+    ),
+    "n1024_sparse": dict(
+        n_agents=1024, hidden=(4,), degree=4, H=2,
+        schedule="random_geometric", graph_degree=8, fit_clip=1.0,
+    ),
 }
 
 
@@ -1304,6 +1459,10 @@ def _bench_config(
     netstack: "bool | str" = "auto",
     fitstack: "bool | str" = "auto",
     env: str = "grid_world",
+    graph_schedule: str = "static",
+    graph_every: int = 1,
+    graph_degree: int = 0,
+    graph_seed: int = 0,
 ) -> Config:
     spec = BENCH_CONFIGS[name]
     n = spec["n_agents"]
@@ -1315,7 +1474,15 @@ def _bench_config(
     roles = tuple(
         Roles.BY_NAME[l] for l in spec.get("roles", ("Cooperative",) * n)
     )
+    # Cells carrying their own schedule keys (the mega-population
+    # entries) pin them: they ARE the measured sparse arm; the CLI graph
+    # axis applies to the historically static cells only.
+    if "schedule" in spec:
+        graph_schedule = spec["schedule"]
+        graph_degree = spec.get("graph_degree", graph_degree)
+        graph_every = spec.get("graph_every", graph_every)
     return Config(
+        fit_clip=spec.get("fit_clip", 0.0),
         n_agents=n,
         agent_roles=roles,
         in_nodes=in_nodes,
@@ -1332,6 +1499,10 @@ def _bench_config(
         netstack=netstack,
         fitstack=fitstack,
         compute_dtype=compute_dtype,
+        graph_schedule=graph_schedule,
+        graph_every=graph_every,
+        graph_degree=graph_degree,
+        graph_seed=graph_seed,
     )
 
 
@@ -1499,6 +1670,40 @@ def cmd_bench(argv) -> int:
         "leaf-by-leaf dispatch (bitwise-identical comparison arm)",
     )
     _netstack_arm_flag(p)
+    g = p.add_argument_group("time-varying communication graphs")
+    g.add_argument(
+        "--graph_schedule",
+        nargs="+",
+        default=["static"],
+        choices=list(GRAPH_SCHEDULES),
+        help="graph-schedule arm(s) as a cell axis: static (default) = "
+        "the compiled --configs topology, random_geometric = the sparse "
+        "scheduled exchange (gather indices as DATA — ops/exchange.py), "
+        "measured through the host-looped train() since the device scan "
+        "cannot regenerate the per-block resample; pass 'static "
+        "random_geometric' for the sparse-vs-dense A/B. Mega cells "
+        "(n256_sparse/n1024_sparse) pin their own schedule and ignore "
+        "this axis' static value",
+    )
+    g.add_argument(
+        "--graph_every",
+        type=int,
+        default=1,
+        help="resample the time-varying graph every K blocks",
+    )
+    g.add_argument(
+        "--graph_degree",
+        type=int,
+        default=0,
+        help="in-degree (incl. self) of the resampled graph; 0 = reuse "
+        "the cell's static n_in (needs 2H <= degree-1)",
+    )
+    g.add_argument(
+        "--graph_seed",
+        type=int,
+        default=0,
+        help="graph-schedule namespace (independent of the training seed)",
+    )
     p.add_argument(
         "--shard_agents",
         nargs="+",
@@ -1558,8 +1763,16 @@ def cmd_bench(argv) -> int:
     from rcmarl_tpu.ops.aggregation import resolve_impl
     from rcmarl_tpu.parallel.seeds import make_mesh, train_parallel
     from rcmarl_tpu.training.update import fitstack_enabled, netstack_enabled
-    from rcmarl_tpu.training.trainer import init_train_state, train_scanned
-    from rcmarl_tpu.utils.profiling import Timer, mesh_fingerprint
+    from rcmarl_tpu.training.trainer import (
+        init_train_state,
+        train,
+        train_scanned,
+    )
+    from rcmarl_tpu.utils.profiling import (
+        Timer,
+        mesh_fingerprint,
+        train_block_fingerprint,
+    )
 
     shard_modes = [None] if args.shard_agents is None else args.shard_agents
     # any nonzero depth switches the WHOLE list to the host-looped
@@ -1567,20 +1780,50 @@ def cmd_bench(argv) -> int:
     # block through the same harness — the honest sync-vs-pipelined A/B)
     pipeline_mode = any(d > 0 for d in args.pipeline_depth)
     n_failed = 0
-    for name, env, dtype, impl, layout, ns, fs, shard, depth in itertools.product(
-        args.configs, args.env, args.compute_dtype, args.impl, args.layout,
-        args.netstack, args.fitstack, shard_modes, args.pipeline_depth,
+    for name, env, dtype, impl, layout, ns, fs, shard, depth, gsched in (
+        itertools.product(
+            args.configs, args.env, args.compute_dtype, args.impl,
+            args.layout, args.netstack, args.fitstack, shard_modes,
+            args.pipeline_depth, args.graph_schedule,
+        )
     ):
         cfg = _bench_config(
             name, impl, args.n_ep_fixed, dtype, layout,
             netstack=_netstack_value(ns),
             fitstack=_netstack_value(fs),
             env=env,
+            graph_schedule=gsched,
+            graph_every=args.graph_every,
+            graph_degree=args.graph_degree,
+            graph_seed=args.graph_seed,
         )
+        scheduled = cfg.graph_schedule != "static"
+        if (
+            gsched != "static"
+            and "schedule" in BENCH_CONFIGS[name]
+        ):
+            # the mega cells pin their own schedule; running them again
+            # under the CLI schedule axis would duplicate the same row
+            print(
+                f"# skip {name} graph_schedule={gsched}: cell pins its "
+                "own schedule spec",
+                file=sys.stderr,
+            )
+            continue
         if netstack_enabled(cfg) and layout == "per_leaf":
             print(
                 f"# skip {name} netstack={ns} layout=per_leaf: the "
                 "per-leaf layout only exists on the dual-launch arm",
+                file=sys.stderr,
+            )
+            continue
+        if scheduled and (pipeline_mode or shard is not None):
+            arm = "pipeline_depth" if pipeline_mode else "shard_agents"
+            print(
+                f"# skip {name} graph_schedule={cfg.graph_schedule} "
+                f"{arm}: the device-scanned/pipelined harnesses cannot "
+                "regenerate the per-block host resample — scheduled "
+                "cells run the host-looped train()",
                 file=sys.stderr,
             )
             continue
@@ -1597,7 +1840,24 @@ def cmd_bench(argv) -> int:
             n_failed += _bench_pipeline_cell(args, name, cfg, depth)
             continue
         fingerprint = None
-        if shard is None:
+        if scheduled:
+            # the sparse scheduled exchange: per-block graphs are
+            # host-resampled DATA, so the cell is the host-looped
+            # train() — same row shape, wall clock around the whole
+            # loop (resample + validate + block dispatch included: the
+            # cost a scheduled production run actually pays)
+            from types import SimpleNamespace
+
+            state = None
+
+            def run(s, cfg=cfg):
+                st, df = train(
+                    cfg, n_episodes=args.blocks * cfg.n_ep_fixed, state=s
+                )
+                return st, SimpleNamespace(
+                    true_team_returns=df["True_team_returns"].to_numpy()
+                )
+        elif shard is None:
             state = init_train_state(cfg, jax.random.PRNGKey(0))
             run = jax.jit(
                 lambda s, cfg=cfg: train_scanned(cfg, s, args.blocks)
@@ -1626,7 +1886,13 @@ def cmd_bench(argv) -> int:
                 return st, metrics
 
         try:
-            if shard is None:
+            if scheduled:
+                # the host loop has no single lowering to hash; the
+                # steady-state data-graph block program is the honest
+                # cost anchor (train_block_fingerprint lowers it WITH
+                # the (N, degree) graph operand)
+                fingerprint = train_block_fingerprint(cfg)
+            elif shard is None:
                 # tie the row to the EXACT program being timed (the
                 # ledger convention, lint/cost.py): the hash of this
                 # lowering is what catches "benched arm A, shipped arm
@@ -1690,6 +1956,15 @@ def cmd_bench(argv) -> int:
                 "n_in": cfg.n_in,
                 "hidden": list(cfg.hidden),
                 "H": cfg.H,
+                **(
+                    {}
+                    if not scheduled
+                    else {
+                        "graph_schedule": cfg.graph_schedule,
+                        "graph_degree": cfg.resolved_graph_degree,
+                        "graph_every": cfg.graph_every,
+                    }
+                ),
                 **(
                     {}
                     if shard is None
@@ -2012,6 +2287,15 @@ def cmd_profile(argv) -> int:
                 "n_agents": cfg.n_agents,
                 "hidden": list(cfg.hidden),
                 "H": cfg.H,
+                **(
+                    {}
+                    if cfg.graph_schedule == "static"
+                    else {
+                        "graph_schedule": cfg.graph_schedule,
+                        "graph_degree": cfg.resolved_graph_degree,
+                        "graph_every": cfg.graph_every,
+                    }
+                ),
                 "pipeline_depth": cfg.pipeline_depth,
                 "publish_every": cfg.publish_every,
                 "cost_fingerprint": fingerprint,
@@ -2057,6 +2341,15 @@ def cmd_profile(argv) -> int:
                     "compute_dtype": cfg.compute_dtype,
                     "cost_fingerprint": fingerprint,
                     **consensus_tags(cfg),
+                    **(
+                        {}
+                        if cfg.graph_schedule == "static"
+                        else {
+                            "graph_schedule": cfg.graph_schedule,
+                            "graph_degree": cfg.resolved_graph_degree,
+                            "graph_every": cfg.graph_every,
+                        }
+                    ),
                     "ms": {k: round(v * 1e3, 3) for k, v in micro.items()},
                     "platform": jax.devices()[0].platform,
                     "timestamp": datetime.now().isoformat(timespec="seconds"),

@@ -78,6 +78,18 @@ ENV_NAMES = ("grid_world", "pursuit", "coverage", "congestion")
 #: structure), so resampling never recompiles.
 GRAPH_SCHEDULES = ("static", "random_geometric")
 
+#: Mega-population guard rail: the widest STATIC in-neighborhood the
+#: framework will compile. A static dense graph gathers an
+#: ``(N, n_in, P)`` block whose cost is quadratic in the population
+#: once ``n_in`` tracks ``N``; past this degree the time-varying
+#: random-geometric schedule (``graph_schedule='random_geometric'`` +
+#: ``graph_degree``) is MANDATORY — its sparse data-indexed exchange
+#: (rcmarl_tpu.ops.exchange) costs ``O(n · graph_degree · P)`` instead,
+#: the scaling the AUDIT.jsonl ``consensus_exchange`` ledger rows pin.
+#: The limit equals the largest measured dense cell (n64_full), so
+#: every historical config compiles unchanged.
+DENSE_DEGREE_LIMIT = 64
+
 
 #: Valid replica gossip graphs (parallel/gossip.py:replica_in_nodes):
 #: 'ring' = directed circulant of in-degree ``gossip_degree`` (incl.
@@ -251,6 +263,12 @@ class Config:
     collision_physics: bool = False  # opt-in *intended* collision semantics
     scaling: bool = True
     randomize_state: bool = True
+    # Congestion-world toll per OTHER agent sharing a cell (the load
+    # price of envs/congestion.py; 1.0 = the env's historical default,
+    # bit-for-bit). The Diff-DAC task axis scales this per task level
+    # at trace-free runtime (CellSpec.task_scale), so one compiled
+    # program trains over a whole load-level family.
+    congestion_weight: float = 1.0
     # --- time-varying communication graphs ---
     # graph_schedule: 'static' (default) keeps the fixed `in_nodes`
     # topology compiled into the program — bit-for-bit the seed
@@ -287,6 +305,18 @@ class Config:
     adv_fit_batch: int = 32
     # --- cooperative local fit (reference resilient_CAC_agents.py:118,136) ---
     coop_fit_steps: int = 5
+    # Global-gradient-norm ceiling for the phase-I critic/TR SGD fits
+    # (every arm: dual, netstack, fitstack XLA scan, fitstack Pallas
+    # kernel — the clip lives in ops/fit + ops/pallas_fit so the
+    # arm-vs-arm bitwise pins carry any value). 0.0 (default) traces no
+    # clip ops at all — bit-for-bit the reference program. The
+    # mega-population rail: the full-batch MSE gradient's Lipschitz
+    # constant grows with the joint state-action width (~3*n_agents for
+    # the TR net, unnormalized actions), so past n~64 the fixed
+    # ``fast_lr`` exceeds the SGD stability bound 2/L and the raw
+    # 5-step fit diverges to NaN on CLEAN runs; the n>=256 bench/chaos
+    # cells set ``fit_clip=1.0`` (step norm <= fast_lr * fit_clip).
+    fit_clip: float = 0.0
     seed: int = 300
     # --- consensus kernel implementation ---
     # 'xla' (default): log-depth tournament selection bounds + clip/mean
@@ -413,6 +443,21 @@ class Config:
     gossip_mix: str = "trimmed"
     gossip_seed: int = 0
     replica_fault_plan: Optional[ReplicaFaultPlan] = None
+    # --- Diff-DAC multitask axis (parallel/gossip.py) ---
+    # task_axis=True turns the vmapped replica/seed axis into a TASK
+    # axis (Diff-DAC, PAPERS.md 1710.10363): replica r trains on the
+    # congestion world at load level task_levels[r] (the level scales
+    # the congestion toll as traced CellSpec.task_scale data — one
+    # compiled program for the whole task family), and the existing
+    # gossip mix doubles as the cross-task consensus step Diff-DAC
+    # prescribes — the trimmed mean over tasks' parameter blocks.
+    # task_levels: one positive toll multiplier per replica; () =
+    # linspace(0.5, 2.0, replicas) (resolved_task_levels). Requires
+    # replicas >= 2, env='congestion', a static graph schedule, no
+    # pipeline tier, no ADAPTIVE cast, and the XLA consensus family
+    # (the traced-spec program shares the fused-matrix constraints).
+    task_axis: bool = False
+    task_levels: Tuple[float, ...] = ()
     # --- async actor-learner pipeline (rcmarl_tpu.pipeline) ---
     # pipeline_depth: how many rollout blocks the actor tier runs AHEAD
     # of the learner tier (the Podracer/TorchBeast split). 0 (default) =
@@ -521,10 +566,78 @@ class Config:
                     "lives in the host loop); run with replicas=0 and "
                     "pipeline_depth=0"
                 )
+        if self.graph_schedule == "static" and self.n_in > DENSE_DEGREE_LIMIT:
+            # mega-population guard rail: a static dense neighborhood
+            # compiles an (N, n_in, P) exchange quadratic in the
+            # population — past the largest measured dense cell the
+            # sparse scheduled exchange is mandatory
+            raise ValueError(
+                f"static in-neighborhoods of degree {self.n_in} exceed "
+                f"DENSE_DEGREE_LIMIT={DENSE_DEGREE_LIMIT}: the dense "
+                "(N, n_in, P) exchange is quadratic at this scale. Use "
+                "graph_schedule='random_geometric' with a bounded "
+                "graph_degree (the sparse O(n*deg*P) exchange, "
+                "rcmarl_tpu.ops.exchange)"
+            )
         if not float(self.adaptive_scale) >= 0.0:
             raise ValueError(
                 f"adaptive_scale={self.adaptive_scale} must be >= 0"
             )
+        if not float(self.congestion_weight) >= 0.0:
+            raise ValueError(
+                f"congestion_weight={self.congestion_weight} must be >= 0"
+            )
+        if not float(self.fit_clip) >= 0.0:
+            raise ValueError(f"fit_clip={self.fit_clip} must be >= 0")
+        if self.task_levels and not self.task_axis:
+            raise ValueError(
+                "task_levels without task_axis=True would be silently "
+                "ignored; set task_axis=True (the Diff-DAC arm) or drop "
+                "the levels"
+            )
+        if self.task_axis:
+            if self.replicas < 2:
+                raise ValueError(
+                    "task_axis=True needs replicas >= 2 (the replica "
+                    "axis IS the task axis; one task is just train())"
+                )
+            if self.env != "congestion":
+                raise ValueError(
+                    f"task_axis=True varies the congestion toll per task "
+                    f"level; env={self.env!r} has no load knob (use "
+                    "env='congestion')"
+                )
+            if self.pipeline_depth:
+                raise ValueError(
+                    "task_axis=True rides the gossip replica program; "
+                    "the composed pipeline tier (pipeline_depth > 0) "
+                    "does not thread per-replica task specs"
+                )
+            if self.task_levels and len(self.task_levels) != self.replicas:
+                raise ValueError(
+                    f"task_levels has {len(self.task_levels)} entries "
+                    f"for replicas={self.replicas}; need one level per "
+                    "replica (or () for the linspace default)"
+                )
+            if self.task_levels and not all(
+                float(l) > 0.0 for l in self.task_levels
+            ):
+                raise ValueError(
+                    f"task_levels={self.task_levels} must all be > 0 "
+                    "(toll multipliers)"
+                )
+            if Roles.ADAPTIVE in self.agent_roles:
+                raise ValueError(
+                    "task_axis=True traces the scenario as CellSpec "
+                    "data, which does not model the ADAPTIVE colluding "
+                    "adversary (the fused-matrix constraint)"
+                )
+            if self.consensus_impl not in ("xla", "xla_sort", "auto"):
+                raise ValueError(
+                    "task_axis=True runs consensus with a traced "
+                    "CellSpec (the XLA family); consensus_impl="
+                    f"{self.consensus_impl!r} cannot apply"
+                )
         if self.consensus_impl not in CONSENSUS_IMPLS:
             raise ValueError(
                 f"consensus_impl={self.consensus_impl!r}: expected one of "
@@ -699,6 +812,19 @@ class Config:
         shape — and therefore the compiled program's input avals —
         unchanged)."""
         return self.graph_degree if self.graph_degree else self.n_in
+
+    @property
+    def resolved_task_levels(self) -> Tuple[float, ...]:
+        """The Diff-DAC toll multiplier per replica when
+        :attr:`task_axis` is set: ``task_levels`` verbatim when given,
+        else an even spread over [0.5, 2.0] — one load level per
+        replica, the family the single compiled program trains over."""
+        if not self.task_axis:
+            return ()
+        if self.task_levels:
+            return tuple(float(l) for l in self.task_levels)
+        r = self.replicas
+        return tuple(0.5 + 1.5 * i / (r - 1) for i in range(r))
 
     @property
     def gossip_n_in(self) -> int:

@@ -77,6 +77,7 @@ def _make_congestion(cfg: Config) -> CongestionWorld:
     return CongestionWorld(
         nrow=cfg.nrow, ncol=cfg.ncol, n_agents=cfg.n_agents,
         scaling=cfg.scaling,
+        congestion_weight=cfg.congestion_weight,
     )
 
 
@@ -153,6 +154,23 @@ def env_transition(
     if isinstance(env, CongestionWorld):
         return congestion.env_step(env, pos, task, actions)
     raise TypeError(f"not a registered env world: {type(env).__name__}")
+
+
+def env_transition_scaled(
+    env, pos: jnp.ndarray, task: jnp.ndarray, actions: jnp.ndarray,
+    task_scale: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """:func:`env_transition` with the traced Diff-DAC task knob.
+
+    The congestion world scales its toll by ``task_scale``
+    (:func:`rcmarl_tpu.envs.congestion.env_step_scaled` —
+    ``CellSpec.task_scale``, one load level per vmapped replica); every
+    other world has no load knob and ignores the scale. ``task_scale ==
+    1.0`` is bitwise :func:`env_transition` for every world."""
+    if isinstance(env, CongestionWorld):
+        return congestion.env_step_scaled(env, pos, task, actions,
+                                          task_scale)
+    return env_transition(env, pos, task, actions)
 
 
 def env_obs(env, pos: jnp.ndarray) -> jnp.ndarray:

@@ -80,3 +80,35 @@ def env_step(
     load = jnp.sum(same_cell, axis=1) - 1.0  # exclude self
     reward = shaping - env.congestion_weight * load
     return npos, task, reward
+
+
+def env_step_scaled(
+    env: CongestionWorld,
+    pos: jnp.ndarray,
+    task: jnp.ndarray,
+    actions: jnp.ndarray,
+    toll_scale: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """:func:`env_step` with the congestion toll scaled by TRACED data.
+
+    ``toll_scale`` (() float32) multiplies the per-step toll — the
+    Diff-DAC task axis (``Config.task_axis``): each vmapped replica
+    trains this same compiled program at its own load level
+    (``CellSpec.task_scale``). ``toll_scale == 1.0`` is bitwise
+    :func:`env_step` (IEEE: ``1.0 * w * load == w * load`` exactly), so
+    threading the spec through the rollout costs non-task cells
+    nothing, bit-for-bit.
+    """
+    clip_hi = jnp.array([env.nrow - 1, env.ncol - 1], dtype=jnp.int32)
+    move = jnp.asarray(MOVES)[actions]
+    dist_before = jnp.sum(jnp.abs(pos - task), axis=1)  # (N,)
+    npos = jnp.clip(pos + move, 0, clip_hi)
+    at_goal_stay = (dist_before == 0) & (actions == 0)
+    shaping = jnp.where(
+        at_goal_stay, 0.0, -(dist_before.astype(jnp.float32)) - 1.0
+    )
+    pair = jnp.sum(jnp.abs(npos[:, None, :] - npos[None, :, :]), axis=-1)
+    same_cell = (pair == 0).astype(jnp.float32)
+    load = jnp.sum(same_cell, axis=1) - 1.0  # exclude self
+    reward = shaping - toll_scale * env.congestion_weight * load
+    return npos, task, reward

@@ -94,6 +94,54 @@ def tiny_gala_cfg(**overrides):
     return tiny_gossip_cfg(**base)
 
 
+def tiny_sparse_cfg(**overrides):
+    """The sparse-exchange audit variant: the time-varying
+    random-geometric schedule (degree 3 over 4 agents), so the audited
+    gather takes its indices as TRACED data through
+    :func:`rcmarl_tpu.ops.exchange.sparse_gather` — the mega-population
+    exchange the ``consensus_exchange`` cost rows price."""
+    from rcmarl_tpu.config import Roles, circulant_in_nodes
+
+    base = dict(
+        n_agents=4,
+        agent_roles=(Roles.COOPERATIVE,) * 4,
+        in_nodes=circulant_in_nodes(4, 3),
+        graph_schedule="random_geometric",
+        graph_degree=3,
+        H=1,
+    )
+    base.update(overrides)
+    return tiny_cfg(**base)
+
+
+def megapop_cfg(**overrides):
+    """The mega-population sharding-ladder shape: n=1024 agents on the
+    sparse random-geometric schedule (degree 8, H=2), tiny (4,) hidden
+    — the cell whose agent-sharded flat consensus block the
+    ``megapop@sharded`` device-memory ladder compiles at mesh {1,2,8}
+    (compile/inspect only; nothing this size ever executes in lint)."""
+    from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+
+    n = 1024
+    base = dict(
+        n_agents=n,
+        agent_roles=(Roles.COOPERATIVE,) * n,
+        in_nodes=circulant_in_nodes(n, 5),
+        graph_schedule="random_geometric",
+        graph_degree=8,
+        H=2,
+        fit_clip=1.0,
+        hidden=(4,),
+        env="congestion",
+        n_episodes=2,
+        n_ep_fixed=2,
+        max_ep_len=4,
+        n_epochs=1,
+    )
+    base.update(overrides)
+    return Config(**base)
+
+
 def census_cfg(**overrides):
     """The collective-census variant: 4 cooperative agents on a
     circulant degree-3 ring, so the agent axis tiles evenly over a

@@ -77,6 +77,7 @@ _ANCHORS = {
     "actor_block": "rcmarl_tpu/serve/engine.py",
     "learner_block": "rcmarl_tpu/pipeline/trainer.py",
     "aggregation": "rcmarl_tpu/ops/aggregation.py",
+    "consensus_exchange": "rcmarl_tpu/ops/exchange.py",
 }
 
 
@@ -801,6 +802,80 @@ def fused_serve_cost_rows() -> Tuple[List[dict], List[str], set]:
     return rows, notes, skipped
 
 
+#: Population the sparse-vs-dense exchange ledger rows measure at
+#: (matching the committed PERF.jsonl mega-population bench cells).
+SPARSE_EXCHANGE_N = 256
+
+
+def sparse_exchange_cost_rows() -> Tuple[List[dict], List[str], set]:
+    """The mega-population exchange ledger:
+    ``consensus_exchange[sparse]`` vs ``consensus_exchange[dense]`` —
+    the same advanced-indexing gather program
+    (:func:`rcmarl_tpu.ops.exchange.sparse_gather`) compiled at n=256
+    over the real flat critic+TR consensus block, once with the
+    scheduled ``(N, graph_degree)`` index array and once with the dense
+    ``(N, N)`` full neighborhood. Both arms are MEASURED (XLA
+    ``cost_analysis``, ``bytes_model: 'xla-cost-analysis'``) and
+    lowered from abstract shapes — nothing allocates. The gate
+    (:data:`FUSED_GATE_PAIRS`) requires sparse ``bytes_accessed``
+    strictly below dense: the exchange scales with ``n * graph_degree *
+    P``, not ``n^2 * P`` — the ISSUE-18 acceptance invariant. The
+    sparse row also carries the analytic byte model
+    (:func:`rcmarl_tpu.ops.exchange.exchange_cost_model`) for honest
+    cross-checking of the measured number."""
+    import jax
+    import jax.numpy as jnp
+
+    from rcmarl_tpu.config import Roles, circulant_in_nodes
+    from rcmarl_tpu.lint.configs import megapop_cfg
+    from rcmarl_tpu.ops.exchange import exchange_cost_model, sparse_gather
+    from rcmarl_tpu.parallel.megapop import consensus_block_struct
+    from rcmarl_tpu.utils.profiling import (
+        config_fingerprint,
+        program_fingerprint,
+    )
+
+    rows: List[dict] = []
+    notes: List[str] = []
+    skipped: set = set()
+    n = SPARSE_EXCHANGE_N
+    cfg = megapop_cfg(
+        n_agents=n,
+        agent_roles=(Roles.COOPERATIVE,) * n,
+        in_nodes=circulant_in_nodes(n, 5),
+    )
+    fp = config_fingerprint(cfg)
+    block = consensus_block_struct(cfg)  # (N, P_total), abstract
+    deg = cfg.resolved_graph_degree
+    arms = {
+        "consensus_exchange[sparse]": jax.ShapeDtypeStruct(
+            (n, deg), jnp.int32
+        ),
+        "consensus_exchange[dense]": jax.ShapeDtypeStruct(
+            (n, n), jnp.int32
+        ),
+    }
+    for entry, idx in arms.items():
+        lowered = jax.jit(sparse_gather).lower(block, idx)
+        compiled = lowered.compile()
+        metrics = _compiled_metrics(compiled)
+        if metrics is None:
+            notes.append(
+                f"{entry}: platform exposes no cost/memory analysis; "
+                "the sparse-exchange gate is unverifiable here"
+            )
+            skipped.add(entry)
+            continue
+        row = _row(entry, fp, program_fingerprint(lowered), metrics)
+        row["bytes_model"] = "xla-cost-analysis"
+        if entry.endswith("[sparse]"):
+            row["analytic_bytes"] = exchange_cost_model(
+                n, deg, int(block.shape[1])
+            )["total"]
+        rows.append(row)
+    return rows, notes, skipped
+
+
 #: The (fused entry, two-launch reference) row pairs the HBM gate
 #: compares: fused bytes_accessed strictly below the reference's at
 #: FLOPs equal within :data:`COST_TOLERANCE`.
@@ -809,6 +884,55 @@ FUSED_GATE_PAIRS = (
     ("fit_scan[pallas_resident]", "fit_scan[xla_carry]"),
     ("serve_path[pallas_fused]", "serve_path[xla_chain]"),
 )
+
+
+def sparse_exchange_gate_findings(
+    rows: Sequence[dict], skipped=frozenset()
+) -> List[Finding]:
+    """``cost-sparse-gate``: the ISSUE-18 acceptance invariant as a CI
+    rule — ``consensus_exchange[sparse]`` must be STRICTLY below
+    ``consensus_exchange[dense]`` in BOTH ``bytes_accessed`` and
+    ``flops``. Unlike the fused-kernel gate (same arithmetic, fewer
+    bytes), the sparse exchange wins by doing LESS of both: the gather
+    touches ``n * graph_degree`` neighbor rows instead of ``n * n``."""
+    findings: List[Finding] = []
+    by = {r["entry"]: r for r in rows if r.get("kind") == "cost"}
+    sparse_e = "consensus_exchange[sparse]"
+    dense_e = "consensus_exchange[dense]"
+    if sparse_e in skipped or dense_e in skipped:
+        return findings
+    s, d = by.get(sparse_e), by.get(dense_e)
+    if s is None or d is None:
+        findings.append(
+            Finding(
+                "cost-sparse-gate",
+                _anchor_for(sparse_e),
+                1,
+                f"{sparse_e} vs {dense_e}: gate pair incomplete ("
+                + ", ".join(
+                    f"missing {e}"
+                    for e, row in ((sparse_e, s), (dense_e, d))
+                    if row is None
+                )
+                + ")",
+            )
+        )
+        return findings
+    for metric in ("bytes_accessed", "flops"):
+        sv = float(s["metrics"][metric])
+        dv = float(d["metrics"][metric])
+        if not sv < dv:
+            findings.append(
+                Finding(
+                    "cost-sparse-gate",
+                    _anchor_for(sparse_e),
+                    1,
+                    f"{sparse_e}: {metric} {sv:.0f} is not strictly "
+                    f"below the dense arm's {dv:.0f} — the sparse "
+                    "exchange lost its O(n*degree) scaling claim",
+                )
+            )
+    return findings
 
 
 def fused_gate_findings(
@@ -878,10 +1002,11 @@ def cost_rows() -> Tuple[List[dict], List[str], set]:
     arows, anotes, askipped = aggregation_cost_rows()
     frows, fnotes, fskipped = fused_consensus_cost_rows()
     srows, snotes, sskipped = fused_serve_cost_rows()
+    xrows, xnotes, xskipped = sparse_exchange_cost_rows()
     return (
-        rows + arows + frows + srows,
-        notes + anotes + fnotes + snotes,
-        skipped | askipped | fskipped | sskipped,
+        rows + arows + frows + srows + xrows,
+        notes + anotes + fnotes + snotes + xnotes,
+        skipped | askipped | fskipped | sskipped | xskipped,
     )
 
 
@@ -1016,4 +1141,5 @@ def audit_cost(
         )
     findings, cmp_notes = compare_cost(baseline, fresh, tol, skipped)
     findings.extend(fused_gate_findings(fresh, skipped, tol))
+    findings.extend(sparse_exchange_gate_findings(fresh, skipped))
     return findings, notes + cmp_notes, fresh

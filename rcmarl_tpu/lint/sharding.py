@@ -78,6 +78,7 @@ _ANCHORS = {
     "seeds": "rcmarl_tpu/parallel/seeds.py",
     "matrix": "rcmarl_tpu/parallel/matrix.py",
     "gossip": "rcmarl_tpu/parallel/gossip.py",
+    "megapop": "rcmarl_tpu/parallel/megapop.py",
 }
 
 
@@ -270,9 +271,10 @@ def _sharding_programs() -> Dict[str, tuple]:
     already pins.
     """
     from rcmarl_tpu.config import Roles
-    from rcmarl_tpu.lint.configs import census_cfg
+    from rcmarl_tpu.lint.configs import census_cfg, megapop_cfg
     from rcmarl_tpu.parallel.gossip import lower_gossip_mix
     from rcmarl_tpu.parallel.matrix import lower_matrix
+    from rcmarl_tpu.parallel.megapop import lower_megapop_consensus
     from rcmarl_tpu.parallel.seeds import lower_parallel, make_mesh
 
     cfg = census_cfg()
@@ -280,7 +282,13 @@ def _sharding_programs() -> Dict[str, tuple]:
         agent_roles=(Roles.COOPERATIVE,) * 3 + (Roles.MALICIOUS,)
     )
     gcfg = _gossip_cfg()
+    mcfg = megapop_cfg()
     return {
+        "megapop@sharded": (
+            mcfg,
+            lambda n: make_mesh(n, seed_axis=1),
+            lambda mesh: lower_megapop_consensus(mcfg, mesh),
+        ),
         "seeds@sharded": (
             cfg,
             _seeds_mesh,

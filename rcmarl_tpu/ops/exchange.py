@@ -1,0 +1,146 @@
+"""Sparse neighbor exchange — the O(n·deg·P) mega-population gather.
+
+The consensus exchange has two regimes. Small populations compile the
+static ``Config.in_nodes`` topology into the program (rolls for
+rotation-symmetric graphs, a constant fancy index otherwise —
+:func:`rcmarl_tpu.training.update.gather_neighbor_messages`); the
+gathered block is ``(N, n_in, P)``, and for the dense graphs the
+reference favors, ``n_in`` grows with ``N`` — the exchange is
+**quadratic** in the population. Mega-population cells (n=256/1024,
+ROADMAP item 3) instead ride the time-varying random-geometric schedule
+(PR 12, :func:`rcmarl_tpu.config.scheduled_in_nodes`): every agent
+keeps exactly ``graph_degree`` scheduled in-neighbors, the indices flow
+in as DATA, and the gather here touches only ``n · graph_degree · P``
+elements — the cost the AUDIT.jsonl ``consensus_exchange`` ledger rows
+pin (sparse strictly below dense at n=256, gated every ``lint --cost``
+run).
+
+This module is THE sparse exchange layer: one gather primitive shared
+by both netstack arms (the dual-launch epoch and the combined
+``(N, P_critic + P_tr)`` pair block both delegate their data-indexed
+branch here), plus the host-side guard rails the schedule's hypothesis
+twins pin — a scheduled graph that reaches the device is regular,
+self-first, in-range, duplicate-free, and wide enough for the
+configured trim (``2H + 1 <= degree``). Transport faults and sanitize
+compose downstream unchanged: faulting operates on the *gathered*
+block (``apply_link_faults_flat``), so the sparse block passes through
+the exact fault/trim/clip/mean chain the dense block does — the
+bitwise sparse-vs-dense pins in tests/test_exchange.py hold across the
+whole sanitize/fault matrix.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sparse_gather(tree, in_arr):
+    """Gather each agent's scheduled in-neighborhood from ``tree``.
+
+    ``tree``: pytree of ``(N, ...)`` leaves (stacked per-agent
+    messages). ``in_arr``: ``(N, degree)`` integer gather indices, own
+    index first per row — TRACED data, so per-block resampling
+    re-dispatches one compiled program. Returns ``(N, degree, ...)``
+    leaves, own message at neighbor slot 0.
+
+    This is deliberately the plain advanced-indexing gather: XLA lowers
+    it to one dynamic-gather op whose cost scales with the OUTPUT
+    ``N * degree * P``, never with a dense ``N * N`` neighborhood — the
+    scaling the cost ledger's ``consensus_exchange[sparse]`` row proves
+    against its ``[dense]`` twin. On matching indices it is bitwise
+    identical to the static constant-index gather (same op, indices as
+    data instead of literals).
+    """
+    idx = jnp.asarray(in_arr)
+    return jax.tree.map(lambda l: l[idx], tree)
+
+
+def validate_graph(graph, n_agents: int, degree: int | None = None,
+                   H: int | None = None) -> np.ndarray:
+    """Host-side guard rails for a scheduled communication graph.
+
+    Checks the invariants every array the device gather consumes must
+    hold (the hypothesis twins in tests/test_exchange.py pin that
+    :func:`rcmarl_tpu.config.scheduled_in_nodes` always produces them):
+
+    - shape ``(n_agents, degree)`` with an integer dtype;
+    - every row lists the agent itself FIRST (the reference's
+      own-at-slot-0 convention the trim's own-anchoring relies on);
+    - all indices in ``[0, n_agents)``;
+    - no duplicate in-neighbors within a row (a duplicated sender would
+      double its vote in the mean — a silent resilience regression);
+    - ``2H + 1 <= degree`` when ``H`` is given (the trimming guarantee
+      needs 2H+1 honest-capable inputs in every neighborhood).
+
+    Returns the validated graph as an int32 numpy array; raises
+    ``ValueError`` on any violation. The solo trainer's host loop and
+    the CLI cells call this once per resample — O(N·deg) host work,
+    nothing on device.
+    """
+    g = np.asarray(graph)  # lint: disable=host-sync (host-side guard)
+    if g.ndim != 2 or g.shape[0] != n_agents:
+        raise ValueError(
+            f"scheduled graph must be (n_agents={n_agents}, degree); "
+            f"got shape {g.shape}"
+        )
+    if not np.issubdtype(g.dtype, np.integer):
+        raise ValueError(
+            f"scheduled graph must be integer gather indices; got "
+            f"dtype {g.dtype}"
+        )
+    deg = g.shape[1]
+    if degree is not None and deg != degree:
+        raise ValueError(
+            f"scheduled graph degree {deg} != expected {degree}"
+        )
+    if deg < 1:
+        raise ValueError("scheduled graph needs degree >= 1 (self)")
+    if H is not None and not 0 <= 2 * H <= deg - 1:
+        raise ValueError(
+            f"H={H} too large for scheduled degree {deg}: need "
+            "2H <= degree-1 in every neighborhood"
+        )
+    if (g < 0).any() or (g >= n_agents).any():
+        bad = np.argwhere((g < 0) | (g >= n_agents))[0]
+        raise ValueError(
+            f"scheduled graph index out of range at row {bad[0]} slot "
+            f"{bad[1]}: {g[bad[0], bad[1]]} not in [0, {n_agents})"
+        )
+    if (g[:, 0] != np.arange(n_agents)).any():
+        bad = int(  # lint: disable=host-sync (host-side guard)
+            np.argwhere(g[:, 0] != np.arange(n_agents))[0][0]
+        )
+        raise ValueError(
+            f"scheduled graph row {bad} must list the agent itself "
+            f"first (got {g[bad, 0]}; own-at-slot-0 convention)"
+        )
+    for i in range(n_agents):
+        if len(set(g[i].tolist())) != deg:
+            raise ValueError(
+                f"scheduled graph row {i} has duplicate in-neighbors "
+                f"({g[i].tolist()}); a duplicated sender would double "
+                "its vote in the trimmed mean"
+            )
+    return np.asarray(g, dtype=np.int32)  # lint: disable=host-sync
+
+
+def exchange_cost_model(n_agents: int, degree: int, p_total: int,
+                        itemsize: int = 4) -> dict:
+    """The analytic byte cost of one sparse exchange, for honest row
+    tags next to the compiled-cost measurements (the fused-gate rows'
+    ``bytes_model`` convention, lint/cost.py): the gather reads the
+    ``(N, P)`` message block plus the ``(N, deg)`` int32 indices and
+    writes the ``(N, deg, P)`` gathered block — every term linear in
+    ``n_agents * degree``, never ``n_agents**2``."""
+    out = n_agents * degree * p_total * itemsize
+    # all-Python shape math — nothing traced reaches this module
+    return {
+        "read_block": float(n_agents * p_total * itemsize),  # lint: disable=host-sync
+        "read_indices": float(n_agents * degree * 4),  # lint: disable=host-sync
+        "write_gathered": float(out),  # lint: disable=host-sync
+        "total": float(  # lint: disable=host-sync
+            n_agents * p_total * itemsize + n_agents * degree * 4 + out
+        ),
+    }

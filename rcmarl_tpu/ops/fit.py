@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from rcmarl_tpu.ops.losses import weighted_mse
-from rcmarl_tpu.ops.optim import sgd_update
+from rcmarl_tpu.ops.optim import clip_grads, sgd_update
 
 
 def fit_full_batch(
@@ -40,12 +40,17 @@ def fit_full_batch(
     loss_fn: Callable[[object], jnp.ndarray],
     n_steps: int,
     lr: float,
+    clip: float = 0.0,
 ):
     """``n_steps`` full-batch SGD steps on a fixed objective.
 
     ``loss_fn`` closes over data, target, and mask; the target must be
     pre-computed by the caller (the reference computes the TD target once,
     BEFORE the 5-step fit, ``resilient_CAC_agents.py:114-118``).
+
+    ``clip`` (static, default 0.0 = off, bit-for-bit the reference op
+    sequence) bounds each step's global gradient norm — the
+    mega-population stability rail (:func:`rcmarl_tpu.ops.optim.clip_grads`).
 
     Returns (final_params, first_step_loss) — the reference logs
     ``history['loss'][0]`` (``resilient_CAC_agents.py:122``).
@@ -54,7 +59,7 @@ def fit_full_batch(
 
     def step(p, _):
         loss, g = grad_fn(p)
-        return sgd_update(p, g, lr), loss
+        return sgd_update(p, clip_grads(g, clip), lr), loss
 
     final, losses = jax.lax.scan(step, params, None, length=n_steps)
     return final, losses[0]
@@ -141,6 +146,7 @@ def fit_minibatch(
     opt_update: Optional[Callable] = None,
     shuffle: bool = True,
     assume_valid: bool = False,
+    clip: float = 0.0,
 ):
     """Shuffled mini-batch fit with Keras epoch/batch structure.
 
@@ -160,6 +166,9 @@ def fit_minibatch(
       assume_valid: static promise that ``mask`` is all-ones; the
         shuffle skips the valid-first penalty work (bitwise-identical
         plan — see :func:`valid_first_shuffle`).
+      clip: static global-gradient-norm ceiling applied before the
+        update (either optimizer); 0.0 (default) traces no extra ops —
+        the reference-exact program.
 
     Returns (final_params, final_opt_state, first_epoch_mean_loss) —
     Keras's ``history['loss'][0]`` is the mean of per-batch losses over the
@@ -194,6 +203,7 @@ def fit_minibatch(
             p, ostate = carry
             bidx, bval = xs
             loss, g = grad_fn(p, bidx, bval)
+            g = clip_grads(g, clip)
             nonempty = jnp.sum(bval) > 0
             if opt_update is None:
                 newp = sgd_update(p, g, lr)
@@ -246,6 +256,7 @@ def fit_mse_full_batch(
     mask: jnp.ndarray,
     n_steps: int,
     lr: float,
+    clip: float = 0.0,
 ):
     """:func:`fit_full_batch` specialized to masked-MSE regression of
     ``forward(params, x)`` onto a fixed ``target``. Identical op
@@ -256,6 +267,7 @@ def fit_mse_full_batch(
         lambda p: weighted_mse(forward(p, x), target, mask=mask),
         n_steps,
         lr,
+        clip=clip,
     )
 
 
@@ -269,6 +281,7 @@ def fit_mse_minibatch(
     epochs: int,
     batch_size: int,
     lr: float,
+    clip: float = 0.0,
 ):
     """:func:`fit_minibatch` specialized the same way (the adversary
     critic/TR fit shape: Keras ``fit(epochs, batch_size)`` with shuffled
@@ -285,6 +298,7 @@ def fit_mse_minibatch(
         epochs=epochs,
         batch_size=batch_size,
         lr=lr,
+        clip=clip,
     )
     return out, loss
 
@@ -338,6 +352,7 @@ def fit_mse_sched(
     mask: jnp.ndarray,
     schedule: FitSchedule,
     lr: float,
+    clip: float = 0.0,
 ):
     """Masked-MSE regression of ``forward(params, x)`` onto a fixed
     ``target`` under an arbitrary :class:`FitSchedule` — the ONE row
@@ -359,6 +374,7 @@ def fit_mse_sched(
         lr=lr,
         shuffle=schedule.shuffle,
         assume_valid=schedule.assume_valid,
+        clip=clip,
     )
     return out, loss
 
@@ -372,6 +388,7 @@ def fused_fit_scan(
     mask: jnp.ndarray,
     schedule: FitSchedule,
     lr: float,
+    clip: float = 0.0,
 ):
     """ALL fit flavors of one schedule shape as ONE stacked scan.
 
@@ -390,7 +407,7 @@ def fused_fit_scan(
     Returns (fitted rows, (R, N) first-epoch losses).
     """
     def fit_one(k, p, x, t):
-        return fit_mse_sched(k, p, forward, x, t, mask, schedule, lr)
+        return fit_mse_sched(k, p, forward, x, t, mask, schedule, lr, clip)
 
     per_agent = jax.vmap(fit_one, in_axes=(0, 0, None, 0))
     return jax.vmap(per_agent, in_axes=(0, 0, 0, 0))(

@@ -28,6 +28,26 @@ def sgd_update(params, grads, lr: float):
     return jax.tree.map(lambda p, g: p - lr * g, params, grads)
 
 
+def clip_grads(grads, clip: float):
+    """Global-norm gradient clip: g * min(1, clip / ||g||_2).
+
+    ``clip`` is a STATIC Python float; ``clip <= 0`` returns ``grads``
+    untouched with NO extra ops traced, so the default-off program is
+    bit-for-bit the reference op sequence (the fitstack/netstack pins
+    rely on this). The clip exists for the mega-population path
+    (``Config.fit_clip``): the phase-I full-batch MSE gradient's
+    Lipschitz constant grows with the population's input width, so
+    past the reference scale the fixed ``fast_lr`` crosses the SGD
+    stability bound (lr > 2/L) and the raw 5-step fit diverges.
+    """
+    if clip <= 0.0:
+        return grads
+    leaves = jax.tree.leaves(grads)
+    norm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+    scale = jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-16))
+    return jax.tree.map(lambda g: g * scale, grads)
+
+
 class AdamState(NamedTuple):
     count: jnp.ndarray  # scalar int32 step counter (t in TF's formula)
     m: object  # first-moment pytree, same structure as params

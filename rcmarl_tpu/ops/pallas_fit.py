@@ -50,7 +50,7 @@ from rcmarl_tpu.ops.fit import (
     valid_first_shuffle,
 )
 from rcmarl_tpu.ops.losses import weighted_mse
-from rcmarl_tpu.ops.optim import sgd_update
+from rcmarl_tpu.ops.optim import clip_grads, sgd_update
 
 
 def _fit_plans(keys, mask, schedule: FitSchedule, n_batches: int):
@@ -91,6 +91,7 @@ def _fit_kernel(
     epochs: int,
     n_batches: int,
     shuffle: bool,
+    clip: float,
 ):
     """One (row, agent) cell: params live in registers/VMEM across the
     whole ``epochs x n_batches`` schedule; each step is the scan body's
@@ -119,6 +120,7 @@ def _fit_kernel(
             return weighted_mse(forward(p, x[bidx]), tgt[bidx], mask=bval)
 
         loss, g = jax.value_and_grad(batch_loss)(p)
+        g = clip_grads(g, clip)
         nonempty = jnp.sum(bval) > 0
         newp = sgd_update(p, g, lr)
         p = jax.tree.map(lambda a, b_: jnp.where(nonempty, b_, a), p, newp)
@@ -156,6 +158,7 @@ def pallas_fit_scan(
     mask: jnp.ndarray,
     schedule: FitSchedule,
     lr: float,
+    clip: float = 0.0,
     *,
     interpret: bool = False,
 ):
@@ -212,6 +215,7 @@ def pallas_fit_scan(
         epochs=schedule.epochs,
         n_batches=n_batches,
         shuffle=schedule.shuffle,
+        clip=clip,
     )
     outs = pl.pallas_call(
         kernel,

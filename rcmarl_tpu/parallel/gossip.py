@@ -378,6 +378,23 @@ def train_gossip(
     quarantine = carried.copy() if readmit_after > 0 else np.zeros(R, bool)
     streak = np.zeros(R, np.int64)
     round_idx = int(start_round)
+    specs = None
+    if cfg.task_axis:
+        # Diff-DAC (PAPERS.md 1710.10363): the replica axis IS the task
+        # axis — replica r trains the congestion world at load level
+        # resolved_task_levels[r] (traced CellSpec.task_scale data, one
+        # compiled program for the whole family), and the gossip mix
+        # below doubles as Diff-DAC's cross-task consensus step: the
+        # trimmed mean over the tasks' parameter blocks.
+        from rcmarl_tpu.training.update import spec_from_config
+
+        base_spec = spec_from_config(cfg)
+        specs = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (R,) + x.shape), base_spec
+        )
+        specs = specs._replace(
+            task_scale=jnp.asarray(cfg.resolved_task_levels, jnp.float32)
+        )
     if states is None:
         states = init_states(cfg, replica_seeds(cfg))
     last_good = states  # per-replica rollback target (last good post-mix)
@@ -387,7 +404,9 @@ def train_gossip(
     for seg_len, mix_after in _segment_lengths(n_blocks, cfg.gossip_every):
         # stale-replay payload: the previous round's post-mix params
         prev_params = last_good.params
-        states, metrics = train_parallel(cfg, states=states, n_blocks=seg_len)
+        states, metrics = train_parallel(
+            cfg, states=states, n_blocks=seg_len, specs=specs
+        )
         blocks_done += seg_len
         if guard:
             healthy = np.asarray(_replica_block_healthy(states, metrics))
@@ -531,5 +550,7 @@ def train_gossip(
         "excluded_mask": [int(x) for x in (excluded | quarantine)],
         "readmit_after": readmit_after,
         "quarantined": [int(x) for x in quarantine],
+        "task_axis": bool(cfg.task_axis),
+        "task_levels": [float(l) for l in cfg.resolved_task_levels],
     }
     return states, df

@@ -196,6 +196,7 @@ def _matrix_program(
         malicious=NamedSharding(mesh, P("seed", a)),
         H=NamedSharding(mesh, P("seed")),
         common_reward=NamedSharding(mesh, P("seed")),
+        task_scale=NamedSharding(mesh, P("seed")),
     )
     states = jax.device_put(states, in_shard)
     specs = jax.device_put(specs, spec_shard)

@@ -109,6 +109,25 @@ def init_states(cfg: Config, seeds) -> TrainState:
     return jax.vmap(lambda k: init_train_state(cfg, k))(keys)
 
 
+def reset_state_for_phase(cfg: Config, state: TrainState, seed) -> TrainState:
+    """The phase-restart boundary for ONE replica (weights + goal kept;
+    Adam moments, buffer, and RNG reset — see
+    :func:`reset_states_for_phase` for the protocol provenance). The
+    solo form exists for the time-varying-graph sweep cells, whose
+    per-block host resample keeps them off the vmapped seed program."""
+    from rcmarl_tpu.ops.optim import adam_init
+
+    params = state.params._replace(
+        actor_opt=jax.vmap(adam_init)(state.params.actor)
+    )
+    return init_train_state(
+        cfg,
+        jax.random.PRNGKey(seed),
+        desired=state.desired,
+        params=params,
+    )
+
+
 def reset_states_for_phase(cfg: Config, states: TrainState, seeds) -> TrainState:
     """Reference two-phase protocol boundary (SURVEY.md §5): the published
     runs are 4000+4000 episodes as two processes, where the restart
@@ -118,20 +137,9 @@ def reset_states_for_phase(cfg: Config, states: TrainState, seeds) -> TrainState
     re-seeds with the same ``--random_seed``). Applies that boundary to a
     batch of replicas: params + desired carry over, everything else
     re-initializes from each replica's seed exactly as phase 1 did."""
-    from rcmarl_tpu.ops.optim import adam_init
-
-    def one(state: TrainState, seed):
-        params = state.params._replace(
-            actor_opt=jax.vmap(adam_init)(state.params.actor)
-        )
-        return init_train_state(
-            cfg,
-            jax.random.PRNGKey(seed),
-            desired=state.desired,
-            params=params,
-        )
-
-    return jax.vmap(one)(states, jnp.asarray(seeds, jnp.uint32))
+    return jax.vmap(lambda s, sd: reset_state_for_phase(cfg, s, sd))(
+        states, jnp.asarray(seeds, jnp.uint32)
+    )
 
 
 #: Compiled-program cache for :func:`train_parallel` and
@@ -160,23 +168,48 @@ def _parallel_program(
     n_blocks: int,
     mesh: Mesh,
     shard_agents: bool,
+    specs=None,
 ):
-    """(jitted fn, device-placed states): the sharded multi-replica
-    executable, shared by :func:`train_parallel` (which executes it)
-    and :func:`lower_parallel` (which only inspects its lowering — the
-    graftlint collective census). One ``cached_jit`` slot per program
-    shape either way."""
+    """(jitted fn, device-placed states[, device-placed specs]): the
+    sharded multi-replica executable, shared by :func:`train_parallel`
+    (which executes it) and :func:`lower_parallel` (which only inspects
+    its lowering — the graftlint collective census). One ``cached_jit``
+    slot per program shape either way.
+
+    ``specs`` (optional): a replica-batched ``CellSpec`` pytree — one
+    traced scenario per replica (the Diff-DAC task axis threads
+    per-replica ``task_scale`` load levels through here,
+    :func:`rcmarl_tpu.parallel.gossip.train_gossip`). ``None`` keeps
+    the historical trace-time-specialized program bit-for-bit."""
     in_shard = state_shardings(mesh, states, shard_agents)
     states = jax.device_put(states, in_shard)
+    if specs is None:
+        fn = cached_jit(
+            ("seeds", cfg, n_blocks, mesh, shard_agents),
+            lambda: jax.jit(
+                jax.vmap(lambda s: train_scanned(cfg, s, n_blocks)),
+                in_shardings=(in_shard,),
+                out_shardings=(in_shard, NamedSharding(mesh, P("seed"))),
+            ),
+        )
+        return fn, states
+    a = "agent" if shard_agents else None
+    spec_shard = jax.tree.map(
+        lambda x: NamedSharding(
+            mesh, P("seed", a) if x.ndim > 1 else P("seed")
+        ),
+        specs,
+    )
+    specs = jax.device_put(specs, spec_shard)
     fn = cached_jit(
-        ("seeds", cfg, n_blocks, mesh, shard_agents),
+        ("seeds+spec", cfg, n_blocks, mesh, shard_agents),
         lambda: jax.jit(
-            jax.vmap(lambda s: train_scanned(cfg, s, n_blocks)),
-            in_shardings=(in_shard,),
+            jax.vmap(lambda s, sp: train_scanned(cfg, s, n_blocks, sp)),
+            in_shardings=(in_shard, spec_shard),
             out_shardings=(in_shard, NamedSharding(mesh, P("seed"))),
         ),
     )
-    return fn, states
+    return fn, states, specs
 
 
 def lower_parallel(
@@ -204,6 +237,7 @@ def train_parallel(
     mesh: Optional[Mesh] = None,
     shard_agents: bool = False,
     states: Optional[TrainState] = None,
+    specs=None,
 ) -> Tuple[TrainState, EpisodeMetrics]:
     """Run independent replicas as one sharded XLA program.
 
@@ -216,6 +250,9 @@ def train_parallel(
         dimension (consensus gathers become ICI collectives).
       states: resume from previously returned batched states (their RNG
         streams continue; seeds must then be None).
+      specs: optional replica-batched ``CellSpec`` — one traced scenario
+        per replica (the Diff-DAC task axis rides here; ``None`` is the
+        historical bit-for-bit path).
 
     Returns (batched TrainState, EpisodeMetrics with leading seed axis).
     """
@@ -242,8 +279,15 @@ def train_parallel(
     if states is None:
         states = init_states(cfg, seeds)
 
-    fn, states = _parallel_program(cfg, states, n_blocks, mesh, shard_agents)
-    return fn(states)
+    if specs is None:
+        fn, states = _parallel_program(
+            cfg, states, n_blocks, mesh, shard_agents
+        )
+        return fn(states)
+    fn, states, specs = _parallel_program(
+        cfg, states, n_blocks, mesh, shard_agents, specs
+    )
+    return fn(states, specs)
 
 
 def train_block_parallel(

@@ -31,6 +31,7 @@ from rcmarl_tpu.envs.api import (
     env_reset,
     env_reward_scaled,
     env_transition,
+    env_transition_scaled,
 )
 from rcmarl_tpu.models.mlp import actor_probs, mlp_forward
 
@@ -124,7 +125,14 @@ def rollout_episode(
         pos, task, ret, j = carry
         s_scaled = env_obs(env, pos)
         actions = sample_actions(cfg, params.actor, s_scaled, k)
-        npos, ntask, reward = env_transition(env, pos, task, actions)
+        if spec is None:
+            npos, ntask, reward = env_transition(env, pos, task, actions)
+        else:
+            # traced Diff-DAC task level (Config.task_axis); 1.0 keeps
+            # every non-task spec cell bitwise on the plain transition
+            npos, ntask, reward = env_transition_scaled(
+                env, pos, task, actions, spec.task_scale
+            )
         r_scaled = env_reward_scaled(env, reward)  # (N,)
         ret = ret + r_scaled * cfg.gamma**j
         out = (

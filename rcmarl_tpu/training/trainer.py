@@ -293,9 +293,21 @@ def train(
 
     all_metrics = []
     for b in range(n_blocks):
-        graph = (
-            scheduled_in_nodes(cfg, start_block + b) if dynamic_graph else None
-        )
+        graph = None
+        if dynamic_graph:
+            # guard rail at the host/device boundary: every resampled
+            # graph the device gather consumes is regular, self-first,
+            # in-range, duplicate-free, and wide enough for the trim
+            # (ops/exchange.py — the sparse-exchange invariants the
+            # hypothesis twins pin)
+            from rcmarl_tpu.ops.exchange import validate_graph
+
+            graph = validate_graph(
+                scheduled_in_nodes(cfg, start_block + b),
+                cfg.n_agents,
+                degree=cfg.resolved_graph_degree,
+                H=cfg.H,
+            )
         attempt = 0
         while True:
             base = state

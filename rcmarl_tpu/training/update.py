@@ -180,6 +180,7 @@ def spec_from_config(cfg: Config) -> CellSpec:
         malicious=_role_mask(cfg, Roles.MALICIOUS),
         H=jnp.asarray(cfg.H, jnp.int32),
         common_reward=jnp.asarray(cfg.common_reward, bool),
+        task_scale=jnp.asarray(1.0, jnp.float32),
     )
 
 
@@ -212,8 +213,13 @@ def gather_neighbor_messages(cfg: Config, tree, in_arr=None):
       of the full stacked params when sharded.
     """
     if in_arr is not None:
-        idx = jnp.asarray(in_arr)
-        return jax.tree.map(lambda l: l[idx], tree)
+        # the sparse O(n·deg·P) mega-population exchange — ONE shared
+        # primitive (ops/exchange.py) for both netstack arms, pinned
+        # bitwise against the static gather on matching indices and
+        # cost-gated sparse-below-dense in AUDIT.jsonl (lint --cost)
+        from rcmarl_tpu.ops.exchange import sparse_gather
+
+        return sparse_gather(tree, in_arr)
     shifts = cfg.uniform_shifts
     if shifts is not None:
         return jax.tree.map(

@@ -61,6 +61,9 @@ def config_from_json(s: str) -> Config:
     d["agent_roles"] = tuple(d["agent_roles"])
     d["in_nodes"] = tuple(tuple(n) for n in d["in_nodes"])
     d["hidden"] = tuple(d["hidden"])
+    # absent in pre-task-axis checkpoints: default ()
+    if "task_levels" in d:
+        d["task_levels"] = tuple(d["task_levels"])
     # dataclasses.asdict recursed into the nested FaultPlan dataclass;
     # rebuild it (absent in pre-fault checkpoints: default None).
     if d.get("fault_plan") is not None:

@@ -177,6 +177,16 @@ def train_block_fingerprint(cfg) -> str:
     shapes = jax.eval_shape(
         lambda k: init_train_state(cfg, k), jax.random.PRNGKey(0)
     )
+    if cfg.graph_schedule != "static":
+        # time-varying graphs: the measured program is the one whose
+        # gather indices arrive as DATA (ops/exchange.py), so the
+        # fingerprint must lower with the (N, degree) graph operand —
+        # fingerprinting the static-topology program would tie the row
+        # to an arm that never ran
+        graph = jax.ShapeDtypeStruct(
+            (cfg.n_agents, cfg.resolved_graph_degree), jnp.int32
+        )
+        return program_fingerprint(train_block.lower(cfg, shapes, graph=graph))
     return program_fingerprint(train_block.lower(cfg, shapes))
 
 
@@ -617,7 +627,15 @@ def profile_phases(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
     ``actor_phase`` (phase III over the fresh window), and
     ``full_block`` (the production fused program: rollout + n_epochs
     epochs + actor + buffer push).
+
+    Scheduled configs (``graph_schedule != 'static'``) are measured on
+    the program they actually run: the block-0 resample rides in as a
+    TRACED ``graph`` operand (never a baked constant), so the timed
+    gather is the indices-as-data sparse exchange, matching the
+    fingerprint :func:`train_block_fingerprint` cites. ``None`` is an
+    empty pytree to jit, so the static arm shares the code path.
     """
+    from rcmarl_tpu.config import scheduled_in_nodes
     from rcmarl_tpu.training.buffer import update_batch
     from rcmarl_tpu.training.rollout import rollout_block
     from rcmarl_tpu.training.trainer import (
@@ -627,10 +645,15 @@ def profile_phases(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
     )
     from rcmarl_tpu.training.update import actor_phase, critic_tr_epoch
 
+    graph = (
+        jnp.asarray(scheduled_in_nodes(cfg, 0))
+        if cfg.graph_schedule != "static"
+        else None
+    )
     if state is None:
         state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
     # one production block first: warm the buffer to steady-state occupancy
-    state, _ = train_block(cfg, state)
+    state, _ = train_block(cfg, state, graph=graph)
 
     env = make_env(cfg)
     key = jax.random.PRNGKey(0)
@@ -646,16 +669,20 @@ def profile_phases(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
     r_coop = team_average_reward(cfg, batch.r)
 
     epoch = jax.jit(
-        lambda p, b, rc, k: critic_tr_epoch(
-            cfg, (p.critic, p.tr, p.critic_local), b, rc, k
+        lambda p, b, rc, k, g: critic_tr_epoch(
+            cfg, (p.critic, p.tr, p.critic_local), b, rc, k, graph=g
         )
     )
-    out["critic_tr_epoch"] = _timeit(epoch, state.params, batch, r_coop, key, reps=reps)
+    out["critic_tr_epoch"] = _timeit(
+        epoch, state.params, batch, r_coop, key, graph, reps=reps
+    )
 
     actor = jax.jit(lambda p, f, k: actor_phase(cfg, p, f, k))
     out["actor_phase"] = _timeit(actor, state.params, fresh, key, reps=reps)
 
-    out["full_block"] = _timeit(lambda s: train_block(cfg, s), state, reps=reps)
+    out["full_block"] = _timeit(
+        lambda s: train_block(cfg, s, graph=graph), state, reps=reps
+    )
     return out
 
 
@@ -674,12 +701,19 @@ def consensus_tags(cfg) -> Dict[str, int]:
     per_agent = sum(
         int(l.size) // cfg.n_agents for l in jax.tree.leaves(params)
     )
+    # Scheduled configs gather along the schedule's degree axis, not the
+    # static anchor's n_in — tag the volume the launch actually streams.
+    n_in = (
+        cfg.n_in
+        if cfg.graph_schedule == "static"
+        else cfg.resolved_graph_degree
+    )
     return {
-        "n_in": cfg.n_in,
+        "n_in": n_in,
         "H": cfg.H,
         "n_agents": cfg.n_agents,
-        "volume": cfg.n_in * cfg.n_agents,
-        "gathered_numel": cfg.n_agents * cfg.n_in * per_agent,
+        "volume": n_in * cfg.n_agents,
+        "gathered_numel": cfg.n_agents * n_in * per_agent,
     }
 
 
@@ -759,9 +793,17 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
         team_average_reward,
     )
 
-    from rcmarl_tpu.config import FUSED_CONSENSUS_IMPLS
+    from rcmarl_tpu.config import FUSED_CONSENSUS_IMPLS, scheduled_in_nodes
     from rcmarl_tpu.training.update import consensus_block
 
+    # scheduled configs: measure the indices-as-data sparse exchange —
+    # the block-0 resample rides every gather/epoch arm as a TRACED
+    # operand (profile_phases discipline; None = static, empty pytree)
+    graph = (
+        jnp.asarray(scheduled_in_nodes(cfg, 0))
+        if cfg.graph_schedule != "static"
+        else None
+    )
     if state is None:
         state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
     env = make_env(cfg)
@@ -787,24 +829,28 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
         out["gather"] = 0.0
     elif stacked:
         gather_arm = jax.jit(
-            lambda c, t: gather_neighbor_messages(cfg, _pair_block(c, t))
-        )
-        out["gather"] = _timeit(gather_arm, critic, tr, reps=reps)
-    else:
-        gather_arm = jax.jit(
-            lambda c, t: (
-                gather_neighbor_messages(cfg, c),
-                gather_neighbor_messages(cfg, t),
+            lambda c, t, g: gather_neighbor_messages(
+                cfg, _pair_block(c, t), g
             )
         )
-        out["gather"] = _timeit(gather_arm, critic, tr, reps=reps)
-    gather = jax.jit(lambda t: gather_neighbor_messages(cfg, t))
+        out["gather"] = _timeit(gather_arm, critic, tr, graph, reps=reps)
+    else:
+        gather_arm = jax.jit(
+            lambda c, t, g: (
+                gather_neighbor_messages(cfg, c, g),
+                gather_neighbor_messages(cfg, t, g),
+            )
+        )
+        out["gather"] = _timeit(gather_arm, critic, tr, graph, reps=reps)
+    gather = jax.jit(lambda t, g: gather_neighbor_messages(cfg, t, g))
     nbr = gather(
-        critic
+        critic, graph
     )  # (N, n_in, ...) leaves — the trim-bound/clip diagnostics' input
 
     # the flattened one-launch layout: ONE (N, n_in, P_total) block
-    N, n_in = cfg.n_agents, cfg.n_in
+    # (scheduled arm: the neighbor axis is the schedule's degree)
+    N = cfg.n_agents
+    n_in = cfg.n_in if graph is None else int(graph.shape[1])
     flat = jnp.concatenate(
         [l.reshape(N, n_in, -1) for l in jax.tree.leaves(nbr)], axis=-1
     )
@@ -849,7 +895,7 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
     elif stacked:
         # phase II as the netstack epoch runs it: ONE fused pair update
         # over the combined (N, n_in, P_c + P_t) gathered block
-        pair_nbr = gather(_pair_block(critic, tr))
+        pair_nbr = gather(_pair_block(critic, tr), graph)
 
         cons2 = jax.jit(
             jax.vmap(
@@ -861,7 +907,7 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
         )
         out["consensus"] = _timeit(cons2, critic, tr, pair_nbr, reps=reps)
     else:
-        nbr_t = gather(tr)
+        nbr_t = gather(tr, graph)
 
         def cons_both(critic_p, tr_p, nc, nt):
             c = jax.vmap(
@@ -1000,11 +1046,13 @@ def profile_consensus(cfg, state=None, *, reps: int = 3) -> Dict[str, float]:
 
     # the whole epoch + the residual the micro components don't cover
     epoch = jax.jit(
-        lambda p, b, rc, k: critic_tr_epoch(
-            cfg, (p.critic, p.tr, p.critic_local), b, rc, k
+        lambda p, b, rc, k, g: critic_tr_epoch(
+            cfg, (p.critic, p.tr, p.critic_local), b, rc, k, graph=g
         )
     )
-    out["epoch"] = _timeit(epoch, state.params, batch, r_coop, key, reps=reps)
+    out["epoch"] = _timeit(
+        epoch, state.params, batch, r_coop, key, graph, reps=reps
+    )
     out["epoch_other"] = (
         out["epoch"]
         - out["gather"]

@@ -503,6 +503,24 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
     --adaptive_scale 100 --summary_dir "$smoke_dir" --quiet
 echo "adaptive-adversary smoke cell OK"
 
+# Mega-population smoke cell (round 18): a tiny-budget n=256 train
+# through the real CLI with consensus riding the SPARSE random-
+# geometric schedule as traced data (ops/exchange.py sparse_gather,
+# O(n·deg·P) instead of the n² dense gather) and the fit_clip
+# stability rail on — the mega-population wire-up end to end (CLI
+# flags -> Config -> host-looped train() -> per-block resample ->
+# sparse exchange -> checkpoint). The population is what is under
+# test, so everything else stays minimal: (4,) hidden, 2 blocks.
+# The bitwise sparse-vs-dense pins and n=1024 ladders live in
+# tests/test_exchange.py + AUDIT.jsonl; this proves the CLI path.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
+    --n_agents 256 --in_degree 5 --nrow 16 --ncol 16 --hidden 4 \
+    --graph_schedule random_geometric --graph_degree 9 --graph_every 1 \
+    --fit_clip 1.0 --H 1 \
+    --n_episodes 4 --n_ep_fixed 2 --max_ep_len 4 --n_epochs 1 \
+    --summary_dir "$smoke_dir" --quiet
+echo "mega-population sparse smoke cell OK"
+
 # Chaos smoke cell: a representative slice of the chaos campaign
 # through the real CLI, gated against the committed RESILIENCE.jsonl —
 # one transport cell (NaN bombs at the high rate, sanitize+guard), the

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Mega-population training quality — the committed QUALITY.md
+experiment (simulation_results/mega_population.json).
+
+The n=256 congestion-free grid world at population scale, consensus
+riding the SPARSE time-varying exchange (random-geometric degree 9,
+resampled every block, gather indices as traced data —
+ops/exchange.py) with the ``fit_clip`` stability rail on (the raw
+reference fit diverges past n~64: Config.fit_clip). Three arms ask the
+mega-population acceptance questions directly:
+
+  clean_h2   : 256 cooperative, H=2 — does training IMPROVE at n=256
+               on the sparse path (first-window vs last-window mean
+               return), and does it stay finite end to end?
+  trimmed_h2 : 254 coop + 2 Adaptive colluders, H=2 — the PROVISIONED
+               trim: total colluders <= H, so no neighborhood can ever
+               contain more than H of them, under ANY schedule —
+               containment by construction, and the trimmed mean holds
+               both gates.
+  trimmed_h1 : same cast, H=1 — the UNDER-provisioned trim: both
+               colluders landing in one resampled neighborhood beat a
+               1-per-side trim, and each leaked payload (10x the
+               healthy spread) widens the next epoch's spread. At 2
+               colluders the leak is measurable (consensus magnitude
+               elevated over clean) but slow; as the colluder count
+               grows it compounds geometrically to non-finite — with 8
+               colluders even H=2 falls, since >=3 land in one
+               degree-9 neighborhood a handful of times over 60
+               resamples, and a handful is enough. The theory's
+               assumption is <=H Byzantine PER NEIGHBORHOOD, and a
+               global count above H plus schedule mixing is what
+               breaks it — not the sparse exchange itself.
+  plain_h0   : same cast, H=0 — the undefended comparison arm (the
+               clip-and-average bounds are adversary-controlled).
+
+Each arm reports its return windows AND ``consensus_abs_max`` — the
+largest |parameter| across the COOPERATIVE agents' consensus critic+TR
+rows at the end of the run (the adversaries' own rows are
+adversary-controlled by definition and excluded). That second metric is where the poisoning shows first: the policy's
+returns are shielded for a while by Adam's scale invariance (blown-up
+advantages normalize away in the actor step), so the return band alone
+CANNOT separate the under-provisioned arms — the H=2 arm's consensus
+nets stay near the clean arm's band while the H=1 and H=0 nets go
+non-finite. ``values_sane`` gates it at 100x the clean arm's
+magnitude.
+
+The adversary is the omniscient colluding ADAPTIVE role at scale 10
+(see scripts/adaptive_adversary.py for the 5-agent original; this is
+its n-scale twin over a time-varying sparse graph).
+
+Usage:  python scripts/mega_population.py [--episodes 120]
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--episodes", type=int, default=120)
+    p.add_argument("--seed", type=int, default=300)
+    p.add_argument("--n_agents", type=int, default=256)
+    p.add_argument("--n_adv", type=int, default=2)
+    p.add_argument("--degree", type=int, default=9)
+    p.add_argument("--scale", type=float, default=10.0)
+    p.add_argument("--window", type=int, default=30)
+    p.add_argument("--tol", type=float, default=0.05)
+    p.add_argument(
+        "--out", type=str, default="simulation_results/mega_population.json"
+    )
+    args = p.parse_args()
+
+    import jax
+
+    from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+    from rcmarl_tpu.training.trainer import train
+
+    n, n_adv = args.n_agents, args.n_adv
+    side = max(3, int(round(n**0.5)))
+    coop = (Roles.COOPERATIVE,) * n
+    adv = (Roles.COOPERATIVE,) * (n - n_adv) + (Roles.ADAPTIVE,) * n_adv
+    arms_spec = [
+        ("clean_h2", coop, 2),
+        ("trimmed_h2", adv, 2),
+        ("trimmed_h1", adv, 1),
+        ("plain_h0", adv, 0),
+    ]
+
+    arms = []
+    for label, cast, H in arms_spec:
+        cfg = Config(
+            n_agents=n,
+            agent_roles=cast,
+            # tiny static anchor ring: consensus rides the schedule
+            in_nodes=circulant_in_nodes(n, 5),
+            nrow=side,
+            ncol=side,
+            hidden=(4,),
+            graph_schedule="random_geometric",
+            graph_degree=args.degree,
+            H=H,
+            fit_clip=1.0,
+            adaptive_scale=args.scale,
+            n_episodes=args.episodes,
+            n_ep_fixed=2,
+            max_ep_len=8,
+            n_epochs=2,
+            slow_lr=0.002,
+            seed=args.seed,
+        )
+        state, df = train(cfg, guard=False)
+        r = df["True_team_returns"].values
+        finite = np.isfinite(r)
+        collapsed = None if finite.all() else int(np.argmin(finite))
+        rf = r[finite]
+        w = min(args.window, max(1, len(rf) // 3))
+        # healthy rows only: the adversaries' own rows in the stacked
+        # trees are adversary-controlled by definition (their local fits
+        # ride their own poisoned estimates) — the poisoning question is
+        # what the COOPERATIVE agents' consensus nets absorbed.
+        coop_mask = np.array([c == Roles.COOPERATIVE for c in cast])
+        cons = max(
+            float(np.max(np.abs(np.asarray(leaf)[coop_mask])))
+            for tree in (state.params.critic, state.params.tr)
+            for leaf in jax.tree_util.tree_leaves(tree)
+        )
+        arms.append(
+            {
+                "label": label,
+                "H": H,
+                "adversaries": int(sum(c == Roles.ADAPTIVE for c in cast)),
+                "first_window": round(float(np.mean(rf[:w])), 4),
+                "final_return": round(float(np.mean(rf[-w:])), 4),
+                "consensus_abs_max": float(f"{cons:.4g}"),
+                "collapsed_at_episode": collapsed,
+            }
+        )
+        print(arms[-1], flush=True)
+
+    clean = next(a for a in arms if a["label"] == "clean_h2")
+    clean["improved"] = bool(
+        clean["collapsed_at_episode"] is None
+        and clean["final_return"] > clean["first_window"]
+    )
+    band = clean["final_return"]
+    sane = 100.0 * clean["consensus_abs_max"]
+    for a in arms:
+        # one-sided: DEGRADATION is what the band polices
+        a["within_clean_band"] = bool(
+            a["collapsed_at_episode"] is None
+            and a["final_return"] >= band - args.tol * abs(band)
+        )
+        a["values_sane"] = bool(a["consensus_abs_max"] <= sane)
+
+    out = {
+        "generated_by": "python scripts/mega_population.py",
+        "config": {
+            "scenario": (
+                f"n={n} grid ({side}x{side}), sparse random-geometric "
+                f"degree {args.degree} resampled per block, "
+                f"{n_adv} Adaptive colluders, fit_clip 1.0"
+            ),
+            "episodes": args.episodes,
+            "seed": args.seed,
+            "adaptive_scale": args.scale,
+            "window": args.window,
+            "tol": args.tol,
+        },
+        "platform": jax.devices()[0].platform,
+        "clean_final": band,
+        "arms": arms,
+    }
+    path = Path(args.out)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(out, indent=1) + "\n")
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

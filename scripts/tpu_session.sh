@@ -238,6 +238,28 @@ run_step "13. pipelined-gossip-fleet refit (composed steps/s, on-chip)" \
     --json_out simulation_results/gala_composed_tpu.json \
     --perf_out PERF.jsonl
 
+# The mega-population path (PR 18): the committed n256_sparse /
+# n1024_sparse epoch rows and the sparse-vs-dense consensus micros are
+# CPU fallbacks (headline:false — a serial host loop dominates the
+# per-block resample + launch). This is the on-chip refit: (14) the
+# sparse bench cells at both scales across the env-zoo scale-up arms
+# (congestion + pursuit ride the same cells via --env), re-appending
+# headline epoch rows with the resolved cost_fingerprint so the
+# O(n·deg·P) claim is priced on the MXU, and (14b) the consensus
+# micro split (gather vs trim-bounds vs clip/mean) on the n256 dense
+# comparator vs the sparse schedule — the measured crossover the
+# AUDIT.jsonl cost arm models statically.
+run_step "14. mega-population sparse refit (n256/n1024 epoch rows)" \
+    timeout 5400 python -m rcmarl_tpu bench \
+    --configs n256_sparse n1024_sparse \
+    --env grid_world congestion pursuit \
+    --n_ep_fixed 2 --blocks 3 --reps 3 --out PERF.jsonl
+
+run_step "14b. sparse-vs-dense consensus micro (n256, on-chip)" \
+    timeout 3600 python -m rcmarl_tpu profile \
+    --configs n256_ring n256_sparse \
+    --consensus_micro --out PERF.jsonl
+
 echo "== session summary =="
 rc=0
 for name in "${step_order[@]}"; do

@@ -1,0 +1,499 @@
+"""Sparse neighbor exchange + mega-population rails (ops/exchange.py).
+
+Contracts:
+
+1. **Sparse-vs-dense bitwise pin** — :func:`sparse_gather` with the
+   static topology's own indices passed as TRACED data is bitwise the
+   compiled static gather, and a whole ``train_block`` on the scheduled
+   config (graph = the static circulant, as data) matches the static
+   config leaf-for-leaf across the arm matrix: dual/netstack, clean /
+   faulted+sanitize, and the fused-consensus config (which routes a
+   data graph onto the stacked XLA arm — pinned against the static
+   fused kernel, i.e. kernel-vs-data-graph).
+2. **Guard rails** — every graph :func:`rcmarl_tpu.config.scheduled_in_nodes`
+   can emit passes :func:`validate_graph` (hypothesis twin), and every
+   corruption class (shape, dtype, range, self-slot, duplicates, trim
+   headroom) is rejected loudly before it can reach the device gather.
+3. **Cost model** — the analytic exchange cost is linear in
+   ``n·degree`` and strictly below the dense ``n·n`` exchange for any
+   ``degree < n`` (the AUDIT.jsonl ``consensus_exchange`` row's
+   invariant, checked here without compiling anything).
+4. **fit_clip rail** — ``clip=0`` (the default) and an unreachable
+   ceiling are BITWISE the reference fit (IEEE: ``g * 1.0 == g``), an
+   active clip bounds the step norm by ``lr * clip``, and the clip
+   threads through the fitstack XLA/Pallas twins leaf-for-leaf.
+5. **Diff-DAC task axis** — ``env_step_scaled`` at ``task_scale=1.0``
+   is bitwise the plain congestion step; the task-axis gossip program
+   trains finite and records its levels.
+
+Heavy cells (two trainer compiles or a replica program) are
+slow-marked; the tier-1 residents are the gather/validator/cost/fit
+units plus ONE tiny block-level pin.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rcmarl_tpu.config import (
+    Config,
+    circulant_in_nodes,
+    scheduled_in_nodes,
+)
+from rcmarl_tpu.faults import FaultPlan
+from rcmarl_tpu.ops.exchange import (
+    exchange_cost_model,
+    sparse_gather,
+    validate_graph,
+)
+from rcmarl_tpu.training.trainer import init_train_state, train_block
+
+N = 6
+DEG = 3  # incl. self: 2H <= DEG-1 holds with H=1
+
+#: miniature trainer shape (the tier-1 compile budget is tight)
+TINY = dict(
+    n_agents=N,
+    agent_roles=(0,) * N,
+    in_nodes=circulant_in_nodes(N, DEG),
+    nrow=3,
+    ncol=3,
+    n_episodes=2,
+    max_ep_len=4,
+    n_ep_fixed=2,
+    n_epochs=1,
+    buffer_size=16,
+    coop_fit_steps=2,
+    adv_fit_epochs=1,
+    adv_fit_batch=4,
+    batch_size=4,
+    H=1,
+)
+
+
+def static_cfg(**kw):
+    base = dict(TINY)
+    base.update(kw)
+    return Config(**base)
+
+
+def sched_cfg(**kw):
+    """The same topology, but consensus rides the data-graph path."""
+    return static_cfg(
+        graph_schedule="random_geometric", graph_degree=DEG, **kw
+    )
+
+
+#: the static circulant's own rows, as the traced-data operand
+CIRC = jnp.asarray(np.array(circulant_in_nodes(N, DEG)), jnp.int32)
+
+
+def assert_trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def random_tree(key, n):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "w": jax.random.normal(k1, (n, 4, 3)),
+        "b": jax.random.normal(k2, (n,)),
+        "v": jax.random.normal(k3, (n, 2)),
+    }
+
+
+class TestSparseGather:
+    def test_matches_static_fancy_index_bitwise(self):
+        """Same indices, data vs literal: the exact same gather op."""
+        tree = random_tree(jax.random.PRNGKey(0), N)
+        idx = np.array(circulant_in_nodes(N, DEG))
+        sparse = sparse_gather(tree, jnp.asarray(idx, jnp.int32))
+        static = jax.tree.map(lambda l: l[idx], tree)
+        assert_trees_equal(sparse, static)
+
+    def test_own_message_at_slot_zero(self):
+        tree = random_tree(jax.random.PRNGKey(1), N)
+        out = sparse_gather(tree, CIRC)
+        for name in tree:
+            np.testing.assert_array_equal(
+                np.asarray(out[name][:, 0]), np.asarray(tree[name])
+            )
+
+    def test_one_compiled_program_across_resamples(self):
+        """Indices are DATA: one jitted program serves every graph."""
+        tree = random_tree(jax.random.PRNGKey(2), N)
+        compiles = []
+        cfg = sched_cfg()
+
+        @jax.jit
+        def gather(t, g):
+            compiles.append(1)
+            return sparse_gather(t, g)
+
+        for block in range(3):
+            g = validate_graph(
+                scheduled_in_nodes(cfg, block), N, degree=DEG, H=1
+            )
+            out = gather(tree, jnp.asarray(g))
+            assert_trees_equal(out, jax.tree.map(lambda l: l[g], tree))
+        assert len(compiles) == 1  # traced once, re-dispatched twice
+
+    def test_ragged_padded_rows_match_dense_gather(self):
+        """The dense arm's padded fancy-index gather IS sparse_gather on
+        the padded index array — the ragged-graph pin."""
+        from rcmarl_tpu.training.update import gather_neighbor_messages
+
+        ragged = ((0, 1), (1, 0, 2), (2, 0))
+        cfg = static_cfg(
+            n_agents=3, agent_roles=(0,) * 3, in_nodes=ragged, H=0
+        )
+        in_pad, valid = cfg.padded_in_nodes()
+        assert any(v != valid[0] for v in valid)  # genuinely ragged
+        tree = random_tree(jax.random.PRNGKey(3), 3)
+        dense = gather_neighbor_messages(cfg, tree)
+        sparse = sparse_gather(tree, jnp.asarray(np.array(in_pad)))
+        assert_trees_equal(dense, sparse)
+
+
+def _block_pin(cfg_sched, cfg_static):
+    """train_block on the scheduled config, fed the STATIC topology as
+    data, must match the static program leaf-for-leaf."""
+    state = init_train_state(cfg_static, jax.random.PRNGKey(0))
+    out_d, m_d = train_block(cfg_static, state)
+    out_s, m_s = train_block(cfg_sched, state, graph=CIRC)
+    assert_trees_equal(out_s.params, out_d.params)
+    np.testing.assert_array_equal(
+        np.asarray(m_s.true_team_returns), np.asarray(m_d.true_team_returns)
+    )
+
+
+class TestBlockLevelPins:
+    def test_dual_arm_clean(self):
+        _block_pin(sched_cfg(netstack=False), static_cfg(netstack=False))
+
+    @pytest.mark.slow
+    def test_netstack_arm_clean(self):
+        _block_pin(sched_cfg(netstack=True), static_cfg(netstack=True))
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("netstack", [False, True])
+    def test_faulted_sanitized(self, netstack):
+        """Transport faults act on the GATHERED block, so the sparse
+        block passes through the same fault/trim/clip/mean chain."""
+        plan = FaultPlan(nan_p=0.3, drop_p=0.2, seed=11)
+        kw = dict(
+            netstack=netstack, fault_plan=plan, consensus_sanitize=True
+        )
+        _block_pin(sched_cfg(**kw), static_cfg(**kw))
+
+    @pytest.mark.slow
+    def test_fused_kernel_vs_data_graph(self):
+        """Kernel-vs-data-graph equivalence: the scheduled XLA arm fed
+        the static topology as traced data matches the STATIC
+        fused-consensus kernel (which unrolls in_nodes inside the
+        Pallas program) to kernel tolerance — the fused kernel itself
+        is only allclose to the XLA arm in this fusion context, so the
+        pin is allclose, not bitwise (the bitwise sparse-vs-dense pins
+        live on the XLA arms above). The fused config refuses a
+        time-varying schedule loudly (its gather is program structure,
+        not data)."""
+        cfg_f = static_cfg(
+            netstack=True, consensus_impl="pallas_fused_interpret"
+        )
+        cfg_s = sched_cfg(netstack=True)
+        state = init_train_state(cfg_f, jax.random.PRNGKey(0))
+        out_f, _ = train_block(cfg_f, state)
+        out_s, _ = train_block(cfg_s, state, graph=CIRC)
+        for a, b in zip(
+            jax.tree.leaves(out_f.params), jax.tree.leaves(out_s.params)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            )
+        with pytest.raises(ValueError, match="time-varying"):
+            sched_cfg(consensus_impl="pallas_fused_interpret")
+
+    @pytest.mark.slow
+    def test_scheduled_host_loop_trains_finite(self):
+        """The real host-looped train() path: per-block resamples flow
+        through validate_graph + sparse_gather and training stays
+        finite."""
+        from rcmarl_tpu.training.trainer import train
+
+        cfg = sched_cfg(n_episodes=4, fit_clip=1.0)
+        state, df = train(cfg, n_episodes=4)
+        assert all(
+            bool(jnp.isfinite(l).all()) for l in jax.tree.leaves(state.params)
+        )
+        assert np.isfinite(df["True_team_returns"].to_numpy()).all()
+
+
+class TestValidateGraph:
+    def valid(self):
+        return np.asarray(
+            validate_graph(scheduled_in_nodes(sched_cfg(), 0), N, DEG, 1)
+        )
+
+    def test_accepts_scheduled_output(self):
+        g = self.valid()
+        assert g.dtype == np.int32 and g.shape == (N, DEG)
+
+    def test_rejects_wrong_shape(self):
+        with pytest.raises(ValueError, match="must be"):
+            validate_graph(self.valid()[: N - 1], N)
+        with pytest.raises(ValueError, match="degree"):
+            validate_graph(self.valid(), N, degree=DEG + 1)
+
+    def test_rejects_float_dtype(self):
+        with pytest.raises(ValueError, match="integer"):
+            validate_graph(self.valid().astype(np.float32), N)
+
+    def test_rejects_out_of_range(self):
+        g = self.valid()
+        g[2, 1] = N  # one past the end
+        with pytest.raises(ValueError, match="out of range"):
+            validate_graph(g, N)
+        g = self.valid()
+        g[0, 2] = -1
+        with pytest.raises(ValueError, match="out of range"):
+            validate_graph(g, N)
+
+    def test_rejects_non_self_first(self):
+        g = self.valid()
+        g[3, 0], g[3, 1] = g[3, 1], g[3, 0]
+        with pytest.raises(ValueError, match="itself"):
+            validate_graph(g, N)
+
+    def test_rejects_duplicate_senders(self):
+        g = self.valid()
+        g[1, 2] = g[1, 1]  # a sender voting twice
+        with pytest.raises(ValueError, match="duplicate"):
+            validate_graph(g, N)
+
+    def test_rejects_insufficient_trim_headroom(self):
+        with pytest.raises(ValueError, match="2H"):
+            validate_graph(self.valid(), N, H=2)  # needs degree >= 5
+
+
+@pytest.mark.parametrize("n,deg", [(16, 4), (256, 9), (1024, 8)])
+def test_cost_model_linear_and_below_dense(n, deg):
+    sparse = exchange_cost_model(n, deg, p_total=100)
+    dense = exchange_cost_model(n, n, p_total=100)
+    assert sparse["total"] < dense["total"]
+    # the dominant written-block term is exactly linear in degree
+    double = exchange_cost_model(n, 2 * deg, p_total=100)
+    assert double["write_gathered"] == 2 * sparse["write_gathered"]
+
+
+# Property twin: EVERY graph the schedule can emit passes the guard.
+# The deterministic sweep always runs (hypothesis is an optional dep —
+# tests/test_graph_properties.py covers the builder when it exists);
+# with hypothesis present the same property also fuzzes broadly.
+def _check_schedule_validates(H, seed, block, n=8):
+    degree = 2 * H + 1
+    cfg = Config(
+        n_agents=n,
+        agent_roles=(0,) * n,
+        in_nodes=tuple(
+            tuple((i + k) % n for k in range(degree)) for i in range(n)
+        ),
+        H=H,
+        graph_schedule="random_geometric",
+        graph_degree=degree,
+        graph_seed=seed,
+    )
+    g = validate_graph(
+        scheduled_in_nodes(cfg, block), n, degree=degree, H=H
+    )
+    assert g.shape == (n, degree)
+
+
+@pytest.mark.parametrize("H", [0, 1, 2])
+@pytest.mark.parametrize("seed", [0, 17, 2**19 + 3])
+@pytest.mark.parametrize("block", [0, 1, 37])
+def test_scheduled_graphs_always_validate(H, seed, block):
+    _check_schedule_validates(H, seed, block)
+
+
+try:  # the fuzzing twin, when the optional dep exists
+    from hypothesis import given, settings, strategies as st
+
+    @given(
+        st.integers(min_value=0, max_value=2),  # H
+        st.integers(min_value=0, max_value=2**20),  # graph_seed
+        st.integers(min_value=0, max_value=40),  # block
+    )
+    @settings(max_examples=40, deadline=None)
+    def test_scheduled_graphs_always_validate_fuzzed(H, seed, block):
+        _check_schedule_validates(H, seed, block)
+
+except ImportError:  # pragma: no cover - hypothesis not installed
+    pass
+
+
+class TestFitClip:
+    def _fit(self, clip, minibatch=False):
+        from rcmarl_tpu.ops.fit import fit_mse_full_batch, fit_mse_minibatch
+
+        key = jax.random.PRNGKey(5)
+        k1, k2, k3 = jax.random.split(key, 3)
+        params = {
+            "w": jax.random.normal(k1, (3, 1)),
+            "b": jnp.zeros((1,)),
+        }
+        x = jax.random.normal(k2, (16, 3)) * 8.0  # hot gradients
+        t = jax.random.normal(k3, (16, 1))
+        mask = jnp.ones((16,))
+        fwd = lambda p, xx: xx @ p["w"] + p["b"]
+        if minibatch:
+            out, _ = fit_mse_minibatch(
+                key, params, fwd, x, t, mask, epochs=2, batch_size=8,
+                lr=0.05, clip=clip,
+            )
+        else:
+            out, _ = fit_mse_full_batch(
+                params, fwd, x, t, mask, n_steps=3, lr=0.05, clip=clip
+            )
+        return params, out
+
+    @pytest.mark.parametrize("minibatch", [False, True])
+    def test_off_and_unreachable_ceiling_bitwise(self, minibatch):
+        """clip=0 traces NO clip ops; an unreachable ceiling multiplies
+        by exactly 1.0 — both are the reference fit, bit-for-bit."""
+        _, off = self._fit(0.0, minibatch)
+        _, huge = self._fit(1e12, minibatch)
+        assert_trees_equal(off, huge)
+
+    def test_active_clip_bounds_first_step(self):
+        from rcmarl_tpu.ops.fit import fit_mse_full_batch
+
+        clip, lr = 0.25, 0.05
+        params, _ = self._fit(0.0)
+        fwd = lambda p, xx: xx @ p["w"] + p["b"]
+        key = jax.random.PRNGKey(5)
+        _, k2, k3 = jax.random.split(key, 3)
+        x = jax.random.normal(k2, (16, 3)) * 8.0
+        t = jax.random.normal(k3, (16, 1))
+        out, _ = fit_mse_full_batch(
+            params, fwd, x, t, jnp.ones((16,)), n_steps=1, lr=lr, clip=clip
+        )
+        delta = jax.tree.map(lambda a, b: a - b, out, params)
+        norm = float(
+            jnp.sqrt(
+                sum(jnp.sum(jnp.square(l)) for l in jax.tree.leaves(delta))
+            )
+        )
+        assert norm <= lr * clip * (1 + 1e-5)
+        # and the raw fit genuinely exceeds the ceiling (clip is active)
+        raw, _ = fit_mse_full_batch(
+            params, fwd, x, t, jnp.ones((16,)), n_steps=1, lr=lr
+        )
+        draw = jax.tree.map(lambda a, b: a - b, raw, params)
+        assert (
+            float(
+                jnp.sqrt(
+                    sum(
+                        jnp.sum(jnp.square(l))
+                        for l in jax.tree.leaves(draw)
+                    )
+                )
+            )
+            > lr * clip
+        )
+
+    def test_clip_threads_through_fitstack_twins(self):
+        """XLA fused scan vs Pallas fit kernel (interpret), clip ON:
+        the clip lives in the shared step body, so the leaf-for-leaf
+        pin carries any clip value."""
+        from rcmarl_tpu.ops.fit import FitSchedule, fused_fit_scan
+        from rcmarl_tpu.ops.pallas_fit import pallas_fit_scan
+
+        R, n, B, W = 2, 2, 8, 4
+        key = jax.random.PRNGKey(9)
+        ks = jax.random.split(key, 6)
+        keys = jax.random.split(ks[0], R * n).reshape(R, n, -1)
+        params = {
+            "w": jax.random.normal(ks[1], (R, n, W, 1)),
+            "b": jnp.zeros((R, n, 1)),
+        }
+        x = jax.random.normal(ks[2], (R, B, W)) * 5.0
+        t = jax.random.normal(ks[3], (R, n, B, 1))
+        mask = jnp.ones((B,))
+        fwd = lambda p, xx: xx @ p["w"] + p["b"]
+        sched = FitSchedule(epochs=2, batch_size=4)
+        xla_out, xla_loss = fused_fit_scan(
+            keys, params, fwd, x, t, mask, sched, 0.05, 0.3
+        )
+        pl_out, pl_loss = pallas_fit_scan(
+            keys, params, fwd, x, t, mask, sched, 0.05, 0.3,
+            interpret=True,
+        )
+        assert_trees_equal(xla_out, pl_out)
+        np.testing.assert_allclose(
+            np.asarray(pl_loss), np.asarray(xla_loss), rtol=1e-6
+        )
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="fit_clip"):
+            static_cfg(fit_clip=-0.5)
+        assert static_cfg(fit_clip=1.0).fit_clip == 1.0
+
+
+class TestTaskAxis:
+    def _world(self, weight=1.0):
+        from rcmarl_tpu.envs.api import make_env
+
+        cfg = static_cfg(env="congestion", congestion_weight=weight)
+        return cfg, make_env(cfg)
+
+    def test_unit_scale_bitwise(self):
+        from rcmarl_tpu.envs.congestion import env_step, env_step_scaled
+
+        cfg, env = self._world()
+        key = jax.random.PRNGKey(4)
+        pos = jax.random.randint(key, (N, 2), 0, 3)
+        task = jnp.zeros((N, 2), jnp.int32)
+        acts = jax.random.randint(key, (N,), 0, 5)
+        base = env_step(env, pos, task, acts)
+        scaled = env_step_scaled(env, pos, task, acts, jnp.float32(1.0))
+        for a, b in zip(base, scaled):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_scale_amplifies_the_toll_only(self):
+        from rcmarl_tpu.envs.congestion import env_step, env_step_scaled
+
+        cfg, env = self._world()
+        pos = jnp.zeros((N, 2), jnp.int32)  # everyone on one cell
+        task = jnp.zeros((N, 2), jnp.int32)
+        acts = jnp.zeros((N,), jnp.int32)  # all stay: shaping = 0
+        _, _, r1 = env_step(env, pos, task, acts)
+        _, _, r2 = env_step_scaled(env, pos, task, acts, jnp.float32(2.0))
+        np.testing.assert_allclose(np.asarray(r2), 2.0 * np.asarray(r1))
+
+    @pytest.mark.slow
+    def test_task_axis_gossip_trains_finite(self):
+        """The Diff-DAC arm end to end: two replicas train the
+        congestion world at different load levels through ONE compiled
+        program, the gossip mix doubling as cross-task consensus."""
+        from rcmarl_tpu.parallel.gossip import train_gossip
+
+        cfg = static_cfg(
+            env="congestion",
+            replicas=2,
+            task_axis=True,
+            task_levels=(0.5, 2.0),
+            gossip_every=1,
+            gossip_graph="full",
+            gossip_H=0,
+        )
+        states, df = train_gossip(cfg)
+        g = df.attrs["gossip"]
+        assert g["task_axis"] is True
+        assert g["task_levels"] == [0.5, 2.0]
+        assert all(
+            bool(jnp.isfinite(l).all())
+            for l in jax.tree.leaves(states.params)
+        )
