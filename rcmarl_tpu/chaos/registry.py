@@ -260,21 +260,28 @@ def _run_adaptive(intensity: str) -> dict:
 
 
 def _run_mega_sparse(intensity: str) -> dict:
-    """``h{H}``: adaptive collusion at POPULATION scale over the sparse
-    time-varying graph — 248 cooperators + 8 Adaptive colluders at
-    n=256, trimmed consensus over random-geometric degree-9
-    neighborhoods resampled every block (gather indices flow as DATA
-    through :func:`rcmarl_tpu.ops.exchange.sparse_gather`, with
+    """``h{H}`` / ``h{H}_fused``: adaptive collusion at POPULATION
+    scale over the sparse time-varying graph — 248 cooperators + 8
+    Adaptive colluders at n=256, trimmed consensus over
+    random-geometric degree-9 neighborhoods resampled every block
+    (gather indices flow as DATA through
+    :func:`rcmarl_tpu.ops.exchange.sparse_gather`, with
     ``validate_graph`` guarding every resample on the real host-loop
-    path). Survival = the trim holds the clean twin's band where each
-    neighborhood sees colluders only through the sparse schedule — the
-    n-scale point the tiny 3-ring adaptive cell cannot represent."""
+    path). The ``_fused`` suffix runs the same cell on the round-19
+    fused Pallas phase II (``consensus_impl='pallas_fused_interpret'``:
+    the schedule rides the kernel as a scalar-prefetch operand) — the
+    resilience claim must hold on the kernel arm, not just the XLA
+    chain it mirrors. Survival = the trim holds the clean twin's band
+    where each neighborhood sees colluders only through the sparse
+    schedule — the n-scale point the tiny 3-ring adaptive cell cannot
+    represent."""
     import numpy as np
 
     from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
     from rcmarl_tpu.training.trainer import train
 
-    H = int(intensity.removeprefix("h"))
+    fused = intensity.endswith("_fused")
+    H = int(intensity.removeprefix("h").removesuffix("_fused"))
     n, n_adv = 256, 8
     base = dict(
         n_agents=n,
@@ -294,13 +301,17 @@ def _run_mega_sparse(intensity: str) -> dict:
         max_ep_len=4,
         n_epochs=1,
     )
+    # The clean all-cooperative twin is shared across consensus arms
+    # (built from `base` BEFORE the impl override): the band is a
+    # return comparison, not a bitwise pin, and the default-impl twin
+    # is an order of magnitude cheaper than interpret-mode Pallas.
+    clean_cfg = Config(**base).replace(agent_roles=(Roles.COOPERATIVE,) * n)
+    if fused:
+        base["consensus_impl"] = "pallas_fused_interpret"
     cfg = Config(**base)
     clean_key = ("mega_sparse_clean", H)
     if clean_key not in _CLEAN_CACHE:
-        _, df = train(
-            cfg.replace(agent_roles=(Roles.COOPERATIVE,) * n),
-            n_episodes=_TRAIN_EPS,
-        )
+        _, df = train(clean_cfg, n_episodes=_TRAIN_EPS)
         _CLEAN_CACHE[clean_key] = _final_return(df)
     clean = _CLEAN_CACHE[clean_key]
     state, df = train(cfg, n_episodes=_TRAIN_EPS, guard=False)
@@ -320,8 +331,9 @@ def _run_mega_sparse(intensity: str) -> dict:
         "clean_return": clean,
         "detail": (
             f"{n_adv} Adaptive colluders at n={n}, scale 10, H={H}, "
-            "random_geometric degree 9 (sparse data-graph exchange), "
-            "guard off"
+            "random_geometric degree 9 (sparse data-graph exchange, "
+            + ("fused Pallas phase II" if fused else "XLA chain")
+            + "), guard off"
         ),
     }
 
@@ -1242,7 +1254,7 @@ CHAOS_POINTS: Tuple[ChaosPoint, ...] = (
         "H-trimming per scheduled neighborhood + validate_graph on "
         "every resample",
         "tests/test_exchange.py, QUALITY.md mega-population section",
-        (("h1", "survived"),),
+        (("h1", "survived"), ("h1_fused", "survived")),
         _run_mega_sparse,
     ),
     ChaosPoint(

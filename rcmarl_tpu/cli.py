@@ -1427,10 +1427,11 @@ BENCH_CONFIGS = {
     # the sparse random-geometric schedule as DATA (ops/exchange.py) —
     # past DENSE_DEGREE_LIMIT a dense static graph refuses to construct,
     # so these cells measure the O(n·deg·P) exchange, never the n² one.
-    # Scheduled cells route through the host-looped train() in
-    # cmd_bench (the device scan cannot regenerate the per-block
-    # resample); pair with `--env congestion pursuit` for the env-zoo
-    # scale-up rows.
+    # Since round 19 scheduled cells default to the stacked-schedule
+    # scan (one (S, N, deg) window operand, S blocks per launch); the
+    # round-18 host-looped train() arm stays available via
+    # `--sched_harness host_loop` (or `both` for the A/B). Pair with
+    # `--env congestion pursuit` for the env-zoo scale-up rows.
     "n256_sparse": dict(
         n_agents=256, hidden=(16, 16), degree=4, H=2,
         schedule="random_geometric", graph_degree=9, fit_clip=1.0,
@@ -1678,12 +1679,24 @@ def cmd_bench(argv) -> int:
         choices=list(GRAPH_SCHEDULES),
         help="graph-schedule arm(s) as a cell axis: static (default) = "
         "the compiled --configs topology, random_geometric = the sparse "
-        "scheduled exchange (gather indices as DATA — ops/exchange.py), "
-        "measured through the host-looped train() since the device scan "
-        "cannot regenerate the per-block resample; pass 'static "
-        "random_geometric' for the sparse-vs-dense A/B. Mega cells "
-        "(n256_sparse/n1024_sparse) pin their own schedule and ignore "
-        "this axis' static value",
+        "scheduled exchange (gather indices as DATA — ops/exchange.py); "
+        "pass 'static random_geometric' for the sparse-vs-dense A/B. "
+        "Mega cells (n256_sparse/n1024_sparse) pin their own schedule "
+        "and ignore this axis' static value",
+    )
+    g.add_argument(
+        "--sched_harness",
+        type=str,
+        default="scanned",
+        choices=["host_loop", "scanned", "both"],
+        help="harness for the scheduled cells: scanned (default) = the "
+        "stacked-schedule window (config.schedule_window) rides ONE "
+        "lax.scan launch per rep — S blocks per dispatch, graphs as "
+        "scan data; host_loop = the historical per-block host loop "
+        "(resample + validate + one dispatch per block); both = the "
+        "host-loop-vs-scanned A/B (PERF.md round 19). Rows are tagged "
+        "with sched_harness and the window length so the two arms "
+        "sharing a cost_fingerprint stay distinguishable",
     )
     g.add_argument(
         "--graph_every",
@@ -1779,12 +1792,17 @@ def cmd_bench(argv) -> int:
     # pipelined harness (the depth-0 row then measures the fused sync
     # block through the same harness — the honest sync-vs-pipelined A/B)
     pipeline_mode = any(d > 0 for d in args.pipeline_depth)
+    harness_arms = (
+        ["host_loop", "scanned"]
+        if args.sched_harness == "both"
+        else [args.sched_harness]
+    )
     n_failed = 0
-    for name, env, dtype, impl, layout, ns, fs, shard, depth, gsched in (
+    for name, env, dtype, impl, layout, ns, fs, shard, depth, gsched, harn in (
         itertools.product(
             args.configs, args.env, args.compute_dtype, args.impl,
             args.layout, args.netstack, args.fitstack, shard_modes,
-            args.pipeline_depth, args.graph_schedule,
+            args.pipeline_depth, args.graph_schedule, harness_arms,
         )
     ):
         cfg = _bench_config(
@@ -1798,6 +1816,10 @@ def cmd_bench(argv) -> int:
             graph_seed=args.graph_seed,
         )
         scheduled = cfg.graph_schedule != "static"
+        if not scheduled and harn != harness_arms[0]:
+            # the sched_harness axis only exists for scheduled cells;
+            # static cells would emit duplicate rows under 'both'
+            continue
         if (
             gsched != "static"
             and "schedule" in BENCH_CONFIGS[name]
@@ -1840,12 +1862,12 @@ def cmd_bench(argv) -> int:
             n_failed += _bench_pipeline_cell(args, name, cfg, depth)
             continue
         fingerprint = None
-        if scheduled:
-            # the sparse scheduled exchange: per-block graphs are
-            # host-resampled DATA, so the cell is the host-looped
-            # train() — same row shape, wall clock around the whole
-            # loop (resample + validate + block dispatch included: the
-            # cost a scheduled production run actually pays)
+        if scheduled and harn == "host_loop":
+            # the historical scheduled arm: per-block graphs are
+            # host-resampled DATA and every block is its own dispatch —
+            # wall clock around the whole loop (resample + validate +
+            # block dispatch included: the cost the pre-scan scheduled
+            # path paid, the round-19 A/B reference)
             from types import SimpleNamespace
 
             state = None
@@ -1857,6 +1879,26 @@ def cmd_bench(argv) -> int:
                 return st, SimpleNamespace(
                     true_team_returns=df["True_team_returns"].to_numpy()
                 )
+        elif scheduled:
+            # the STACKED-SCHEDULE scan: the (S, N, degree) window
+            # (config.schedule_window — bitwise the host loop's
+            # per-block resample sequence) rides ONE lax.scan launch
+            # per rep. The window build stays inside the timed call:
+            # that host work is part of what a scanned production run
+            # pays, and it is O(S·N·deg) next to the device scan
+            from rcmarl_tpu.config import schedule_window
+
+            state = init_train_state(cfg, jax.random.PRNGKey(0))
+            scan_jit = jax.jit(
+                lambda s, g, cfg=cfg: train_scanned(
+                    cfg, s, args.blocks, graphs=g
+                )
+            )
+
+            def run(s, cfg=cfg, scan_jit=scan_jit):
+                start = int(jax.device_get(s.block))
+                w = schedule_window(cfg, start, args.blocks)
+                return scan_jit(s, w)
         elif shard is None:
             state = init_train_state(cfg, jax.random.PRNGKey(0))
             run = jax.jit(
@@ -1887,10 +1929,12 @@ def cmd_bench(argv) -> int:
 
         try:
             if scheduled:
-                # the host loop has no single lowering to hash; the
-                # steady-state data-graph block program is the honest
-                # cost anchor (train_block_fingerprint lowers it WITH
-                # the (N, degree) graph operand)
+                # both scheduled harnesses anchor to the steady-state
+                # data-graph block program (train_block_fingerprint
+                # lowers it WITH the (N, degree) graph operand) — the
+                # scan is S dispatches of that same block, so the rows
+                # share the fingerprint and differ by sched_harness /
+                # window tags
                 fingerprint = train_block_fingerprint(cfg)
             elif shard is None:
                 # tie the row to the EXACT program being timed (the
@@ -1963,6 +2007,12 @@ def cmd_bench(argv) -> int:
                         "graph_schedule": cfg.graph_schedule,
                         "graph_degree": cfg.resolved_graph_degree,
                         "graph_every": cfg.graph_every,
+                        # host_loop = one dispatch per block (window 1);
+                        # scanned = S blocks per lax.scan launch — the
+                        # tags that keep the two arms sharing a
+                        # cost_fingerprint distinguishable
+                        "sched_harness": harn,
+                        "window": args.blocks if harn == "scanned" else 1,
                     }
                 ),
                 **(
@@ -2056,6 +2106,18 @@ def cmd_profile(argv) -> int:
         "A/B key on",
     )
     p.add_argument(
+        "--window",
+        type=int,
+        default=1,
+        help="stacked-schedule window tag for scheduled configs: the "
+        "number of blocks per lax.scan launch the profiled arm "
+        "represents (config.schedule_window / train_scanned). 1 "
+        "(default) = the host-looped per-block dispatch; >1 tags the "
+        "rows as the scanned-window arm, so micro rows from the two "
+        "harnesses sharing a cost_fingerprint stay distinguishable "
+        "next to graph_every. Ignored on static configs",
+    )
+    p.add_argument(
         "--serve_micro",
         action="store_true",
         help="emit a SERVING micro-breakdown row per (config, env, "
@@ -2118,6 +2180,8 @@ def cmd_profile(argv) -> int:
         raise SystemExit(
             "--pipeline_depth must be >= 0 and --publish_every >= 1"
         )
+    if args.window < 1:
+        raise SystemExit("--window must be >= 1")
 
     import jax
 
@@ -2293,7 +2357,16 @@ def cmd_profile(argv) -> int:
                     else {
                         "graph_schedule": cfg.graph_schedule,
                         "graph_degree": cfg.resolved_graph_degree,
+                        # the WINDOW schedule tags: graph_every (the
+                        # resample cadence) next to the blocks-per-scan
+                        # window length — scanned-window rows (window>1)
+                        # vs host-looped rows (window=1) share a
+                        # cost_fingerprint and differ only here
                         "graph_every": cfg.graph_every,
+                        "window": args.window,
+                        "sched_harness": (
+                            "scanned" if args.window > 1 else "host_loop"
+                        ),
                     }
                 ),
                 "pipeline_depth": cfg.pipeline_depth,

@@ -212,6 +212,43 @@ def scheduled_in_nodes(cfg: "Config", block: int):
     return np.asarray(nodes, dtype=np.int32)
 
 
+def schedule_window(cfg: "Config", start_block: int, n_blocks: int):
+    """The stacked-schedule operand: the ``(S, N, degree)`` int32 block
+    of the scheduled graphs active at blocks ``[start_block,
+    start_block + n_blocks)`` — BITWISE the per-block
+    :func:`scheduled_in_nodes` sequence by construction (it IS that
+    sequence, stacked), which is what lets ``train_scanned`` run S
+    scheduled blocks as one device launch with the window as plain
+    scan data. Every slice passes the same host/device guard rails the
+    host loop applies per block
+    (:func:`rcmarl_tpu.ops.exchange.validate_graph`); resuming
+    mid-sequence is just a different ``start_block`` — the window
+    replays the global schedule bitwise (the hypothesis twins pin
+    both properties, tests/test_sparse_fused.py)."""
+    import numpy as np
+
+    from rcmarl_tpu.ops.exchange import validate_graph
+
+    if n_blocks < 1:
+        raise ValueError(f"n_blocks={n_blocks} must be >= 1 (window length)")
+    if start_block < 0:
+        raise ValueError(f"start_block={start_block} must be >= 0")
+    return np.stack(
+        [
+            np.asarray(
+                validate_graph(
+                    scheduled_in_nodes(cfg, start_block + b),
+                    cfg.n_agents,
+                    degree=cfg.resolved_graph_degree,
+                    H=cfg.H,
+                ),
+                dtype=np.int32,
+            )
+            for b in range(n_blocks)
+        ]
+    )
+
+
 @dataclass(frozen=True)
 class Config:
     """Hyperparameters; defaults mirror reference ``main.py:25-44``.
@@ -337,10 +374,12 @@ class Config:
     # program over the combined (n_in, P_critic + P_tr) pair block
     # (forces the stacked netstack layout; the projection einsum +
     # team head step stay XLA). Bitwise vs the XLA arm across the
-    # sanitize matrix; corrupt_p > 0 plans and time-varying graphs
-    # route back to the XLA reference arm (the former documented in
-    # ops/pallas_consensus.py, the latter rejected here). Gated on the
-    # AUDIT.jsonl bytes_accessed ledger (lint --cost).
+    # sanitize matrix; corrupt_p > 0 plans route back to the XLA
+    # reference arm (documented in ops/pallas_consensus.py).
+    # Time-varying graph schedules run the SPARSE one-kernel epoch:
+    # the scheduled (N, degree) indices ride the kernel as a
+    # scalar-prefetch operand, bitwise vs the sparse_gather XLA arm.
+    # Gated on the AUDIT.jsonl bytes_accessed ledger (lint --cost).
     # 'auto': 3-way measured-crossover choice keyed on (H, n_in,
     # volume) — pallas on TPU from volume >= 256 up, xla vs xla_sort by
     # the CPU-measured selection crossover elsewhere (currently: xla
@@ -664,22 +703,19 @@ class Config:
                 f"{FITSTACK_IMPLS} (the fit-scan Pallas kernel arms)"
             )
         if self.consensus_impl in FUSED_CONSENSUS_IMPLS:
-            # the one-kernel epoch consumes the stacked pair layout and
-            # unrolls a STATIC gather in-kernel; contradictory knobs are
-            # rejected loudly rather than silently overridden
+            # the one-kernel epoch consumes the stacked pair layout;
+            # contradictory knobs are rejected loudly rather than
+            # silently overridden. Time-varying graph schedules are
+            # first-class here: the scheduled (N, degree) indices ride
+            # the kernel as a scalar-prefetch operand (the SPARSE
+            # one-kernel epoch, ops/pallas_consensus.py) — gather
+            # indices stay data, so resampling never recompiles.
             if self.netstack is False:
                 raise ValueError(
                     f"consensus_impl={self.consensus_impl!r} runs phase II "
                     "on the combined (n_in, P_critic + P_tr) pair block; "
                     "netstack=False contradicts it (use True or 'auto' — "
                     "the fused epoch forces the stacked layout)"
-                )
-            if self.graph_schedule != "static":
-                raise ValueError(
-                    f"consensus_impl={self.consensus_impl!r} unrolls the "
-                    "static in_nodes gather inside the kernel; time-varying "
-                    f"graph_schedule={self.graph_schedule!r} is XLA-only "
-                    "(gather indices are traced data there)"
                 )
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(

@@ -78,6 +78,7 @@ _ANCHORS = {
     "learner_block": "rcmarl_tpu/pipeline/trainer.py",
     "aggregation": "rcmarl_tpu/ops/aggregation.py",
     "consensus_exchange": "rcmarl_tpu/ops/exchange.py",
+    "sparse_consensus": "rcmarl_tpu/ops/pallas_consensus.py",
 }
 
 
@@ -876,6 +877,125 @@ def sparse_exchange_cost_rows() -> Tuple[List[dict], List[str], set]:
     return rows, notes, skipped
 
 
+def sparse_consensus_cost_rows() -> Tuple[List[dict], List[str], set]:
+    """The SPARSE one-kernel-epoch ledger: ``sparse_consensus[xla_chain]``
+    vs ``sparse_consensus[pallas_fused]`` — the mega-population fused
+    consensus gate (ISSUE-19), measured at n=:data:`SPARSE_EXCHANGE_N`
+    over the real flat critic+TR consensus block with the scheduled
+    ``(N, graph_degree)`` graph as a TRACED operand.
+
+    Honesty model, same split as :func:`fused_consensus_cost_rows`:
+
+    - the XLA CHAIN arm is MEASURED (``bytes_model:
+      'xla-cost-analysis'``): (1) the ``sparse_gather`` launch that
+      materializes the ``(N, deg, P_total)`` gathered block in HBM and
+      (2) the vmapped sanitize/trim/clip/mean launch that re-reads it,
+      summed — the launch boundary forces the gathered block through
+      HBM exactly as the pre-fusion mega-population path did.
+    - the FUSED arm's FLOPs are the compiled FLOPs of the math twin
+      (the same gather+aggregate arithmetic as ONE XLA program — the
+      kernel's in-register ``dynamic_index_in_dim`` gather adds none),
+      and its bytes are the kernel's exact BlockSpec DMA arithmetic
+      plus the one scalar-prefetch DMA of the schedule block
+      (:func:`rcmarl_tpu.ops.pallas_consensus.sparse_fused_dma_bytes`,
+      ``bytes_model: 'pallas-blockspec-dma'``). The ``(N, deg, P)``
+      gathered block appears in NEITHER term — that is the claim the
+      gate pins.
+
+    Everything lowers from abstract shapes; the 5 MB block never
+    allocates on the lint hot path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from rcmarl_tpu.config import Roles, circulant_in_nodes
+    from rcmarl_tpu.lint.configs import megapop_cfg
+    from rcmarl_tpu.ops.aggregation import resilient_aggregate
+    from rcmarl_tpu.ops.exchange import sparse_gather
+    from rcmarl_tpu.ops.pallas_consensus import sparse_fused_dma_bytes
+    from rcmarl_tpu.parallel.megapop import consensus_block_struct
+    from rcmarl_tpu.utils.profiling import (
+        config_fingerprint,
+        program_fingerprint,
+    )
+
+    rows: List[dict] = []
+    notes: List[str] = []
+    skipped: set = set()
+    n = SPARSE_EXCHANGE_N
+    cfg = megapop_cfg(
+        n_agents=n,
+        agent_roles=(Roles.COOPERATIVE,) * n,
+        in_nodes=circulant_in_nodes(n, 5),
+    )
+    fp = config_fingerprint(cfg)
+    block = consensus_block_struct(cfg)  # (N, P_total), abstract
+    deg = cfg.resolved_graph_degree
+    idx = jax.ShapeDtypeStruct((n, deg), jnp.int32)
+
+    def chain_1(blk, g):
+        return sparse_gather(blk, g)  # materializes (N, deg, P_total)
+
+    def chain_2(gathered):
+        return jax.vmap(
+            lambda v: resilient_aggregate(
+                v, cfg.H, impl="xla", n_agents=n, sanitize=True
+            )
+        )(gathered)
+
+    def math_twin(blk, g):
+        return chain_2(chain_1(blk, g))
+
+    def measure(fn, *args):
+        lowered = jax.jit(fn).lower(*args)
+        compiled = lowered.compile()
+        return _compiled_metrics(compiled), program_fingerprint(lowered)
+
+    m1, _ = measure(chain_1, block, idx)
+    # abstract shapes suffice to lower launch 2 — no execution of the
+    # gather on the lint hot path
+    gathered = jax.eval_shape(chain_1, block, idx)
+    m2, _ = measure(chain_2, gathered)
+    twin, fp_twin = measure(math_twin, block, idx)
+    if m1 is None or m2 is None or twin is None:
+        notes.append(
+            "sparse_consensus: platform exposes no cost/memory "
+            "analysis; the sparse-fused HBM gate is unverifiable here"
+        )
+        skipped.update(
+            {"sparse_consensus[xla_chain]", "sparse_consensus[pallas_fused]"}
+        )
+        return rows, notes, skipped
+    chain = {k: m1[k] + m2[k] for k in m1}
+    chain["peak_bytes"] = (
+        chain["argument_bytes"]
+        + chain["output_bytes"]
+        + chain["temp_bytes"]
+        - chain["alias_bytes"]
+    )
+    row_chain = _row("sparse_consensus[xla_chain]", fp, fp_twin, chain)
+    row_chain["bytes_model"] = "xla-cost-analysis"
+    rows.append(row_chain)
+    p_total = int(block.shape[1])
+    kernel_bytes = sparse_fused_dma_bytes(n, deg, p_total, None)
+    arg_bytes = float(n * p_total * 4 + n * deg * 4)
+    out_bytes = float(n * p_total * 4)
+    fused = {
+        "flops": twin["flops"],
+        "bytes_accessed": kernel_bytes,
+        "argument_bytes": arg_bytes,
+        "output_bytes": out_bytes,
+        "temp_bytes": 0.0,
+        "alias_bytes": 0.0,
+        "peak_bytes": arg_bytes + out_bytes,
+    }
+    row_fused = _row("sparse_consensus[pallas_fused]", fp, fp_twin, fused)
+    row_fused["bytes_model"] = "pallas-blockspec-dma"
+    row_fused["flops_model"] = "math-twin-xla"
+    rows.append(row_fused)
+    return rows, notes, skipped
+
+
 #: The (fused entry, two-launch reference) row pairs the HBM gate
 #: compares: fused bytes_accessed strictly below the reference's at
 #: FLOPs equal within :data:`COST_TOLERANCE`.
@@ -883,6 +1003,7 @@ FUSED_GATE_PAIRS = (
     ("consensus_trunk[pallas_fused]", "consensus_trunk[two_launch]"),
     ("fit_scan[pallas_resident]", "fit_scan[xla_carry]"),
     ("serve_path[pallas_fused]", "serve_path[xla_chain]"),
+    ("sparse_consensus[pallas_fused]", "sparse_consensus[xla_chain]"),
 )
 
 
@@ -1003,10 +1124,11 @@ def cost_rows() -> Tuple[List[dict], List[str], set]:
     frows, fnotes, fskipped = fused_consensus_cost_rows()
     srows, snotes, sskipped = fused_serve_cost_rows()
     xrows, xnotes, xskipped = sparse_exchange_cost_rows()
+    crows, cnotes, cskipped = sparse_consensus_cost_rows()
     return (
-        rows + arows + frows + srows + xrows,
-        notes + anotes + fnotes + snotes + xnotes,
-        skipped | askipped | fskipped | sskipped | xskipped,
+        rows + arows + frows + srows + xrows + crows,
+        notes + anotes + fnotes + snotes + xnotes + cnotes,
+        skipped | askipped | fskipped | sskipped | xskipped | cskipped,
     )
 
 

@@ -207,12 +207,71 @@ def _audit_fitstack_dtypes(
     return findings
 
 
+def _audit_scanned_window(
+    auditor: "RetraceAuditor", steady_blocks: int
+) -> List[Finding]:
+    """The stacked-schedule scan compile-once case: a scheduled config
+    (``graph_every=2``) drives ``train_window_donated`` — S blocks per
+    launch with the ``(S, N, degree)`` window
+    (:func:`rcmarl_tpu.config.schedule_window`) as scan data — across
+    successive windows whose content DIFFERS (each spans a
+    ``graph_every`` resample boundary). One warmup launch compiles; every
+    later window must re-dispatch the SAME executable — the window is
+    data, so crossing a resample boundary may never be a compile.
+    ``train_window_donated`` is deliberately not in the
+    ``jit_entry_points`` registry (its inputs are window-shaped, not the
+    registry's per-config shapes), so its cache is checked by hand, the
+    ``_audit_fitstack_dtypes`` pattern; the registry watchdog still
+    covers the inner ``update_block`` family."""
+    import jax
+
+    from rcmarl_tpu.config import schedule_window
+    from rcmarl_tpu.training.trainer import (
+        init_train_state,
+        train_window_donated,
+    )
+
+    cfg = _tiny_cfg(False, False).replace(
+        graph_schedule="random_geometric", graph_degree=3, graph_every=2
+    )
+    S = 3  # odd window: every window straddles a graph_every boundary
+    state = init_train_state(cfg, jax.random.PRNGKey(0))
+    findings: List[Finding] = []
+    before = int(train_window_donated._cache_size())
+    state, _ = train_window_donated(
+        cfg, state, S, schedule_window(cfg, 0, S)
+    )  # warmup: the one compile
+    with auditor.expect_no_compiles(
+        context="stacked-schedule windows across resample boundaries"
+    ):
+        for w in range(1, steady_blocks + 1):
+            state, _ = train_window_donated(
+                cfg, state, S, schedule_window(cfg, w * S, S)
+            )
+    grew = int(train_window_donated._cache_size()) - before
+    if grew != 1:
+        path, line = _anchor(train_window_donated)
+        findings.append(
+            Finding(
+                "retrace",
+                path,
+                line,
+                f"train_window_donated compiled {grew} program(s) "
+                f"across {steady_blocks + 1} stacked-schedule windows — "
+                "expected exactly ONE (window content is data; a "
+                "resample boundary may never be a compile)",
+            )
+        )
+    return findings
+
+
 def audit_retrace(
     steady_blocks: int = 2,
     fitstack_dtypes: bool = True,
     fused_epoch: bool = True,
     fused_serve: bool = True,
     gala: bool = True,
+    scanned_window: bool = True,
 ) -> List[Finding]:
     """``lint --retrace``: prove exactly-once compilation on tiny runs.
 
@@ -226,7 +285,13 @@ def audit_retrace(
     ``fused_epoch=False`` to shed it to the slow twin / CI cell), a
     time-varying-graph run (per-block resampled
     random-geometric gather indices fed in as data — a resample may
-    never be a compile), a clean run (the donated steady-state entries),
+    never be a compile), the STACKED-SCHEDULE scan (S scheduled blocks
+    per donated ``train_window_donated`` launch with the ``(S, N, deg)``
+    window as scan data — one compile, zero recompiles across window
+    boundaries that straddle a ``graph_every`` resample; gate with
+    ``scanned_window=False`` to shed it —
+    :func:`_audit_scanned_window`), a clean run (the donated
+    steady-state entries),
     the alternating f32/bf16 fused-fit case (exactly one compile per
     compute_dtype, zero steady-state recompiles across alternation —
     :func:`_audit_fitstack_dtypes`), and a Byzantine gossip-replica
@@ -306,6 +371,13 @@ def audit_retrace(
         # always runs it
         auditor.findings.extend(
             _audit_fitstack_dtypes(auditor, steady_blocks)
+        )
+    if scanned_window:
+        # the stacked-schedule scan: S blocks per donated launch, fresh
+        # window data every dispatch — ``scanned_window=False`` sheds it
+        # to the slow twin / CI graftlint cell, the fused_epoch pattern
+        auditor.findings.extend(
+            _audit_scanned_window(auditor, steady_blocks)
         )
     gcfg = tiny_gossip_cfg()
     states, df = train_gossip(gcfg, n_episodes=gcfg.n_ep_fixed)  # warmup round

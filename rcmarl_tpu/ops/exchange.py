@@ -126,6 +126,25 @@ def validate_graph(graph, n_agents: int, degree: int | None = None,
     return np.asarray(g, dtype=np.int32)  # lint: disable=host-sync
 
 
+def validate_graph_window(window, n_agents: int, degree: int | None = None,
+                          H: int | None = None) -> np.ndarray:
+    """:func:`validate_graph` over every slice of an ``(S, N, degree)``
+    stacked-schedule operand (:func:`rcmarl_tpu.config.schedule_window`)
+    — the window-level guard rail ``train_scanned`` applies before the
+    stacked graphs become scan data. Same invariants, applied per
+    block; returns the validated int32 window."""
+    w = np.asarray(window)  # lint: disable=host-sync (host-side guard)
+    if w.ndim != 3:
+        raise ValueError(
+            f"stacked-schedule window must be (S, n_agents, degree); "
+            f"got shape {w.shape}"
+        )
+    return np.stack(
+        [validate_graph(w[b], n_agents, degree=degree, H=H)
+         for b in range(w.shape[0])]
+    )
+
+
 def exchange_cost_model(n_agents: int, degree: int, p_total: int,
                         itemsize: int = 4) -> dict:
     """The analytic byte cost of one sparse exchange, for honest row

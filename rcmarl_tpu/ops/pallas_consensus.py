@@ -39,12 +39,27 @@ because their ``jnp.mean`` epilogue is reassociated freely by XLA's
 fusion pass (measured: the same gathered block means to 1-2 ULP
 different bits in different fusion contexts), exactly the tolerance
 ``tests/test_pallas_aggregation.py`` has always pinned the leaf
-kernel with. Two documented fallbacks to the XLA arm: ``corrupt_p >
+kernel with. One documented fallback to the XLA arm: ``corrupt_p >
 0`` plans (the additive-noise draw's erfinv tail gets FMA-fused into
 whatever consumes it, so its BITS are fusion-context-dependent — and
 the ``(N, n_in, P)`` noise is n_in-fold the block, structurally
-halving the kernel's traffic win anyway) and time-varying (traced)
-communication graphs (the in-kernel gather unrolls static rows).
+halving the kernel's traffic win anyway).
+
+Time-varying (scheduled) communication graphs — the SPARSE one-kernel
+epoch: a traced ``(N, degree)`` gather-index array
+(:func:`rcmarl_tpu.config.scheduled_in_nodes`) rides the kernel as a
+SCALAR-PREFETCH operand (``pltpu.PrefetchScalarGridSpec``) instead of
+being unrolled into the program: the in-kernel gather becomes dynamic
+row selects off the SMEM-resident index block, so per-block graph
+resampling re-dispatches ONE compiled kernel and the ``(N, deg, P)``
+gathered block still never materializes in HBM — the sparse analogue
+of the static win, pinned bitwise against the
+``ops/exchange.py:sparse_gather`` XLA arm across the same matrix
+(tests/test_sparse_fused.py) and carried by the
+``sparse_consensus[xla_chain]`` vs ``[pallas_fused]`` ledger rows
+(:func:`rcmarl_tpu.lint.cost.sparse_consensus_cost_rows`). Scheduled
+graphs are regular by construction, so the sparse path never sees a
+validity mask.
 
 What stays XLA (by design, documented in README "One-kernel epoch"):
 the tiny head-column gather+fault (``P_head = 2(h+1)`` floats per
@@ -68,6 +83,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from rcmarl_tpu.faults import FaultPlan, _link_masks
 from rcmarl_tpu.ops.aggregation import _running_large, _running_small
@@ -296,8 +312,14 @@ def _consensus_kernel(
     """One (N, block_rows, LANES) column tile: in-register gather of
     every agent's neighborhood, the per-link fault chain, and the
     agent's trim/clip/mean epilogue — nothing but the aggregate leaves
-    the tile."""
+    the tile.
+
+    ``in_arr`` is either the STATIC nested index tuples (the gather
+    unrolls compile-time row selects) or None — the SPARSE path, where
+    the leading ref is the scalar-prefetched ``(N, degree)`` int32
+    schedule block and each row select is a dynamic slice off it."""
     it = iter(refs)
+    idx_ref = next(it) if in_arr is None else None
     msgs_ref = next(it)
     stale_ref = next(it) if has_stale else None
     masks_ref = next(it) if plan is not None else None
@@ -323,16 +345,25 @@ def _consensus_kernel(
         )
         tree0 = col < tree_split
 
+    def _row(src, a, k):
+        # static graphs: compile-time row select (unrolled); sparse
+        # graphs: dynamic row select off the prefetched schedule block
+        if in_arr is not None:
+            return src[in_arr[a][k]]
+        return jax.lax.dynamic_index_in_dim(
+            src, idx_ref[a, k], axis=0, keepdims=False
+        )
+
     out_rows = []
     for a in range(n_agents):
         rows = []
         for k in range(n_in):
-            v = blk[in_arr[a][k]]
+            v = _row(blk, a, k)
             if plan is not None:
                 rows.append(
                     _fault_chain(
                         v,
-                        stale_blk[in_arr[a][k]] if has_stale else None,
+                        _row(stale_blk, a, k) if has_stale else None,
                         masks,
                         inf_sign,
                         tree0,
@@ -388,14 +419,21 @@ def fused_pair_consensus(
         (H=0 short-circuits to the plain mean); a traced int32 scalar
         runs the k_max-register dynamic-trim chain (the fused-matrix
         path), fed to the kernel as a scalar input.
-      in_nodes: STATIC padded gather rows (``cfg.padded_in_nodes()[0]``)
-        — the in-kernel gather unrolls these row selects, which is what
-        keeps the gathered block out of HBM. Time-varying (traced)
-        graphs are rejected at Config level.
+      in_nodes: the gather rows, in one of two forms. STATIC nested
+        tuples (``cfg.padded_in_nodes()[0]``) unroll compile-time row
+        selects into the kernel. A TRACED ``(N, degree)`` int32 array
+        (the scheduled time-varying graph,
+        :func:`rcmarl_tpu.config.scheduled_in_nodes`) rides as a
+        SCALAR-PREFETCH operand instead — the SPARSE path: indices are
+        data, each row select is a dynamic slice off the SMEM-resident
+        schedule block, and per-block resampling re-dispatches one
+        compiled kernel. Either way the ``(N, deg, P)`` gathered block
+        never materializes in HBM.
       tree_split: static column index where the TR trunk begins (the
         per-tree fault masks select on it).
       valid: STATIC ``cfg.padded_in_nodes()[1]`` rows (ragged graphs)
-        or None.
+        or None. Must be None on the sparse path (scheduled graphs are
+        regular by construction).
       sanitize: the non-finite-hardened epilogue (bitwise the XLA
         backends' sanitize mode).
       plan / stale / fields: the active FaultPlan with its stale-replay
@@ -407,10 +445,33 @@ def fused_pair_consensus(
     Returns the ``(N, P_trunk)`` post-consensus trunk block.
     """
     N, P = msgs.shape
-    # static host tuples (cfg.padded_in_nodes rows) — kept as-is for the
-    # unrolled in-kernel row selects
-    in_arr = tuple(tuple(row) for row in in_nodes)
-    n_in = len(in_arr[0])
+    sparse = not isinstance(in_nodes, (tuple, list, np.ndarray))
+    if sparse:
+        # traced (N, degree) schedule block — the scalar-prefetch path
+        idx = jnp.asarray(in_nodes, jnp.int32)
+        if idx.ndim != 2 or idx.shape[0] != N:
+            raise ValueError(
+                f"traced in_nodes must be (N={N}, degree) int32 gather "
+                f"rows; got shape {idx.shape}"
+            )
+        if valid is not None:
+            raise ValueError(
+                "a traced (scheduled) graph is regular by construction; "
+                "the sparse kernel path takes no validity mask"
+            )
+        in_arr = None
+        n_in = int(idx.shape[1])
+    else:
+        # static host tuples (cfg.padded_in_nodes rows) — kept as-is for
+        # the unrolled in-kernel row selects
+        idx = None
+        # static host rows by the isinstance gate above — int() here
+        # normalizes np integer scalars, it never touches a traced value
+        in_arr = tuple(
+            tuple(int(v) for v in row)  # lint: disable=host-sync
+            for row in in_nodes
+        )
+        n_in = len(in_arr[0])
     traced_h = not isinstance(H, (int, np.integer))
     if traced_h and valid is not None:
         raise ValueError(
@@ -434,28 +495,32 @@ def fused_pair_consensus(
     v3 = flat.reshape(N, rows_total, _LANES)
     grid = (rows_total // block_rows,)
 
+    # index maps take (*grid, *scalar_refs) under the scalar-prefetch
+    # grid spec — the trailing *_ keeps one set of specs for both paths
     inputs = [v3]
-    in_specs = [pl.BlockSpec((N, block_rows, _LANES), lambda i: (0, i, 0))]
+    in_specs = [
+        pl.BlockSpec((N, block_rows, _LANES), lambda i, *_: (0, i, 0))
+    ]
     if has_stale:
         s3 = _pad_cols(stale.astype(jnp.float32), tile)[0].reshape(
             N, rows_total, _LANES
         )
         inputs.append(s3)
         in_specs.append(
-            pl.BlockSpec((N, block_rows, _LANES), lambda i: (0, i, 0))
+            pl.BlockSpec((N, block_rows, _LANES), lambda i, *_: (0, i, 0))
         )
     if active:
         inputs.append(fields.masks)
         in_specs.append(
-            pl.BlockSpec(fields.masks.shape, lambda i: (0, 0, 0, 0))
+            pl.BlockSpec(fields.masks.shape, lambda i, *_: (0, 0, 0, 0))
         )
         inputs.append(fields.inf_sign)
         in_specs.append(
-            pl.BlockSpec(fields.inf_sign.shape, lambda i: (0, 0, 0))
+            pl.BlockSpec(fields.inf_sign.shape, lambda i, *_: (0, 0, 0))
         )
     if traced_h:
         inputs.append(jnp.asarray(H, jnp.int32).reshape(1, 1))
-        in_specs.append(pl.BlockSpec((1, 1), lambda i: (0, 0)))
+        in_specs.append(pl.BlockSpec((1, 1), lambda i, *_: (0, 0)))
 
     valid_rows = (
         None
@@ -476,14 +541,32 @@ def fused_pair_consensus(
         block_rows=block_rows,
         has_stale=has_stale,
     )
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((N, rows_total, _LANES), jnp.float32),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((N, block_rows, _LANES), lambda i: (0, i, 0)),
-        grid=grid,
-        interpret=interpret,
-    )(*inputs)
+    out_shape = jax.ShapeDtypeStruct((N, rows_total, _LANES), jnp.float32)
+    out_spec = pl.BlockSpec((N, block_rows, _LANES), lambda i, *_: (0, i, 0))
+    if sparse:
+        # the schedule block rides as the scalar-prefetch operand: DMAd
+        # to SMEM once per launch, ahead of the first tile's data DMAs
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_spec,
+        )
+        out = pl.pallas_call(
+            kernel,
+            out_shape=out_shape,
+            grid_spec=grid_spec,
+            interpret=interpret,
+        )(idx, *inputs)
+    else:
+        out = pl.pallas_call(
+            kernel,
+            out_shape=out_shape,
+            in_specs=in_specs,
+            out_specs=out_spec,
+            grid=grid,
+            interpret=interpret,
+        )(*inputs)
     return out.reshape(N, -1)[:, :P]
 
 
@@ -518,7 +601,30 @@ def fused_consensus_dma_bytes(
     return bytes_total
 
 
+def sparse_fused_dma_bytes(
+    n_agents: int,
+    degree: int,
+    n_trunk: int,
+    plan: Optional[FaultPlan],
+    block_rows: int = _DEFAULT_BLOCK_ROWS,
+) -> float:
+    """HBM traffic of the SPARSE (traced-graph) kernel launch: the
+    static kernel's tile DMAs plus ONE ``(N, degree)`` int32
+    scalar-prefetch DMA of the schedule block — prefetched to SMEM
+    ahead of the grid, not re-read per tile. Same deterministic
+    BlockSpec arithmetic, same ``bytes_model: 'pallas-blockspec-dma'``
+    honesty tag; the ``(N, deg, P)`` gathered block the XLA sparse
+    chain materializes never appears in either term."""
+    return (
+        fused_consensus_dma_bytes(
+            n_agents, degree, n_trunk, plan, block_rows
+        )
+        + n_agents * degree * 4.0
+    )
+
+
 # The two-launch/math-twin comparison programs behind the
-# ``consensus_trunk`` ledger rows live with the audit that compiles
-# them (:func:`rcmarl_tpu.lint.cost.consensus_cost_programs`) — this
+# ``consensus_trunk`` / ``sparse_consensus`` ledger rows live with the
+# audit that compiles them (:func:`rcmarl_tpu.lint.cost
+# .consensus_cost_programs` / ``sparse_consensus_cost_rows``) — this
 # module only owns the deterministic DMA arithmetic above.

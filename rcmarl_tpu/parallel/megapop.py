@@ -65,10 +65,31 @@ def _megapop_consensus_block(cfg: Config, block, graph):
     sanitized own-anchored trim/clip/mean per agent — elementwise
     exclusion of non-finite payloads with the degree-deficit fallback,
     exactly the solo path's hardening.
+
+    Two arms per ``cfg.consensus_impl``: the XLA sparse chain (the
+    default — materializes the ``(N, deg, P_total)`` gathered block),
+    or the SPARSE one-kernel arm for the fused impls
+    (:func:`rcmarl_tpu.ops.pallas_consensus.fused_pair_consensus` with
+    the graph as a scalar-prefetch operand — the gathered block never
+    reaches HBM), pinned bitwise against each other in
+    tests/test_sparse_fused.py and cost-gated by the
+    ``sparse_consensus`` AUDIT.jsonl rows.
     """
+    from rcmarl_tpu.config import FUSED_CONSENSUS_IMPLS
     from rcmarl_tpu.ops.aggregation import resilient_aggregate
     from rcmarl_tpu.ops.exchange import sparse_gather
 
+    if cfg.consensus_impl in FUSED_CONSENSUS_IMPLS:
+        from rcmarl_tpu.ops.pallas_consensus import fused_pair_consensus
+
+        return fused_pair_consensus(
+            block,
+            cfg.H,
+            in_nodes=graph,
+            tree_split=int(block.shape[1]),  # one payload family: all tree-0
+            sanitize=True,
+            interpret=cfg.consensus_impl == "pallas_fused_interpret",
+        )
     gathered = sparse_gather(block, graph)  # (N, deg, P_total)
     return jax.vmap(
         lambda v: resilient_aggregate(

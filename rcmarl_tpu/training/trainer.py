@@ -158,26 +158,91 @@ train_block_donated = jax.jit(
 
 
 def train_scanned(
-    cfg: Config, state: TrainState, n_blocks: int, spec=None
+    cfg: Config, state: TrainState, n_blocks: int, spec=None, graphs=None
 ) -> Tuple[TrainState, EpisodeMetrics]:
     """``n_blocks`` blocks as one ``lax.scan`` — zero host round-trips.
 
     Returned metrics leaves have shape (n_blocks * n_ep_fixed,) == one row
     per episode, flattened in episode order.
+
+    ``graphs`` is the STACKED-SCHEDULE operand for time-varying
+    ``graph_schedule`` configs: the ``(n_blocks, N, degree)`` int32
+    window of per-block gather indices
+    (:func:`rcmarl_tpu.config.schedule_window` — bitwise the host
+    loop's ``scheduled_in_nodes`` sequence by construction), consumed
+    as plain scan data so S scheduled blocks run as ONE launch instead
+    of S host dispatches. The window is host data the device scan
+    cannot regenerate, so scheduled configs must pass it; static
+    configs must not (a silently ignored window would be a schedule
+    bug). Concrete host-side validation (shape / self-first / range /
+    duplicates / 2H+1, per block) runs here exactly when the operand
+    is concrete; traced operands — inside a caller's jit, e.g. the
+    donated window entry — were validated where they were built.
     """
 
     if cfg.graph_schedule != "static":
+        if graphs is None:
+            raise ValueError(
+                "train_scanned needs the stacked-schedule window for a "
+                "time-varying graph_schedule: the per-block resample is "
+                "host-side data the device scan cannot regenerate — "
+                "pass graphs=schedule_window(cfg, start_block, n_blocks)"
+            )
+    elif graphs is not None:
         raise ValueError(
-            "train_scanned cannot run a time-varying graph_schedule: "
-            "the per-block resample is host-side data the device scan "
-            "cannot regenerate — use train() (the host loop)"
+            "graphs is the time-varying stacked-schedule operand; "
+            "graph_schedule='static' compiles its topology into the "
+            "program and would silently ignore it"
         )
 
-    def body(s, _):
-        return train_block(cfg, s, spec)
+    if graphs is not None:
+        if isinstance(graphs, np.ndarray):
+            from rcmarl_tpu.ops.exchange import validate_graph_window
 
-    state, metrics = jax.lax.scan(body, state, None, length=n_blocks)
+            graphs = validate_graph_window(
+                graphs, cfg.n_agents, degree=cfg.resolved_graph_degree,
+                H=cfg.H,
+            )
+        graphs = jnp.asarray(graphs, jnp.int32)
+        if graphs.shape[0] != n_blocks:
+            raise ValueError(
+                f"stacked-schedule window covers {graphs.shape[0]} "
+                f"blocks but the scan runs n_blocks={n_blocks}"
+            )
+
+        def body(s, g):
+            return train_block(cfg, s, spec, graph=g)
+
+        state, metrics = jax.lax.scan(body, state, graphs)
+    else:
+
+        def body(s, _):
+            return train_block(cfg, s, spec)
+
+        state, metrics = jax.lax.scan(body, state, None, length=n_blocks)
     return state, jax.tree.map(lambda x: x.reshape(-1), metrics)
+
+
+def _train_window(cfg: Config, state: TrainState, n_blocks: int, graphs,
+                  spec=None):
+    return train_scanned(cfg, state, n_blocks, spec=spec, graphs=graphs)
+
+
+#: The scheduled-config scan as ONE DONATED device launch:
+#: ``train_window_donated(cfg, state, S, graphs)`` runs S scheduled
+#: blocks per dispatch with the ``(S, N, degree)`` stacked-schedule
+#: window as scan data and the carried ``state`` donated (XLA reuses
+#: the params/moments/replay buffers across the launch — the
+#: steady-state driver for scheduled/sparse configs, replacing S
+#: host-looped dispatches). Successive windows re-dispatch the SAME
+#: executable — window content is data, shapes are fixed by
+#: (n_agents, degree, S) — proven by the ``lint --retrace``
+#: scanned-window case. The passed ``state`` is consumed.
+train_window_donated = jax.jit(
+    _train_window,
+    static_argnums=(0, 2),
+    donate_argnums=(1,),
+)
 
 
 def metrics_to_dataframe(metrics: EpisodeMetrics):

@@ -129,8 +129,9 @@ def consensus_fused_impl(cfg: Config) -> "str | None":
     fusion-context-dependent (the erfinv tail FMA-fuses into whatever
     consumes it) and the ``(N, n_in, P)`` noise is n_in-fold the block,
     so the kernel's traffic win is structurally halved there anyway
-    (ops/pallas_consensus.py). Time-varying graphs never reach here
-    (Config rejects them with the fused impls).
+    (ops/pallas_consensus.py). Time-varying graphs are first-class:
+    the scheduled ``(N, degree)`` indices ride the kernel as a
+    scalar-prefetch operand (the SPARSE one-kernel epoch).
     """
     if cfg.consensus_impl not in FUSED_CONSENSUS_IMPLS:
         return None
@@ -379,7 +380,8 @@ def _fit_block(cfg: Config, carry, batch: Batch, r_coop, ekey,
 fit_block = partial(jax.jit, static_argnums=0)(_fit_block)
 
 
-def _consensus_block(cfg: Config, carry, batch: Batch, ekey: jax.Array):
+def _consensus_block(cfg: Config, carry, batch: Batch, ekey: jax.Array,
+                     graph=None):
     """The phase-II consensus as a standalone jitted program on the
     stacked pair layout: the carry nets double as the transmitted
     messages AND the stale-replay source (message content never changes
@@ -389,11 +391,15 @@ def _consensus_block(cfg: Config, carry, batch: Batch, ekey: jax.Array):
     :func:`_pair_phase2` the epoch inlines; registered in
     ``utils/profiling.py:jit_entry_points`` so the lint cost/retrace
     audits and ``profile --consensus_micro`` drive the fused phase II
-    standalone (the one-kernel analogue of :data:`fit_block`)."""
+    standalone (the one-kernel analogue of :data:`fit_block`).
+    ``graph`` (optional traced ``(N, degree)`` int32) drives the
+    scheduled sparse exchange — the sparse one-kernel arm or the
+    ``sparse_gather`` XLA arm, per the resolved impl."""
     critic, tr, _ = carry
     x2 = netstack_pair_inputs(cfg, batch.s, batch.sa)
     cons_c, cons_t, _ = _pair_phase2(
-        cfg, critic, tr, critic, tr, critic, tr, x2, batch.mask, ekey
+        cfg, critic, tr, critic, tr, critic, tr, x2, batch.mask, ekey,
+        graph=graph,
     )
     return cons_c, cons_t
 
@@ -747,7 +753,7 @@ def _pair_phase2(
             )
         return nbr
 
-    if fused is not None and graph is None:
+    if fused is not None:
         from rcmarl_tpu.ops.pallas_consensus import (
             draw_fault_fields,
             fused_pair_consensus,
@@ -757,12 +763,22 @@ def _pair_phase2(
         segs = _pair_segments(msg_c, msg_t)
         n_trunk, split = _pair_trunk_split(segs)
         pair = _pair_block(msg_c, msg_t)
-        in_pad, _ = cfg.padded_in_nodes()
+        if graph is None:
+            in_src, _ = cfg.padded_in_nodes()
+            n_link = cfg.n_in
+        else:
+            # the SPARSE one-kernel epoch: the scheduled (N, degree)
+            # indices ride the kernel as a scalar-prefetch operand;
+            # the fault draw's link axis is the scheduled degree —
+            # exactly the gathered width apply_link_faults_flat draws
+            # on in the XLA sparse arm, so the arms stay bitwise
+            in_src = graph
+            n_link = cfg.resolved_graph_degree
         fkey = fields = stale_pair = None
         if active:
             fkey = jax.random.fold_in(ekey, _FAULT_STREAM)
             fields = draw_fault_fields(
-                fkey, plan, cfg.n_agents, cfg.n_in, segs
+                fkey, plan, cfg.n_agents, n_link, segs
             )
             if float(plan.stale_p) > 0.0:
                 stale_pair = _pair_block(carry_c, carry_t)
@@ -772,7 +788,7 @@ def _pair_phase2(
             agg = fused_pair_consensus(
                 pair[:, :n_trunk],
                 H_k,
-                in_nodes=in_pad,
+                in_nodes=in_src,
                 tree_split=split,
                 valid=valid_pad,
                 sanitize=cfg.consensus_sanitize,
@@ -781,12 +797,14 @@ def _pair_phase2(
                 fields=fields,
                 interpret=fused == "pallas_fused_interpret",
             )
-        head = gather_neighbor_messages(cfg, pair[:, n_trunk:])
+        head = gather_neighbor_messages(cfg, pair[:, n_trunk:], graph)
         if active:
             stale_head = (
                 head
                 if stale_pair is None
-                else gather_neighbor_messages(cfg, stale_pair[:, n_trunk:])
+                else gather_neighbor_messages(
+                    cfg, stale_pair[:, n_trunk:], graph
+                )
             )
             head = apply_link_faults_flat(
                 fkey, head, stale_head, plan, head_segments(segs, n_trunk)

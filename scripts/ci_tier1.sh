@@ -24,7 +24,14 @@ set -o pipefail
 rm -f /tmp/_t1.log
 set +e
 t1_start=$(date +%s)
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+# RCMARL_TEST_CACHE=1 turns on the persistent JAX compilation cache for
+# the suite (tests/conftest.py): cold runs pay the same compiles they
+# always did; reruns on a warm runner get them back from disk. The
+# conftest prints an "RCMARL_CACHE hits=... misses=..." tally at session
+# end, folded into the wall-budget line below so cache effectiveness is
+# visible next to the number it is supposed to shrink.
+timeout -k 10 870 env JAX_PLATFORMS=cpu RCMARL_TEST_CACHE=1 \
+    python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
@@ -35,7 +42,10 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -c
 # being discovered by timeout: warn loudly past 90% — a PR pushing the
 # suite over that line should move cells to the slow marker / CI cells
 # (the PR-8/PR-9 pattern) BEFORE the budget kills the whole gate.
-echo "tier-1 wall budget: ${t1_secs}s / 870s ($(( t1_secs * 100 / 870 ))%)"
+t1_cache=$(grep -ao 'RCMARL_CACHE hits=[0-9]* misses=[0-9]*' /tmp/_t1.log \
+    | tail -1 | sed 's/RCMARL_CACHE //')
+echo "tier-1 wall budget: ${t1_secs}s / 870s ($(( t1_secs * 100 / 870 ))%," \
+     "compile cache ${t1_cache:-unavailable})"
 if [ "$t1_secs" -gt 783 ]; then
     echo "WARNING: tier-1 suite consumed >90% of the 870s wall budget" \
          "(${t1_secs}s); shed load to the slow marker before it times out" >&2
@@ -520,6 +530,44 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rcmarl_tpu train \
     --n_episodes 4 --n_ep_fixed 2 --max_ep_len 4 --n_epochs 1 \
     --summary_dir "$smoke_dir" --quiet
 echo "mega-population sparse smoke cell OK"
+
+# Sparse-fused smoke cell (round 19): the scheduled-graph fused Pallas
+# phase II at mega-population scale — an n=256 degree-9 random-
+# geometric schedule, resampled every block, under a drop+NaN transport
+# plan with sanitize on the stacked critic+TR path, trained on BOTH
+# consensus arms (XLA sparse_gather chain vs pallas_fused_interpret
+# with the schedule as a traced scalar-prefetch operand) from the same
+# init — the params must come out BITWISE identical. This is the
+# acceptance wire-up of the round-19 tentpole (Config -> trainer ->
+# scheduled fused kernel -> tail) at the scale the pytest suite cannot
+# afford (the interpret-mode kernel alone is ~5 min at n=256; the
+# n<=8 twins ride tier-1 in tests/test_sparse_fused.py, the wider
+# sanitize matrix rides the slow marker). The fused arm's cost side is
+# gated separately by the AUDIT.jsonl sparse_consensus rows.
+timeout -k 10 720 env JAX_PLATFORMS=cpu python - <<'PY'
+import numpy as np, jax
+from rcmarl_tpu.config import Config, Roles, circulant_in_nodes
+from rcmarl_tpu.faults import FaultPlan
+from rcmarl_tpu.training.trainer import train
+
+N = 256
+kw = dict(
+    n_agents=N, agent_roles=(Roles.COOPERATIVE,) * N,
+    in_nodes=circulant_in_nodes(N, 5),
+    nrow=16, ncol=16, hidden=(4,),
+    graph_schedule="random_geometric", graph_degree=9, graph_every=1,
+    fit_clip=1.0, H=1,
+    n_episodes=4, n_ep_fixed=2, max_ep_len=4, n_epochs=1,
+    netstack=True, consensus_sanitize=True,
+    fault_plan=FaultPlan(drop_p=0.2, nan_p=0.2, seed=7),
+)
+s_x, _ = train(Config(**kw, consensus_impl="xla"))
+s_f, _ = train(Config(**kw, consensus_impl="pallas_fused_interpret"))
+for a, b in zip(jax.tree.leaves(s_x.params), jax.tree.leaves(s_f.params)):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+print("sparse-fused n=256 bitwise pin OK (scheduled deg-9, faulted+sanitize)")
+PY
+echo "sparse-fused smoke cell OK"
 
 # Chaos smoke cell: a representative slice of the chaos campaign
 # through the real CLI, gated against the committed RESILIENCE.jsonl —

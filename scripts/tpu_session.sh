@@ -260,6 +260,27 @@ run_step "14b. sparse-vs-dense consensus micro (n256, on-chip)" \
     --configs n256_ring n256_sparse \
     --consensus_micro --out PERF.jsonl
 
+# The sparse one-kernel epoch (PR 19): the committed scheduled-graph
+# fused rows are interpret-mode (headline:false) and the AUDIT.jsonl
+# sparse_consensus bytes gate is the BlockSpec DMA model — this is the
+# REAL-LOWERING refit: (15) the sparse-fused vs XLA-sparse consensus
+# A/B at n=256 on both schedule harnesses (the host-looped reference
+# and the round-19 stacked-schedule scan; rows tagged sched_harness/
+# window so the two-axis win — kernel fusion x launch amortisation —
+# separates in the ledger), and (15b) the scanned-window n=1024 row,
+# the scale where per-block host dispatch dominated the CPU numbers.
+run_step "15. sparse-fused refit (scheduled fused vs XLA, both harnesses)" \
+    timeout 5400 python -m rcmarl_tpu bench \
+    --configs n256_sparse \
+    --impl xla pallas_fused --sched_harness both \
+    --n_ep_fixed 2 --blocks 3 --reps 3 --out BENCH_SCALING.jsonl
+
+run_step "15b. scanned-window n1024 row (S blocks per launch, on-chip)" \
+    timeout 5400 python -m rcmarl_tpu bench \
+    --configs n1024_sparse \
+    --impl xla pallas_fused --sched_harness scanned \
+    --n_ep_fixed 2 --blocks 3 --reps 3 --out PERF.jsonl
+
 echo "== session summary =="
 rc=0
 for name in "${step_order[@]}"; do

@@ -197,9 +197,7 @@ class TestBlockLevelPins:
         Pallas program) to kernel tolerance — the fused kernel itself
         is only allclose to the XLA arm in this fusion context, so the
         pin is allclose, not bitwise (the bitwise sparse-vs-dense pins
-        live on the XLA arms above). The fused config refuses a
-        time-varying schedule loudly (its gather is program structure,
-        not data)."""
+        live on the XLA arms above)."""
         cfg_f = static_cfg(
             netstack=True, consensus_impl="pallas_fused_interpret"
         )
@@ -213,8 +211,33 @@ class TestBlockLevelPins:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
             )
-        with pytest.raises(ValueError, match="time-varying"):
-            sched_cfg(consensus_impl="pallas_fused_interpret")
+
+    @pytest.mark.slow
+    def test_sparse_fused_block_bitwise_vs_xla_arm(self):
+        """The SPARSE one-kernel epoch at block level: the scheduled
+        config on the fused impl (graph as a scalar-prefetch operand,
+        in-register gather) must match the scheduled XLA arm
+        (sparse_gather chain) BITWISE, leaf-for-leaf, on the same
+        traced graph under the sanitize contract — the ISSUE-19 lift
+        of the old time-varying rejection. (Sanitize-off cells keep
+        the kernel's historical PLAIN allclose contract — the
+        ``jnp.mean`` epilogue's bits are fusion-context-dependent,
+        tests/test_fused_epoch.py.)"""
+        kw = dict(
+            netstack=True,
+            consensus_sanitize=True,
+            fault_plan=FaultPlan(nan_p=0.3, drop_p=0.2, seed=11),
+        )
+        cfg_x = sched_cfg(**kw)
+        cfg_p = sched_cfg(consensus_impl="pallas_fused_interpret", **kw)
+        state = init_train_state(cfg_x, jax.random.PRNGKey(0))
+        out_x, m_x = train_block(cfg_x, state, graph=CIRC)
+        out_p, m_p = train_block(cfg_p, state, graph=CIRC)
+        assert_trees_equal(out_p.params, out_x.params)
+        np.testing.assert_array_equal(
+            np.asarray(m_p.true_team_returns),
+            np.asarray(m_x.true_team_returns),
+        )
 
     @pytest.mark.slow
     def test_scheduled_host_loop_trains_finite(self):

@@ -475,13 +475,17 @@ class TestConfigSurface:
         with pytest.raises(ValueError, match="netstack"):
             Config(consensus_impl="pallas_fused", netstack=False)
 
-    def test_fused_rejects_time_varying_graph(self):
-        with pytest.raises(ValueError, match="graph_schedule"):
-            Config(
-                consensus_impl="pallas_fused",
-                graph_schedule="random_geometric",
-                graph_degree=3,
-            )
+    def test_fused_accepts_time_varying_graph(self):
+        """Lifted PR-13 rejection: time-varying schedules now ride the
+        SPARSE one-kernel epoch (the graph is a scalar-prefetch
+        operand), so the config surface accepts the combination."""
+        cfg = Config(
+            consensus_impl="pallas_fused",
+            graph_schedule="random_geometric",
+            graph_degree=3,
+        )
+        assert cfg.consensus_impl == "pallas_fused"
+        assert cfg.graph_schedule == "random_geometric"
 
     def test_fitstack_kernel_values_accepted(self):
         for v in ("pallas", "pallas_interpret"):

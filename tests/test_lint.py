@@ -149,7 +149,7 @@ class TestRetraceAuditor:
 
         findings = audit_retrace(
             fitstack_dtypes=False, fused_epoch=False, fused_serve=False,
-            gala=False,
+            gala=False, scanned_window=False,
         )
         assert findings == [], "\n".join(str(f) for f in findings)
 

@@ -1,0 +1,419 @@
+"""Sparse one-kernel epoch (ISSUE 19): the fused consensus kernel with
+the scheduled graph as a TRACED scalar-prefetch operand, and the
+stacked-schedule multi-block scan.
+
+Four contracts:
+
+1. **Kernel sanitize-matrix parity** — the sparse fused kernel
+   (``fused_pair_consensus`` with an ``(N, deg)`` int32 graph, gather
+   via in-register dynamic row selects) is pinned leaf-for-leaf BITWISE
+   against the XLA sparse chain (``sparse_gather`` ->
+   ``apply_link_faults_flat`` -> vmapped ``resilient_aggregate``)
+   across {clean, faulted} x {H=0, H>0, traced H} x sanitize — except
+   the PLAIN cells (sanitize off), which keep the kernel's historical
+   allclose-at-f32 contract (the ``jnp.mean`` epilogue's bits are
+   XLA-fusion-context-dependent — tests/test_fused_epoch.py).
+2. **Stacked-schedule operand** — ``schedule_window(cfg, start, S)``
+   slices are BITWISE the per-block ``scheduled_in_nodes`` sequence for
+   arbitrary ``graph_every``/seed/offset, and a mid-window resume
+   replays the tail bitwise (``window(start+k, S-k) ==
+   window(start, S)[k:]``) — deterministic sweep always; hypothesis
+   fuzz twin when the optional dep exists.
+3. **Scanned window == host loop** — ``train_scanned`` over a
+   ``schedule_window`` operand is bitwise the S host-looped
+   ``train_block(..., graph=w[b])`` dispatches, and the donated
+   windowed entry (``train_window_donated``) matches too.
+4. **Mega-population fused arm** — ``megapop_consensus_block`` on a
+   fused impl (kernel, sanitized) is bitwise its XLA sparse arm.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from functools import partial
+
+from rcmarl_tpu.config import (
+    Config,
+    circulant_in_nodes,
+    schedule_window,
+    scheduled_in_nodes,
+)
+from rcmarl_tpu.faults import FaultPlan, apply_link_faults_flat
+from rcmarl_tpu.ops.aggregation import resilient_aggregate
+from rcmarl_tpu.ops.exchange import sparse_gather, validate_graph
+from rcmarl_tpu.ops.pallas_consensus import (
+    draw_fault_fields,
+    fused_pair_consensus,
+)
+
+N = 4
+DEG = 3
+P = 260
+SPLIT = 130
+#: fake 2-segment layout: critic columns then TR columns
+SEGS = ((0, 0, 0, SPLIT), (1, 0, SPLIT, P - SPLIT))
+PLAN = FaultPlan(drop_p=0.3, nan_p=0.2, stale_p=0.2, flip_p=0.2, inf_p=0.2)
+GRAPH = jnp.asarray(
+    [[0, 1, 2], [1, 3, 0], [2, 0, 3], [3, 2, 1]], jnp.int32
+)
+
+
+def _msgs(seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), (N, P), jnp.float32)
+
+
+def _arms(H, sanitize, faulted):
+    """(xla_chain, fused) closures over (msgs, graph) — the two arms of
+    the ``sparse_consensus`` ledger pair at test scale."""
+    carry = _msgs(7)
+    fkey = jax.random.PRNGKey(3)
+
+    def xla_arm(msgs, graph):
+        nbr = sparse_gather(msgs, graph)
+        if faulted:
+            stale = sparse_gather(carry, graph)
+            nbr = apply_link_faults_flat(fkey, nbr, stale, PLAN, SEGS)
+        return jax.vmap(
+            lambda v: resilient_aggregate(v, H, "xla", sanitize=sanitize)
+        )(nbr)
+
+    def fused_arm(msgs, graph):
+        fields = (
+            draw_fault_fields(fkey, PLAN, N, DEG, SEGS) if faulted else None
+        )
+        return fused_pair_consensus(
+            msgs,
+            H,
+            in_nodes=graph,
+            tree_split=SPLIT,
+            sanitize=sanitize,
+            plan=PLAN if faulted else None,
+            stale=carry if faulted else None,
+            fields=fields,
+            interpret=True,
+        )
+
+    return jax.jit(xla_arm), jax.jit(fused_arm)
+
+
+def _assert_bitwise(a, b):
+    np.testing.assert_array_equal(
+        np.asarray(a).view(np.uint32), np.asarray(b).view(np.uint32)
+    )
+
+
+class TestSparseKernelMatrix:
+    def test_sanitize_clean_bitwise(self):
+        """The fast tier-1 representative: sanitized clean cell, H=1."""
+        xla, fused = _arms(1, True, False)
+        _assert_bitwise(xla(_msgs(), GRAPH), fused(_msgs(), GRAPH))
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("H", [0, 1])
+    @pytest.mark.parametrize("faulted", [False, True])
+    def test_sanitize_matrix_bitwise(self, H, faulted):
+        xla, fused = _arms(H, True, faulted)
+        _assert_bitwise(xla(_msgs(), GRAPH), fused(_msgs(), GRAPH))
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("faulted", [False, True])
+    def test_faulted_unsanitized_bitwise(self, faulted):
+        """Sanitize-off FAULTED cells stay bitwise: the fault chain is
+        threshold compares + selects, no reassociable reduction."""
+        if not faulted:
+            pytest.skip("clean plain cells are the allclose contract")
+        xla, fused = _arms(1, False, True)
+        _assert_bitwise(xla(_msgs(), GRAPH), fused(_msgs(), GRAPH))
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("H", [0, 1])
+    def test_plain_cells_allclose(self, H):
+        """The sanitize-off clean contract is the kernel's historical
+        PLAIN one: allclose at f32 rounding, never bitwise-required."""
+        xla, fused = _arms(H, False, False)
+        np.testing.assert_allclose(
+            np.asarray(xla(_msgs(), GRAPH)),
+            np.asarray(fused(_msgs(), GRAPH)),
+            atol=1e-6,
+        )
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("sanitize", [False, True])
+    def test_traced_h_bitwise(self, sanitize):
+        xla, fused = _arms(jnp.asarray(1, jnp.int32), sanitize, True)
+        _assert_bitwise(xla(_msgs(), GRAPH), fused(_msgs(), GRAPH))
+
+    @pytest.mark.slow
+    def test_resample_is_data_not_program(self):
+        """A fresh graph re-dispatches the SAME compiled sparse kernel
+        (scalar-prefetch operand = data) and stays bitwise."""
+        xla, fused = _arms(1, True, True)
+        g2 = jnp.asarray(
+            [[0, 2, 3], [1, 0, 2], [2, 3, 1], [3, 1, 0]], jnp.int32
+        )
+        fused(_msgs(), GRAPH)
+        _assert_bitwise(xla(_msgs(), g2), fused(_msgs(), g2))
+        assert int(fused._cache_size()) == 1
+
+    def test_sparse_rejects_validity_mask(self):
+        """Scheduled graphs are regular by construction — a validity
+        mask on the sparse path is a caller bug, rejected loudly."""
+        with pytest.raises(ValueError, match="valid"):
+            fused_pair_consensus(
+                _msgs(),
+                1,
+                in_nodes=GRAPH,
+                tree_split=SPLIT,
+                valid=((True,) * DEG,) * N,
+                interpret=True,
+            )
+
+
+# --------------------------------------------------------------------------
+# The stacked-schedule operand
+# --------------------------------------------------------------------------
+
+
+def _sched_cfg(graph_every=2, seed=0, n=8, degree=3, **kw):
+    base = dict(
+        n_agents=n,
+        agent_roles=(0,) * n,
+        in_nodes=circulant_in_nodes(n, degree),
+        H=1,
+        graph_schedule="random_geometric",
+        graph_degree=degree,
+        graph_every=graph_every,
+        graph_seed=seed,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def _check_window_matches_blocks(graph_every, seed, start, S):
+    cfg = _sched_cfg(graph_every=graph_every, seed=seed)
+    w = schedule_window(cfg, start, S)
+    assert w.shape == (S, cfg.n_agents, DEG) and w.dtype == np.int32
+    for b in range(S):
+        np.testing.assert_array_equal(
+            w[b],
+            np.asarray(
+                validate_graph(
+                    scheduled_in_nodes(cfg, start + b), cfg.n_agents
+                )
+            ),
+        )
+
+
+def _check_mid_window_resume(graph_every, seed, start, S, k):
+    cfg = _sched_cfg(graph_every=graph_every, seed=seed)
+    full = schedule_window(cfg, start, S)
+    tail = schedule_window(cfg, start + k, S - k)
+    np.testing.assert_array_equal(full[k:], tail)
+
+
+class TestScheduleWindow:
+    def test_window_matches_per_block_sequence(self):
+        for graph_every in (1, 2, 3):
+            for seed in (0, 7):
+                for start in (0, 1, 5):
+                    _check_window_matches_blocks(graph_every, seed, start, 4)
+
+    def test_mid_window_resume_bitwise(self):
+        """Resuming at block ``start+k`` replays the remaining window
+        bitwise — a checkpoint mid-window loses nothing."""
+        for graph_every in (1, 2, 3):
+            for k in (1, 2, 3):
+                _check_mid_window_resume(2, 11, 3, 4, k)
+                _check_mid_window_resume(graph_every, 5, 0, 4, k)
+
+    def test_window_spans_resample_boundary(self):
+        """graph_every=2, S=4 from an odd start: the window must change
+        content exactly at the resample boundaries."""
+        cfg = _sched_cfg(graph_every=2, seed=3)
+        w = schedule_window(cfg, 1, 4)  # blocks 1,2,3,4 -> rounds 0,1,1,2
+        assert (w[1] == w[2]).all()  # same round
+        assert not (w[0] == w[1]).all()  # round 0 -> 1
+        assert not (w[2] == w[3]).all()  # round 1 -> 2
+
+    def test_window_rejections(self):
+        cfg = _sched_cfg()
+        with pytest.raises(ValueError):
+            schedule_window(cfg, 0, 0)
+        with pytest.raises(ValueError):
+            schedule_window(cfg, -1, 2)
+
+    def test_train_scanned_rejections(self):
+        from rcmarl_tpu.lint.configs import tiny_cfg
+        from rcmarl_tpu.training.trainer import (
+            init_train_state,
+            train_scanned,
+        )
+
+        scfg = tiny_cfg().replace(
+            graph_schedule="random_geometric", graph_degree=3
+        )
+        state = init_train_state(scfg, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="schedule_window"):
+            train_scanned(scfg, state, 2)
+        stat = tiny_cfg()
+        sstate = init_train_state(stat, jax.random.PRNGKey(0))
+        w = schedule_window(scfg, 0, 2)
+        with pytest.raises(ValueError, match="static"):
+            train_scanned(stat, sstate, 2, graphs=w)
+        with pytest.raises(ValueError, match="n_blocks"):
+            train_scanned(scfg, state, 3, graphs=w)
+
+
+try:  # the fuzzing twins, when the optional dep exists
+    from hypothesis import given, settings, strategies as st
+
+    @given(
+        st.integers(min_value=1, max_value=4),  # graph_every
+        st.integers(min_value=0, max_value=2**20),  # graph_seed
+        st.integers(min_value=0, max_value=17),  # start block
+        st.integers(min_value=1, max_value=5),  # window length
+    )
+    @settings(max_examples=25, deadline=None)
+    def test_schedule_window_fuzzed(graph_every, seed, start, S):
+        _check_window_matches_blocks(graph_every, seed, start, S)
+
+    @given(
+        st.integers(min_value=1, max_value=4),  # graph_every
+        st.integers(min_value=0, max_value=2**20),  # graph_seed
+        st.integers(min_value=0, max_value=9),  # start block
+        st.integers(min_value=2, max_value=5),  # window length
+        st.data(),
+    )
+    @settings(max_examples=25, deadline=None)
+    def test_mid_window_resume_fuzzed(graph_every, seed, start, S, data):
+        k = data.draw(st.integers(min_value=1, max_value=S - 1))
+        _check_mid_window_resume(graph_every, seed, start, S, k)
+
+except ImportError:  # pragma: no cover - hypothesis not installed
+    pass
+
+
+# --------------------------------------------------------------------------
+# Scanned window vs host loop
+# --------------------------------------------------------------------------
+
+
+def _tiny_train_cfg(**kw):
+    base = dict(
+        n_agents=6,
+        agent_roles=(0,) * 6,
+        in_nodes=circulant_in_nodes(6, 3),
+        nrow=3,
+        ncol=3,
+        n_episodes=2,
+        max_ep_len=4,
+        n_ep_fixed=2,
+        n_epochs=1,
+        buffer_size=16,
+        coop_fit_steps=2,
+        adv_fit_epochs=1,
+        adv_fit_batch=4,
+        batch_size=4,
+        H=1,
+        graph_schedule="random_geometric",
+        graph_degree=3,
+        graph_every=2,
+        consensus_sanitize=True,
+        fault_plan=FaultPlan(nan_p=0.2, drop_p=0.2, seed=5),
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def _leaves_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.slow
+class TestScannedWindow:
+    S = 3  # odd window over graph_every=2: straddles a resample
+
+    def test_scanned_bitwise_vs_host_loop(self):
+        from rcmarl_tpu.training.trainer import (
+            init_train_state,
+            train_block,
+            train_scanned,
+        )
+
+        cfg = _tiny_train_cfg()
+        w = schedule_window(cfg, 0, self.S)
+        state_h = init_train_state(cfg, jax.random.PRNGKey(0))
+        state_s = init_train_state(cfg, jax.random.PRNGKey(0))
+        for b in range(self.S):
+            state_h, _ = train_block(cfg, state_h, graph=jnp.asarray(w[b]))
+        state_s, metrics = train_scanned(cfg, state_s, self.S, graphs=w)
+        _leaves_equal(state_s.params, state_h.params)
+        assert int(state_s.block) == int(state_h.block)
+        # one metrics row per episode, flattened in episode order
+        assert jax.tree.leaves(metrics)[0].shape[0] == self.S * cfg.n_ep_fixed
+
+    def test_donated_window_entry_matches(self):
+        from rcmarl_tpu.training.trainer import (
+            init_train_state,
+            train_scanned,
+            train_window_donated,
+        )
+
+        cfg = _tiny_train_cfg()
+        w = schedule_window(cfg, 0, self.S)
+        ref, _ = train_scanned(
+            cfg, init_train_state(cfg, jax.random.PRNGKey(0)), self.S,
+            graphs=w,
+        )
+        don, _ = train_window_donated(
+            cfg, init_train_state(cfg, jax.random.PRNGKey(0)), self.S,
+            jnp.asarray(w),
+        )
+        _leaves_equal(don.params, ref.params)
+
+    def test_scanned_fused_impl_bitwise(self):
+        """The composed tentpole: the SPARSE one-kernel epoch under the
+        stacked-schedule scan matches the XLA sparse chain's scan."""
+        from rcmarl_tpu.training.trainer import (
+            init_train_state,
+            train_scanned,
+        )
+
+        cfg_x = _tiny_train_cfg()
+        cfg_p = _tiny_train_cfg(consensus_impl="pallas_fused_interpret")
+        w = schedule_window(cfg_x, 0, 2)
+        out_x, _ = train_scanned(
+            cfg_x, init_train_state(cfg_x, jax.random.PRNGKey(0)), 2,
+            graphs=w,
+        )
+        out_p, _ = train_scanned(
+            cfg_p, init_train_state(cfg_p, jax.random.PRNGKey(0)), 2,
+            graphs=w,
+        )
+        _leaves_equal(out_p.params, out_x.params)
+
+
+# --------------------------------------------------------------------------
+# Mega-population fused arm
+# --------------------------------------------------------------------------
+
+
+class TestMegapopFusedArm:
+    def _run(self, impl):
+        from rcmarl_tpu.parallel.megapop import megapop_consensus_block
+
+        cfg = _sched_cfg(n=8, degree=3, consensus_impl=impl)
+        block = jax.random.normal(
+            jax.random.PRNGKey(2), (8, 40), jnp.float32
+        )
+        graph = jnp.asarray(
+            validate_graph(scheduled_in_nodes(cfg, 0), 8, 3, cfg.H)
+        )
+        return megapop_consensus_block(cfg, block, graph)
+
+    def test_fused_arm_bitwise_vs_xla(self):
+        _assert_bitwise(self._run("xla"), self._run("pallas_fused_interpret"))
