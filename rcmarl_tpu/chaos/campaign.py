@@ -55,9 +55,10 @@ def _cell_key(row: dict) -> Tuple[str, str]:
 
 
 def _round(x: Optional[float]) -> Optional[float]:
+    # ledger canonicalisation of an already-host float — no device pull
     if x is None or not math.isfinite(x):
         return None
-    return round(float(x), 4)
+    return round(float(x), 4)  # lint: disable=host-sync
 
 
 def run_cell(point_name: str, intensity: str, runner=None) -> dict:

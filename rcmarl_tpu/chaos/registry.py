@@ -26,6 +26,13 @@ outcome ladder:
   regression that silently FIXES them would be as suspicious as one
   that breaks a defended cell.
 
+This module is in the lint hot-path set so the traced-value rules bind
+on any jitted inner function a scenario grows; the scenario runners
+themselves are HOST harness code — every ``float()``/``np.asarray()``
+here consumes a completed training result (a pandas frame, a finished
+serve call) and every ``PRNGKey(int)`` mints a fixed host-side fixture
+seed — so those lines carry per-line pragma waivers.
+
 Every cell is deterministic (fixed seeds, simulated clocks, injected
 service models where wall time would leak in), so the committed
 ``RESILIENCE.jsonl`` rows are reproducible and the ``--check`` gate
@@ -109,8 +116,8 @@ _CLEAN_CACHE: Dict[object, float] = {}
 def _final_return(df) -> float:
     import numpy as np
 
-    vals = np.asarray(df["True_team_returns"].values, dtype=float)
-    return float(np.mean(vals[-RETURN_WINDOW:]))
+    vals = np.asarray(df["True_team_returns"].values, dtype=float)  # lint: disable=host-sync
+    return float(np.mean(vals[-RETURN_WINDOW:]))  # lint: disable=host-sync
 
 
 def _within_band(final: float, clean: float) -> bool:
@@ -168,7 +175,7 @@ def _train_cell(cfg) -> dict:
     state, df = train(cfg, n_episodes=_TRAIN_EPS)
     clean = _clean_train_return(cfg, _TRAIN_EPS)
     guard = dict(df.attrs.get("guard", {}))
-    returns = np.asarray(df["True_team_returns"].values, dtype=float)
+    returns = np.asarray(df["True_team_returns"].values, dtype=float)  # lint: disable=host-sync
     final = _final_return(df)
     if not _params_ok(state) or not np.isfinite(returns[-RETURN_WINDOW:]).all():
         outcome = "failed"
@@ -194,7 +201,7 @@ def _train_cell(cfg) -> dict:
 def _run_link(fault: str, sanitize: bool, intensity: str) -> dict:
     from rcmarl_tpu.faults import FaultPlan
 
-    p = float(intensity)
+    p = float(intensity)  # lint: disable=host-sync
     plan = FaultPlan(**{_LINK_FAULTS[fault]: p})
     return _train_cell(
         _tiny(
@@ -223,7 +230,7 @@ def _run_adaptive(intensity: str) -> dict:
     from rcmarl_tpu.config import Roles
     from rcmarl_tpu.training.trainer import train
 
-    H = int(intensity.removeprefix("h"))
+    H = int(intensity.removeprefix("h"))  # lint: disable=host-sync
     cfg = _tiny(
         n_episodes=_TRAIN_EPS,
         agent_roles=(Roles.COOPERATIVE, Roles.COOPERATIVE, Roles.ADAPTIVE),
@@ -239,7 +246,7 @@ def _run_adaptive(intensity: str) -> dict:
         _CLEAN_CACHE[clean_key] = _final_return(df)
     clean = _CLEAN_CACHE[clean_key]
     state, df = train(cfg, n_episodes=_TRAIN_EPS, guard=False)
-    returns = np.asarray(df["True_team_returns"].values, dtype=float)
+    returns = np.asarray(df["True_team_returns"].values, dtype=float)  # lint: disable=host-sync
     # the behavioral threat model scores the COOPERATIVE team: the
     # colluder's own row is adversary bookkeeping
     final = _final_return(df)
@@ -281,7 +288,7 @@ def _run_mega_sparse(intensity: str) -> dict:
     from rcmarl_tpu.training.trainer import train
 
     fused = intensity.endswith("_fused")
-    H = int(intensity.removeprefix("h").removesuffix("_fused"))
+    H = int(intensity.removeprefix("h").removesuffix("_fused"))  # lint: disable=host-sync
     n, n_adv = 256, 8
     base = dict(
         n_agents=n,
@@ -315,7 +322,7 @@ def _run_mega_sparse(intensity: str) -> dict:
         _CLEAN_CACHE[clean_key] = _final_return(df)
     clean = _CLEAN_CACHE[clean_key]
     state, df = train(cfg, n_episodes=_TRAIN_EPS, guard=False)
-    returns = np.asarray(df["True_team_returns"].values, dtype=float)
+    returns = np.asarray(df["True_team_returns"].values, dtype=float)  # lint: disable=host-sync
     final = _final_return(df)
     if not _params_ok(state) or not np.isfinite(returns).all():
         outcome = "failed"
@@ -366,7 +373,7 @@ def _gossip_cell(cfg, readmit_after: int = 0, expect_all_healthy=True) -> dict:
     healthy = [
         ok for r, ok in enumerate(g["replica_healthy"]) if r not in byz
     ]
-    returns = np.asarray(df["True_team_returns"].values, dtype=float)
+    returns = np.asarray(df["True_team_returns"].values, dtype=float)  # lint: disable=host-sync
     final = _final_return(df)
     counters = {
         k: g[k]
@@ -440,7 +447,7 @@ def _run_replica_link(intensity: str) -> dict:
 
     return _gossip_cell(
         _gossip_cfg(
-            replica_fault_plan=ReplicaFaultPlan(nan_p=float(intensity))
+            replica_fault_plan=ReplicaFaultPlan(nan_p=float(intensity))  # lint: disable=host-sync
         )
     )
 
@@ -452,7 +459,7 @@ def _run_flapping(intensity: str) -> dict:
     probe rounds, and keep every replica finite end to end."""
     from rcmarl_tpu.faults import FaultPlan
 
-    K = int(intensity.removeprefix("readmit"))
+    K = int(intensity.removeprefix("readmit"))  # lint: disable=host-sync
     res = _gossip_cell(
         _gossip_cfg(
             n_episodes=12,
@@ -522,18 +529,18 @@ def _run_ckpt_bitflip(intensity: str) -> dict:
     from rcmarl_tpu.utils.checkpoint import save_checkpoint
 
     cfg = _tiny()
-    state_a = init_train_state(cfg, jax.random.PRNGKey(0))
-    state_b = init_train_state(cfg, jax.random.PRNGKey(1))
+    state_a = init_train_state(cfg, jax.random.PRNGKey(0))  # lint: disable=prng-int-seed
+    state_b = init_train_state(cfg, jax.random.PRNGKey(1))  # lint: disable=prng-int-seed
     obs = jax.random.normal(
-        jax.random.PRNGKey(5), (4, cfg.n_agents, cfg.obs_dim)
+        jax.random.PRNGKey(5), (4, cfg.n_agents, cfg.obs_dim)  # lint: disable=prng-int-seed
     )
-    key = jax.random.PRNGKey(9)
+    key = jax.random.PRNGKey(9)  # lint: disable=prng-int-seed
 
     def probs_of(state):
         _, p = serve_block(
             cfg, stack_actor_rows(state.params, cfg), obs, key
         )
-        return np.asarray(p)
+        return np.asarray(p)  # lint: disable=host-sync
 
     with tempfile.TemporaryDirectory() as d:
         path = Path(d) / "checkpoint.npz"
@@ -551,7 +558,7 @@ def _run_ckpt_bitflip(intensity: str) -> dict:
             _corrupt_member(path, _CKPT_MEMBER[intensity])
         applied = watcher.poll()
         _, p = eng.serve(obs, key=key)
-        if not np.isfinite(np.asarray(p)).all():
+        if not np.isfinite(np.asarray(p)).all():  # lint: disable=host-sync
             raise CellFailed("engine served non-finite probabilities")
         if intensity == "both":
             if applied or eng.counters["rejects"] != 1:
@@ -568,14 +575,14 @@ def _run_ckpt_bitflip(intensity: str) -> dict:
                     f"counters={eng.counters})"
                 )
             expect = state_a  # .prev holds A
-        if not np.array_equal(np.asarray(p), probs_of(expect)):
+        if not np.array_equal(np.asarray(p), probs_of(expect)):  # lint: disable=host-sync
             raise CellFailed("served policy is not the expected block")
         # recovery: a healthy re-publish must swap in
         save_checkpoint(path, state_b, cfg, meta=meta)
         if not watcher.poll():
             raise CellFailed("healthy re-publish did not recover")
         _, p2 = eng.serve(obs, key=key)
-        if not np.array_equal(np.asarray(p2), probs_of(state_b)):
+        if not np.array_equal(np.asarray(p2), probs_of(state_b)):  # lint: disable=host-sync
             raise CellFailed("post-recovery serving is not the candidate")
         return {
             "outcome": "survived",
@@ -699,7 +706,7 @@ def _run_pipeline_faulted(intensity: str) -> dict:
     from rcmarl_tpu.lint.configs import tiny_faulted_cfg
     from rcmarl_tpu.pipeline.trainer import train_pipelined
 
-    depth = int(intensity.removeprefix("depth"))
+    depth = int(intensity.removeprefix("depth"))  # lint: disable=host-sync
     cfg = tiny_faulted_cfg(False, pipeline_depth=depth, n_episodes=8)
     state, df = train_pipelined(cfg)
     clean_key = ("pipeline_clean", depth)
@@ -711,7 +718,7 @@ def _run_pipeline_faulted(intensity: str) -> dict:
     clean = _CLEAN_CACHE[clean_key]
     g = df.attrs["guard"]
     p = df.attrs["pipeline"]
-    returns = np.asarray(df["True_team_returns"].values, dtype=float)
+    returns = np.asarray(df["True_team_returns"].values, dtype=float)  # lint: disable=host-sync
     final = _final_return(df)
     if not _params_ok(state) or not np.isfinite(returns[-RETURN_WINDOW:]).all():
         outcome = "failed"
@@ -766,7 +773,7 @@ def _gala_cell(cfg, readmit_after: int = 0) -> dict:
     healthy = [
         ok for r, ok in enumerate(g["replica_healthy"]) if r not in byz
     ]
-    returns = np.asarray(df["True_team_returns"].values, dtype=float)
+    returns = np.asarray(df["True_team_returns"].values, dtype=float)  # lint: disable=host-sync
     final = _final_return(df)
     counters = {
         k: g[k]
@@ -980,8 +987,8 @@ def _run_canary_stale(intensity: str) -> dict:
     from rcmarl_tpu.utils.checkpoint import save_checkpoint
 
     cfg = _tiny()
-    incumbent = init_train_state(cfg, jax.random.PRNGKey(0))
-    candidate = init_train_state(cfg, jax.random.PRNGKey(123))
+    incumbent = init_train_state(cfg, jax.random.PRNGKey(0))  # lint: disable=prng-int-seed
+    candidate = init_train_state(cfg, jax.random.PRNGKey(123))  # lint: disable=prng-int-seed
     with tempfile.TemporaryDirectory() as d:
         path = Path(d) / "checkpoint.npz"
         save_checkpoint(path, incumbent, cfg)
@@ -1008,14 +1015,14 @@ def _run_canary_stale(intensity: str) -> dict:
         from rcmarl_tpu.serve.engine import serve_block, stack_actor_rows
 
         obs = jax.random.normal(
-            jax.random.PRNGKey(5), (4, cfg.n_agents, cfg.obs_dim)
+            jax.random.PRNGKey(5), (4, cfg.n_agents, cfg.obs_dim)  # lint: disable=prng-int-seed
         )
-        key = jax.random.PRNGKey(9)
+        key = jax.random.PRNGKey(9)  # lint: disable=prng-int-seed
         _, p = eng.serve(obs, key=key)
         _, p_inc = serve_block(
             cfg, stack_actor_rows(incumbent.params, cfg), obs, key
         )
-        if not np.array_equal(np.asarray(p), np.asarray(p_inc)):
+        if not np.array_equal(np.asarray(p), np.asarray(p_inc)):  # lint: disable=host-sync
             raise CellFailed(
                 "post-reject serving is not bitwise the incumbent"
             )

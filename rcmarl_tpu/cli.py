@@ -3128,12 +3128,41 @@ def cmd_lint(argv) -> int:
         "config.py line (rcmarl_tpu.lint.contract)",
     )
     p.add_argument(
+        "--kernels",
+        action="store_true",
+        help="also run the static kernel-budget audit: derive every "
+        "Pallas kernel_plan()'s per-grid-step VMEM/SMEM residency, "
+        "tile packing, and DMA traffic across the tiny-lint + bench + "
+        "tpu_session.sh shape matrix, re-derive the committed "
+        "*_dma_bytes closed forms, and gate the kernel_budget rows vs "
+        "--baseline — pure shape arithmetic, no backend "
+        "(rcmarl_tpu.lint.kernels)",
+    )
+    p.add_argument(
+        "--tpu_gen",
+        type=str,
+        default=None,
+        choices=sorted(("v4", "v5e", "v5p")),
+        help="TPU generation whose VMEM/SMEM budget table the --kernels "
+        "arm enforces (default: v4, the strictest — a plan that fits "
+        "there fits everywhere; the ledger records verdicts for every "
+        "generation regardless)",
+    )
+    p.add_argument(
+        "--feasibility",
+        action="store_true",
+        help="print the per-session-step kernel feasibility verdicts "
+        "('step:<tag> kernel=... shape=... verdict=...') at --tpu_gen "
+        "and exit 0 — the scripts/tpu_session.sh preflight feed "
+        "(implies --kernels; verdicts only, no baseline gate)",
+    )
+    p.add_argument(
         "--baseline",
         type=str,
         default="AUDIT.jsonl",
-        help="the committed cost/collective/device-memory ledger the "
-        "--cost/--collectives/--sharding gates compare against "
-        "(default: ./AUDIT.jsonl); "
+        help="the committed cost/collective/device-memory/kernel-budget "
+        "ledger the --cost/--collectives/--sharding/--kernels gates "
+        "compare against (default: ./AUDIT.jsonl); "
         "on gate failure the fresh ledger is written to <baseline>.new "
         "so the diff is one click away",
     )
@@ -3157,7 +3186,7 @@ def cmd_lint(argv) -> int:
         "--all",
         action="store_true",
         help="shorthand for --retrace --donation --backends --cost "
-        "--collectives --sharding --contract",
+        "--collectives --sharding --contract --kernels",
     )
     p.add_argument(
         "--rules",
@@ -3183,9 +3212,22 @@ def cmd_lint(argv) -> int:
             print(f"  {r}")
         return 0
 
+    if args.feasibility:
+        # the session preflight feed: machine-readable verdicts only,
+        # always exit 0 — the script gates on the verdict text, and a
+        # broken preflight must not silently veto a whole session
+        from rcmarl_tpu.lint.cost import COST_TOLERANCE
+        from rcmarl_tpu.lint.kernels import feasibility_lines
+
+        tol = COST_TOLERANCE if args.cost_tol is None else args.cost_tol
+        for line in feasibility_lines(args.tpu_gen, tol):
+            print(line)
+        return 0
+
     any_audit = (
         args.retrace or args.donation or args.backends or args.cost
-        or args.collectives or args.sharding or args.contract or args.all
+        or args.collectives or args.sharding or args.contract
+        or args.kernels or args.all
     )
     if args.collectives or args.sharding or args.all:
         # The collective census needs a multi-device mesh. Mirror
@@ -3295,6 +3337,25 @@ def cmd_lint(argv) -> int:
 
         f, nts = audit_contract()
         findings += f
+        notes += nts
+        n_sections += 1
+    if args.kernels or args.all:
+        from rcmarl_tpu.lint.cost import COST_TOLERANCE
+        from rcmarl_tpu.lint.kernels import audit_kernels, kernel_rows
+
+        tol = COST_TOLERANCE if args.cost_tol is None else args.cost_tol
+        if args.write_baseline:
+            # invariants (tile packing, model drift, must-fit budget
+            # busts) still enforced while regenerating
+            rows, f, nts, skipped = kernel_rows(args.tpu_gen, tol)
+            findings += f
+            fresh_rows += rows
+            skipped_entries |= skipped
+        else:
+            f, nts, rows = audit_kernels(args.baseline, tol, args.tpu_gen)
+            findings += f
+            gate_findings += len(f)
+            fresh_rows += rows
         notes += nts
         n_sections += 1
     if args.write_baseline and fresh_rows:

@@ -24,7 +24,8 @@ static-unhashable  jit-static configs stay hashable (frozen-dataclass
 
 **Layer 2 — compiled-artifact audits** (import jax, run real tiny
 programs; ``lint --retrace/--donation/--backends/--cost/--collectives/
---sharding/--contract``):
+--sharding/--contract/--kernels`` — the kernels arm is pure shape
+arithmetic and runs without a backend):
 
 ================== ====================================================
 retrace            each jitted entry point compiles exactly once after
@@ -70,6 +71,26 @@ contract-drift     a Config field unreachable from any CLI flag (and
                    not exempted), failing the checkpoint-header JSON
                    round-trip, or missing from the docs/api.md table
                    (:mod:`.contract`)
+kernel-vmem-budget a Pallas plan's statically derived per-grid-step
+                   VMEM residency (double-buffered BlockSpec tiles +
+                   scratch live set) exceeds the selected TPU
+                   generation's budget on a must-fit lint cell, or a
+                   committed ``feasible`` verdict regressed
+                   (:mod:`.kernels`)
+kernel-smem-budget same, for the scalar-prefetch SMEM residency
+                   (:mod:`.kernels`)
+kernel-tile-misaligned a CHOSEN tile dimension violates the dtype's
+                   (sublane, lane) packing quantum — (8, 128) f32,
+                   (16, 128) bf16, (32, 128) int8 (:mod:`.kernels`)
+kernel-dma-model-drift a committed ``*_dma_bytes`` closed-form model
+                   disagrees with the traffic re-derived from the
+                   plan's BlockSpec grid arithmetic past ``--cost_tol``
+                   (:mod:`.kernels`)
+kernel-budget-regression a ``kernel_budget`` ledger row drifted:
+                   residency/traffic grew past tolerance, a row is
+                   unbaselined or stale, or the plan fingerprint
+                   changed without regenerating AUDIT.jsonl
+                   (:mod:`.kernels`)
 ================== ====================================================
 
 Escape hatch for Layer 1: ``# lint: disable=<rule>`` on the flagged
@@ -128,6 +149,11 @@ AUDIT_RULES = (
     "device-memory-regression",
     "nondeterminism",
     "contract-drift",
+    "kernel-vmem-budget",
+    "kernel-smem-budget",
+    "kernel-tile-misaligned",
+    "kernel-dma-model-drift",
+    "kernel-budget-regression",
 )
 
 _PASSES = (prng.run, hostsync.run, staticargs.run)

@@ -33,7 +33,13 @@ from typing import Iterable, List, Sequence
 #: prng-fold-tag). Entries ending in ``/`` match a directory anywhere
 #: in the path; others match as a path suffix — so the set holds for
 #: package-relative, repo-relative, and absolute display paths alike.
-HOT_PATH_PATTERNS = ("ops/", "agents/updates.py", "training/update.py")
+HOT_PATH_PATTERNS = (
+    "ops/",
+    "agents/updates.py",
+    "training/update.py",
+    "parallel/gala.py",
+    "chaos/",
+)
 
 _LINE_PRAGMA = re.compile(r"#\s*lint:\s*disable=([\w,\-]+)")
 _FILE_PRAGMA = re.compile(r"#\s*lint:\s*disable-file=([\w,\-]+)")

@@ -1,0 +1,842 @@
+"""``lint --kernels`` — static kernel-budget audit over every Pallas plan.
+
+For every Pallas entry point x a shape matrix spanning (a) the tiny
+lint configs the CPU suite itself compiles, (b) the BENCH_CONFIGS
+scaling cells, and (c) every shape queued in ``scripts/tpu_session.sh``
+(each cell carries its session step tags), the audit statically derives
+per-grid-step on-chip residency from the kernel's own
+``kernel_plan()`` seam — BlockSpec block shapes, scratch live sets,
+scalar-prefetch operands, accumulator dtypes — and then:
+
+================== ====================================================
+rule id            what it enforces
+================== ====================================================
+kernel-vmem-budget a plan's per-grid-step VMEM residency (double-
+                   buffered pipelined blocks + scratch) exceeds the
+                   selected TPU generation's budget on a cell that must
+                   fit (the tiny lint cells), or regressed a committed
+                   ``feasible`` verdict
+kernel-smem-budget same, for the scalar-memory residency of the
+                   scalar-prefetch operands
+kernel-tile-misaligned a CHOSEN tile dimension violates the dtype's
+                   (sublane, lane) packing quantum — (8, 128) f32,
+                   (16, 128) bf16, (32, 128) int8
+kernel-dma-model-drift a committed ``*_dma_bytes`` closed-form model
+                   disagrees with the traffic re-derived from the
+                   plan's grid arithmetic beyond ``--cost_tol``
+kernel-budget-regression a ``kernel_budget`` ledger row drifted:
+                   residency/traffic grew past tolerance, a row is
+                   unbaselined or stale, or the plan fingerprint
+                   changed without regenerating AUDIT.jsonl
+================== ====================================================
+
+Everything here is pure shape arithmetic: plans come from
+``jax.eval_shape`` of the real init/rollout/stacking chains
+(:mod:`rcmarl_tpu.utils.profiling`), so mega-population session cells
+price in milliseconds on any host, with no backend and no allocation.
+The ``kernel_budget`` rows are therefore platform-free (no
+``platform``/``jax`` keys): byte-identical wherever they are
+regenerated.
+
+Session/bench cells that exceed a generation's budget are NOT findings
+— they are honest ``infeasible`` verdicts (recorded per generation in
+the ledger) that the ``tpu_session.sh`` preflight uses to abort exactly
+the queued steps that could not run. A finding fires only when a
+must-fit lint cell busts the budget or a committed verdict regresses.
+
+Residency model (the conservative Mosaic reading): every pipelined
+VMEM block is double-buffered whenever the grid has more than one step
+(compute on tile i overlaps the DMA of tile i+1), scratch is resident
+once, SMEM operands live in scalar memory for the launch. Grids price
+ONE launch — a vmapped launch (the per-agent aggregation) adds grid
+steps, not per-step residency.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence, Set, Tuple
+
+from rcmarl_tpu.lint.cost import COST_TOLERANCE, read_ledger
+from rcmarl_tpu.lint.findings import Finding
+from rcmarl_tpu.ops.dma_model import KernelPlan, plan_dma_bytes
+
+#: Per-generation on-chip budgets in bytes. v4 cores carry 16 MiB of
+#: VMEM; v5e/v5p carry 128 MiB. SMEM is 1 MiB everywhere. The audit
+#: defaults to the STRICTEST generation (v4): a plan that fits there
+#: fits everywhere.
+TPU_GENERATIONS = {
+    "v4": {"vmem": 16 * 2**20, "smem": 1 * 2**20},
+    "v5e": {"vmem": 128 * 2**20, "smem": 1 * 2**20},
+    "v5p": {"vmem": 128 * 2**20, "smem": 1 * 2**20},
+}
+
+#: Ledger row order (and the strictest-first default).
+GEN_ORDER = ("v4", "v5e", "v5p")
+DEFAULT_GEN = "v4"
+
+#: Minimum sublane (second-minor) tile extent per dtype — the TPU
+#: packing rule: a (sublane, 128) tile holds 8 f32 rows, 16 bf16 rows,
+#: 32 int8 rows. The lane (minor) quantum is 128 for every dtype.
+SUBLANE_MIN = {
+    "float32": 8,
+    "int32": 8,
+    "uint32": 8,
+    "bfloat16": 16,
+    "float16": 16,
+    "int16": 16,
+    "uint16": 16,
+    "int8": 32,
+    "uint8": 32,
+}
+LANE_MIN = 128
+
+#: Absolute slack (bytes) on the DMA-model drift gate: the fit scan's
+#: derivation counts the (R, N) first-epoch-loss output (4·R·N bytes)
+#: that the committed scan-carry model leaves out of its parameter
+#: traffic — structural, bounded, and far below any real model error.
+KERNEL_DRIFT_ABS_SLACK = 4096.0
+
+#: The residency/traffic metrics the regression gate compares.
+KERNEL_GATED_METRICS = ("vmem_bytes", "smem_bytes", "dma_derived_bytes")
+
+_KERNEL_ANCHORS = {
+    "fused_consensus": "rcmarl_tpu/ops/pallas_consensus.py",
+    "sparse_consensus": "rcmarl_tpu/ops/pallas_consensus.py",
+    "aggregation_select": "rcmarl_tpu/ops/pallas_aggregation.py",
+    "aggregation_sort": "rcmarl_tpu/ops/pallas_aggregation.py",
+    "fit_scan": "rcmarl_tpu/ops/pallas_fit.py",
+    "fused_serve": "rcmarl_tpu/ops/pallas_serve.py",
+    "fused_fleet": "rcmarl_tpu/ops/pallas_serve.py",
+}
+
+
+def _anchor(entry: str) -> str:
+    return _KERNEL_ANCHORS.get(
+        entry.split("[", 1)[0], "rcmarl_tpu/lint/kernels.py"
+    )
+
+
+# --------------------------------------------------------------------------
+# Residency, tiling, fingerprint — pure plan arithmetic
+# --------------------------------------------------------------------------
+
+
+def plan_vmem_bytes(plan: KernelPlan) -> int:
+    """Per-grid-step VMEM residency: every pipelined block pays double
+    (Mosaic overlaps tile i's compute with tile i+1's DMA) whenever the
+    grid has more than one step; scratch is resident once."""
+    mult = 2 if plan.grid_steps() > 1 else 1
+    total = 0
+    for op in plan.inputs + plan.outputs:
+        if op.memory == "vmem":
+            total += op.block_bytes() * mult
+    for op in plan.scratch:
+        total += op.block_bytes()
+    return int(total)
+
+
+def plan_smem_bytes(plan: KernelPlan) -> int:
+    """Scalar-memory residency: the scalar-prefetch operands, resident
+    for the whole launch."""
+    return int(
+        sum(
+            op.block_bytes()
+            for op in plan.inputs + plan.outputs
+            if op.memory == "smem"
+        )
+    )
+
+
+def plan_fingerprint(plan: KernelPlan) -> str:
+    """A short stable hash of the plan's full static signature (grid,
+    refetch discipline, every operand's shape/dtype/memory/variance) —
+    the ``kernel_budget`` rows' config-drift key."""
+    sig = {
+        "name": plan.name,
+        "grid": [int(g) for g in plan.grid],
+        "refetch": plan.refetch,
+        "operands": [
+            [
+                role,
+                op.name,
+                [int(d) for d in op.block_shape],
+                op.dtype,
+                [bool(v) for v in op.varies],
+                op.memory,
+                [int(d) for d in op.tiled_dims],
+            ]
+            for role, ops in (
+                ("in", plan.inputs),
+                ("out", plan.outputs),
+                ("scratch", plan.scratch),
+            )
+            for op in ops
+        ],
+    }
+    blob = json.dumps(sig, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:12]
+
+
+def tile_findings(plan: KernelPlan, entry: str) -> List[Finding]:
+    """``kernel-tile-misaligned``: a CHOSEN tile extent (``tiled_dims``
+    only — problem-determined dims like an obs width are the problem's
+    business, not the tiling's) that violates the dtype packing quantum
+    at the sublane (second-minor) or lane (minor) position."""
+    findings: List[Finding] = []
+    anchor = _anchor(entry)
+    for op in plan.inputs + plan.outputs:
+        nd = len(op.block_shape)
+        for d in op.tiled_dims:
+            if d == nd - 1:
+                quantum, axis = LANE_MIN, "lane"
+            elif d == nd - 2:
+                quantum = SUBLANE_MIN.get(op.dtype, 8)
+                axis = "sublane"
+            else:
+                continue
+            if op.block_shape[d] % quantum:
+                findings.append(
+                    Finding(
+                        "kernel-tile-misaligned",
+                        anchor,
+                        1,
+                        f"{entry}: operand {op.name!r} tile dim {d} = "
+                        f"{op.block_shape[d]} is not a multiple of the "
+                        f"{op.dtype} {axis} quantum {quantum} "
+                        f"(block {tuple(op.block_shape)}) — the tile "
+                        "wastes packed registers or fails to lower",
+                    )
+                )
+    return findings
+
+
+def drift_findings(
+    entry: str, model_bytes: float, derived_bytes: float, tol: float
+) -> List[Finding]:
+    """``kernel-dma-model-drift``: the committed closed-form model vs
+    the traffic re-derived from the plan's grid arithmetic. Fires in
+    BOTH directions — this is a model-accuracy check, not a growth
+    gate."""
+    gap = abs(derived_bytes - model_bytes)
+    if gap <= max(tol * model_bytes, KERNEL_DRIFT_ABS_SLACK):
+        return []
+    return [
+        Finding(
+            "kernel-dma-model-drift",
+            _anchor(entry),
+            1,
+            f"{entry}: committed DMA model says {model_bytes:.0f} bytes "
+            f"but the BlockSpec grid arithmetic derives "
+            f"{derived_bytes:.0f} ({gap:.0f} apart > "
+            f"max({tol:g} rel, {KERNEL_DRIFT_ABS_SLACK:.0f} abs)) — "
+            "the model and the kernel plan no longer describe the same "
+            "launch",
+        )
+    ]
+
+
+# --------------------------------------------------------------------------
+# The cell matrix
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class KernelCell:
+    """One (kernel, shape) audit cell. ``steps`` are the
+    ``scripts/tpu_session.sh`` step tags whose queued work launches this
+    plan (empty for lint-only shapes); ``must_fit`` marks the tiny lint
+    cells whose infeasibility is a finding rather than a verdict.
+    ``build()`` returns ``(plan, committed_model_bytes_or_None)``."""
+
+    entry: str
+    steps: Tuple[str, ...]
+    must_fit: bool
+    build: Callable[[], Tuple[KernelPlan, Optional[float]]]
+
+
+def _bench_cfg(name: str):
+    from rcmarl_tpu.cli import _bench_config
+
+    # impl/dtype knobs don't move any shape; n_ep_fixed=10 is the
+    # bench/profile CLI default the session steps inherit
+    return _bench_config(name, "xla", 10)
+
+
+def _agg_cell(entry, steps, cfg_fn, variant, must_fit=False) -> KernelCell:
+    def build():
+        from rcmarl_tpu.ops import pallas_aggregation
+        from rcmarl_tpu.utils.profiling import pair_trunk_struct
+
+        cfg = cfg_fn()
+        _, _, p_pair = pair_trunk_struct(cfg)
+        plan = pallas_aggregation.kernel_plan(
+            cfg.n_in, p_pair, cfg.H, variant=variant
+        )
+        return plan, None  # no committed DMA model for the leaf kernel
+
+    return KernelCell(entry, steps, must_fit, build)
+
+
+def _consensus_cell(
+    entry, steps, cfg_fn, *, faulted=False, must_fit=False
+) -> KernelCell:
+    def build():
+        from rcmarl_tpu.ops import pallas_consensus
+        from rcmarl_tpu.ops.dma_model import consensus_model_bytes
+        from rcmarl_tpu.utils.profiling import pair_trunk_struct
+
+        cfg = cfg_fn()
+        n_trunk, _, _ = pair_trunk_struct(cfg)
+        plan = pallas_consensus.kernel_plan(
+            cfg.n_agents,
+            cfg.n_in,
+            n_trunk,
+            active=faulted,
+            has_stale=faulted,
+            trim_h=cfg.H,
+            sanitize=faulted,
+        )
+        model = consensus_model_bytes(
+            cfg.n_agents,
+            cfg.n_in,
+            n_trunk,
+            active=faulted,
+            has_stale=faulted,
+        )
+        return plan, model
+
+    return KernelCell(entry, steps, must_fit, build)
+
+
+def _sparse_cell(entry, steps, cfg_fn, must_fit=False) -> KernelCell:
+    def build():
+        from rcmarl_tpu.ops import pallas_consensus
+        from rcmarl_tpu.ops.dma_model import sparse_consensus_model_bytes
+        from rcmarl_tpu.utils.profiling import pair_trunk_struct
+
+        cfg = cfg_fn()
+        n_trunk, _, _ = pair_trunk_struct(cfg)
+        degree = cfg.resolved_graph_degree
+        plan = pallas_consensus.kernel_plan(
+            cfg.n_agents, degree, n_trunk, sparse=True, trim_h=cfg.H
+        )
+        model = sparse_consensus_model_bytes(cfg.n_agents, degree, n_trunk)
+        return plan, model
+
+    return KernelCell(entry, steps, must_fit, build)
+
+
+def _fit_cell(entry, steps, cfg_fn, flavor, must_fit=False) -> KernelCell:
+    def build():
+        from rcmarl_tpu.ops import pallas_fit
+        from rcmarl_tpu.utils.profiling import (
+            coop_fit_row_structs,
+            fit_row_structs,
+        )
+
+        cfg = cfg_fn()
+        structs = (
+            fit_row_structs(cfg)
+            if flavor == "adv"
+            else coop_fit_row_structs(cfg)
+        )
+        _, params_rows, x_rows, targets_rows, schedule = structs
+        plan = pallas_fit.kernel_plan(
+            params_rows, x_rows, targets_rows, schedule
+        )
+        model = pallas_fit.fit_scan_hbm_bytes(
+            params_rows, x_rows, targets_rows, schedule, resident=True
+        )
+        return plan, model
+
+    return KernelCell(entry, steps, must_fit, build)
+
+
+def _serve_cell(
+    entry, steps, cfg_fn, batch, *, members=0, must_fit=False
+) -> KernelCell:
+    def build():
+        import jax
+
+        from rcmarl_tpu.ops import pallas_serve
+        from rcmarl_tpu.ops.dma_model import serve_model_bytes
+        from rcmarl_tpu.utils.profiling import serve_block_struct
+
+        cfg = cfg_fn()
+        block = serve_block_struct(cfg)
+        if members:
+            from rcmarl_tpu.serve.fleet import fleet_stack
+
+            block = jax.eval_shape(
+                lambda b: fleet_stack([b] * members), block
+            )
+        plan = pallas_serve.kernel_plan(
+            block, batch, cfg.n_agents, mode="sample", fleet=bool(members)
+        )
+        model = serve_model_bytes(
+            cfg.n_agents,
+            cfg.obs_dim,
+            tuple(cfg.hidden),
+            cfg.n_actions,
+            batch,
+            mode="sample",
+            n_members=members,
+        )
+        return plan, model
+
+    return KernelCell(entry, steps, must_fit, build)
+
+
+def kernel_cells() -> List[KernelCell]:
+    """The full (kernel x shape) audit matrix: every tiny lint shape
+    (``must_fit``) plus every shape the TPU session queues, tagged with
+    the session step(s) that launch it. Builders defer all imports and
+    derive shapes through ``jax.eval_shape`` — a cell is milliseconds,
+    megapop included."""
+    from rcmarl_tpu.lint.configs import (
+        megapop_cfg,
+        tiny_cfg,
+        tiny_faulted_cfg,
+        tiny_mixed_cfg,
+        tiny_sparse_cfg,
+    )
+
+    def default_cfg():
+        from rcmarl_tpu.config import Config
+
+        return Config()
+
+    from rcmarl_tpu.lint.cost import SERVE_COST_BATCH
+
+    cells: List[KernelCell] = []
+
+    # ---- leaf aggregation (select + sorting-network arms)
+    agg_steps = {
+        "ref5_ring": (("2",), ("2",)),
+        "n16_full": (("2", "9"), ("2",)),
+        "n64_ring": (("1",), ("1",)),
+        "n64_full": (("1", "2", "9"), ("1", "2")),
+        "n64_large_h2": (("1", "2", "9"), ("1", "2")),
+        "n256_ring": (("1", "14b"), ("1",)),
+    }
+    for variant in ("select", "sort"):
+        cells.append(
+            _agg_cell(
+                f"aggregation_{variant}[tiny]",
+                (),
+                tiny_cfg,
+                variant,
+                must_fit=True,
+            )
+        )
+        for name, (sel_tags, sort_tags) in agg_steps.items():
+            cells.append(
+                _agg_cell(
+                    f"aggregation_{variant}[{name}]",
+                    sel_tags if variant == "select" else sort_tags,
+                    lambda name=name: _bench_cfg(name),
+                    variant,
+                )
+            )
+
+    # ---- dense fused consensus (the one-kernel epoch, step 9)
+    cells.append(
+        _consensus_cell(
+            "fused_consensus[tiny_faulted]",
+            (),
+            lambda: tiny_faulted_cfg(netstack=True),
+            faulted=True,
+            must_fit=True,
+        )
+    )
+    for name in ("n16_full", "n64_full", "n64_large_h2"):
+        cells.append(
+            _consensus_cell(
+                f"fused_consensus[{name}]",
+                ("9",),
+                lambda name=name: _bench_cfg(name),
+            )
+        )
+
+    # ---- sparse (scheduled-graph) consensus
+    cells.append(
+        _sparse_cell(
+            "sparse_consensus[tiny_sparse]", (), tiny_sparse_cfg,
+            must_fit=True,
+        )
+    )
+    cells.append(
+        _sparse_cell(
+            "sparse_consensus[n256_sparse]",
+            ("14", "14b", "15"),
+            lambda: _bench_cfg("n256_sparse"),
+        )
+    )
+    cells.append(
+        _sparse_cell(
+            "sparse_consensus[n1024_sparse]",
+            ("14", "15b"),
+            lambda: _bench_cfg("n1024_sparse"),
+        )
+    )
+    cells.append(
+        _sparse_cell("sparse_consensus[megapop]", (), megapop_cfg)
+    )
+
+    # ---- the fit scan (adversary minibatch rows + cooperative
+    # full-batch rows — all-coop session cells launch the coop shape)
+    cells.append(
+        _fit_cell(
+            "fit_scan[tiny_mixed]", (), tiny_mixed_cfg, "adv", must_fit=True
+        )
+    )
+    cells.append(
+        _fit_cell("fit_scan[tiny_coop]", (), tiny_cfg, "coop", must_fit=True)
+    )
+    cells.append(
+        _fit_cell(
+            "fit_scan[n16_mixed_adv]",
+            ("9b",),
+            lambda: _bench_cfg("n16_mixed"),
+            "adv",
+        )
+    )
+    cells.append(
+        _fit_cell(
+            "fit_scan[n16_mixed_coop]",
+            ("9b",),
+            lambda: _bench_cfg("n16_mixed"),
+            "coop",
+        )
+    )
+    cells.append(
+        _fit_cell(
+            "fit_scan[n64_full_coop]",
+            ("9b",),
+            lambda: _bench_cfg("n64_full"),
+            "coop",
+        )
+    )
+
+    # ---- fused serving (solo + fleet)
+    cells.append(
+        _serve_cell(
+            f"fused_serve[tiny@{SERVE_COST_BATCH}]",
+            (),
+            tiny_cfg,
+            SERVE_COST_BATCH,
+            must_fit=True,
+        )
+    )
+    cells.append(
+        _serve_cell(
+            f"fused_fleet[tiny_f2@{SERVE_COST_BATCH}]",
+            (),
+            tiny_cfg,
+            SERVE_COST_BATCH,
+            members=2,
+            must_fit=True,
+        )
+    )
+    cells.append(
+        _serve_cell(
+            "fused_serve[ref5@4096]", ("12", "12b"), default_cfg, 4096
+        )
+    )
+    cells.append(
+        _serve_cell(
+            "fused_fleet[ref5_f4@4096]", ("10b",), default_cfg, 4096,
+            members=4,
+        )
+    )
+    return cells
+
+
+# --------------------------------------------------------------------------
+# Rows, comparison, audit
+# --------------------------------------------------------------------------
+
+
+def kernel_rows(
+    tpu_gen: Optional[str] = None,
+    tol: float = COST_TOLERANCE,
+    cells: Optional[Sequence[KernelCell]] = None,
+) -> Tuple[List[dict], List[Finding], List[str], Set[str]]:
+    """Derive every cell's plan and extract ``kernel_budget`` ledger
+    rows (one per generation), plus the unconditional findings.
+
+    Returns ``(rows, findings, notes, skipped entry names)`` — the
+    collectives-arm contract. Tile misalignment and DMA-model drift are
+    invariants (they hold with or without a baseline, and under
+    ``--write_baseline``); budget violations are findings only on
+    must-fit cells at the selected generation — session cells record
+    verdicts, and an infeasible one is a note here and a loud abort in
+    the session preflight. Underivable cells (a shape chain that
+    raises) are noted and skipped — never silently passed. ``cells``
+    overrides the matrix (the planted-regression tests feed
+    deliberately bad plans through the same pipeline)."""
+    gen = tpu_gen or DEFAULT_GEN
+    if gen not in TPU_GENERATIONS:
+        raise ValueError(
+            f"tpu_gen={gen!r}: expected one of {sorted(TPU_GENERATIONS)}"
+        )
+    rows: List[dict] = []
+    findings: List[Finding] = []
+    notes: List[str] = []
+    skipped: Set[str] = set()
+    for cell in kernel_cells() if cells is None else cells:
+        try:
+            plan, model = cell.build()
+        except Exception as e:  # noqa: BLE001 — cost-arm discipline:
+            # an underivable shape is a note + skip, never a pass
+            notes.append(
+                f"{cell.entry}: shape derivation failed "
+                f"({type(e).__name__}: {e}); kernel cell skipped here"
+            )
+            skipped.update(f"{cell.entry}@{g}" for g in GEN_ORDER)
+            continue
+        fp = plan_fingerprint(plan)
+        vmem = plan_vmem_bytes(plan)
+        smem = plan_smem_bytes(plan)
+        derived = float(plan_dma_bytes(plan))
+        findings.extend(tile_findings(plan, cell.entry))
+        if model is not None:
+            findings.extend(
+                drift_findings(cell.entry, float(model), derived, tol)
+            )
+        metrics = {
+            "vmem_bytes": float(vmem),
+            "smem_bytes": float(smem),
+            "dma_model_bytes": float(model) if model is not None else 0.0,
+            "dma_derived_bytes": derived,
+        }
+        for g in GEN_ORDER:
+            budget = TPU_GENERATIONS[g]
+            feasible = vmem <= budget["vmem"] and smem <= budget["smem"]
+            rows.append(
+                {
+                    "v": 1,
+                    "kind": "kernel_budget",
+                    "entry": f"{cell.entry}@{g}",
+                    "fingerprint": fp,
+                    "program": plan.name,
+                    "gen": g,
+                    "steps": list(cell.steps),
+                    "grid": [int(x) for x in plan.grid],
+                    "must_fit": cell.must_fit,
+                    "verdict": "feasible" if feasible else "infeasible",
+                    # per-row copy: rows are independently mutable (the
+                    # compare tests patch one generation's row alone)
+                    "metrics": dict(metrics),
+                }
+            )
+        budget = TPU_GENERATIONS[gen]
+        over_vmem = vmem > budget["vmem"]
+        over_smem = smem > budget["smem"]
+        if not (over_vmem or over_smem):
+            continue
+        if cell.must_fit:
+            if over_vmem:
+                findings.append(
+                    Finding(
+                        "kernel-vmem-budget",
+                        _anchor(cell.entry),
+                        1,
+                        f"{cell.entry}: per-grid-step VMEM residency "
+                        f"{vmem} bytes exceeds the {gen} budget "
+                        f"{budget['vmem']} on a must-fit lint cell — "
+                        "shrink the block/tile or the scratch live set",
+                    )
+                )
+            if over_smem:
+                findings.append(
+                    Finding(
+                        "kernel-smem-budget",
+                        _anchor(cell.entry),
+                        1,
+                        f"{cell.entry}: scalar-prefetch residency "
+                        f"{smem} bytes exceeds the {gen} SMEM budget "
+                        f"{budget['smem']} on a must-fit lint cell",
+                    )
+                )
+        else:
+            which = "VMEM" if over_vmem else "SMEM"
+            notes.append(
+                f"{cell.entry}: infeasible at {gen} ({which} "
+                f"{vmem if over_vmem else smem} bytes > budget); the "
+                "session preflight aborts step(s) "
+                f"{list(cell.steps) or ['(lint-only shape)']} on {gen} "
+                "hosts"
+            )
+    return rows, findings, notes, skipped
+
+
+def compare_kernels(
+    baseline: Sequence[dict],
+    fresh: Sequence[dict],
+    tol: float = COST_TOLERANCE,
+    skipped=frozenset(),
+) -> Tuple[List[Finding], List[str]]:
+    """Diff fresh ``kernel_budget`` rows against the committed ledger.
+
+    ``kernel-budget-regression``: a gated metric grew past ``tol``, a
+    fresh row is unbaselined, a plan fingerprint changed, or a ledger
+    row went stale (``skipped`` entries exempt — this host could not
+    derive them, already noted). A committed ``feasible`` verdict that
+    flips to ``infeasible`` fires the budget rule itself
+    (``kernel-vmem-budget``/``kernel-smem-budget``) — that is the
+    regression the budget table exists to catch. Shrunk metrics and
+    verdicts that IMPROVED are notes: refresh the ledger to lock the
+    win in. Rows are platform-free, so there is no platform skew path
+    here (module docstring)."""
+    findings: List[Finding] = []
+    notes: List[str] = []
+    base_by_entry = {
+        r["entry"]: r for r in baseline if r.get("kind") == "kernel_budget"
+    }
+    fresh_entries = set()
+    for row in fresh:
+        entry = row["entry"]
+        fresh_entries.add(entry)
+        anchor = _anchor(entry)
+        base = base_by_entry.get(entry)
+        if base is None:
+            findings.append(
+                Finding(
+                    "kernel-budget-regression",
+                    anchor,
+                    1,
+                    f"{entry}: no row in the baseline ledger — regenerate "
+                    "and commit AUDIT.jsonl in this PR "
+                    "(lint --kernels --write_baseline)",
+                )
+            )
+            continue
+        if base.get("fingerprint") != row.get("fingerprint"):
+            findings.append(
+                Finding(
+                    "kernel-budget-regression",
+                    anchor,
+                    1,
+                    f"{entry}: kernel plan changed (ledger fingerprint "
+                    f"{base.get('fingerprint')} != "
+                    f"{row.get('fingerprint')}); regenerate AUDIT.jsonl",
+                )
+            )
+            continue
+        if base.get("verdict") == "feasible" and row.get("verdict") == (
+            "infeasible"
+        ):
+            gen = row.get("gen", "?")
+            budget = TPU_GENERATIONS.get(gen, TPU_GENERATIONS[DEFAULT_GEN])
+            over_smem = (
+                float(row["metrics"].get("smem_bytes", 0.0))
+                > budget["smem"]
+            )
+            rule = (
+                "kernel-smem-budget" if over_smem else "kernel-vmem-budget"
+            )
+            findings.append(
+                Finding(
+                    rule,
+                    anchor,
+                    1,
+                    f"{entry}: committed verdict 'feasible' regressed to "
+                    "'infeasible' — the plan no longer fits the "
+                    f"{gen} budget it shipped under",
+                )
+            )
+            continue
+        if base.get("verdict") == "infeasible" and row.get("verdict") == (
+            "feasible"
+        ):
+            notes.append(
+                f"{entry}: verdict improved infeasible -> feasible; "
+                "refresh AUDIT.jsonl to lock the win in"
+            )
+            continue
+        for metric in KERNEL_GATED_METRICS:
+            old = float(base["metrics"].get(metric, 0.0))
+            new = float(row["metrics"].get(metric, 0.0))
+            if new > old * (1.0 + tol) + 1e-9:
+                findings.append(
+                    Finding(
+                        "kernel-budget-regression",
+                        anchor,
+                        1,
+                        f"{entry}: {metric} grew {old:.0f} -> {new:.0f} "
+                        f"(> 1+{tol:g} tolerance) without a ledger "
+                        "update",
+                    )
+                )
+            elif old > new * (1.0 + tol) + 1e-9:
+                notes.append(
+                    f"{entry}: {metric} shrank {old:.0f} -> {new:.0f}; "
+                    "refresh AUDIT.jsonl to lock the improvement in"
+                )
+    for entry in sorted(set(base_by_entry) - fresh_entries - set(skipped)):
+        findings.append(
+            Finding(
+                "kernel-budget-regression",
+                _anchor(entry),
+                1,
+                f"{entry}: ledger row has no current counterpart (cell "
+                "removed or renamed); regenerate AUDIT.jsonl",
+            )
+        )
+    return findings, notes
+
+
+def audit_kernels(
+    baseline_path="AUDIT.jsonl",
+    tol: float = COST_TOLERANCE,
+    tpu_gen: Optional[str] = None,
+) -> Tuple[List[Finding], List[str], List[dict]]:
+    """``lint --kernels``: (findings, notes, fresh rows). Fresh rows
+    ride back so the CLI can write them next to a failing baseline."""
+    fresh, findings, notes, skipped = kernel_rows(tpu_gen, tol)
+    baseline = read_ledger(baseline_path)
+    if not baseline:
+        notes.append(
+            f"baseline ledger {baseline_path} missing or empty; every "
+            "kernel row below reports unbaselined"
+        )
+    cmp_findings, cmp_notes = compare_kernels(baseline, fresh, tol, skipped)
+    return findings + cmp_findings, notes + cmp_notes, fresh
+
+
+def feasibility_lines(
+    tpu_gen: Optional[str] = None, tol: float = COST_TOLERANCE
+) -> List[str]:
+    """The ``tpu_session.sh`` preflight feed: one
+    ``step:<tag> kernel=<k> shape=<s> gen=<g> verdict=<v>`` line per
+    (session step, kernel cell) pair at the selected generation.
+    Underivable cells report ``verdict=unverified`` (the preflight
+    aborts only on ``infeasible``)."""
+    gen = tpu_gen or DEFAULT_GEN
+    rows, _, notes, skipped = kernel_rows(gen, tol)
+    lines = []
+    for row in rows:
+        if row.get("gen") != gen or not row.get("steps"):
+            continue
+        kernel, shape = row["entry"].rsplit("@", 1)[0].split("[", 1)
+        mib = row["metrics"]["vmem_bytes"] / 2**20
+        for step in row["steps"]:
+            lines.append(
+                f"step:{step} kernel={kernel} shape={shape.rstrip(']')} "
+                f"gen={gen} verdict={row['verdict']} vmem_mib={mib:.2f}"
+            )
+    seen_skipped = {e.rsplit("@", 1)[0] for e in skipped}
+    for cell in kernel_cells():
+        if cell.entry in seen_skipped and cell.steps:
+            kernel, shape = cell.entry.split("[", 1)
+            for step in cell.steps:
+                lines.append(
+                    f"step:{step} kernel={kernel} "
+                    f"shape={shape.rstrip(']')} gen={gen} "
+                    "verdict=unverified vmem_mib=nan"
+                )
+    return sorted(lines)

@@ -1,0 +1,251 @@
+"""The ONE grid-arithmetic core behind every Pallas kernel's byte math.
+
+Two things live here, deliberately jax-free (numpy + dataclasses only,
+so ``lint --kernels`` and the TPU-session preflight can price every
+queued shape without touching a backend):
+
+1. **The introspectable kernel-plan datatype.** Every Pallas module
+   (:mod:`.pallas_consensus`, :mod:`.pallas_fit`, :mod:`.pallas_serve`,
+   :mod:`.pallas_aggregation`) exports a ``kernel_plan()`` seam that
+   returns a :class:`KernelPlan`: the launch grid plus one
+   :class:`BlockOperand` per input/output/live-scratch array — block
+   shape, dtype, memory space (VMEM tile vs SMEM scalar-prefetch),
+   which grid axes the index map varies with, and which block dims are
+   CHOSEN tile sizes (vs problem-determined). The launch wrappers build
+   their real ``pl.BlockSpec`` lists FROM the plan (``index_map`` rides
+   along on each operand), so the lint arm and ``pallas_call`` consume
+   one derivation — a plan that drifts from the kernel breaks the
+   kernel, not just the audit.
+
+2. **The shared traffic core + the committed closed-form DMA models.**
+   :func:`plan_dma_bytes` prices a plan's HBM traffic from pure grid
+   arithmetic under the plan's refetch discipline (``'always'``: every
+   pipelined block is re-DMAd each grid step — the conservative reading
+   the consensus/serve models commit to; ``'on_change'``: a block is
+   re-fetched only when its index-map output changes between
+   consecutive steps — the revisit-aware reading the fit scan model
+   commits to). The three historically copy-pasted ``*_dma_bytes``
+   helpers are consolidated below as closed forms over the same tile
+   arithmetic (:func:`consensus_model_bytes`,
+   :func:`sparse_consensus_model_bytes`, :func:`serve_model_bytes`);
+   the ops modules' public helpers delegate here bitwise. ``lint
+   --kernels`` re-derives each closed form from the plan via
+   :func:`plan_dma_bytes` and fires ``kernel-dma-model-drift`` when
+   model and derivation disagree — the models are verified, not
+   asserted.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+#: TPU vector lane width — the trailing-axis tile quantum every kernel
+#: here pads its flat column axis to.
+LANES = 128
+
+
+def pad_to_tile(n: int, tile: int) -> int:
+    """``n`` rounded up to a multiple of ``tile`` (the column padding
+    every flat-block kernel applies before reshaping to lanes)."""
+    return ((n + tile - 1) // tile) * tile
+
+
+def tile_rows(batch: int, block_b: int) -> int:
+    """The largest tile height <= ``block_b`` dividing ``batch`` — an
+    exact grid with no padded rows (the serve kernel's batch tiling)."""
+    bb = max(1, min(block_b, batch))
+    while batch % bb:
+        bb -= 1
+    return bb
+
+
+@dataclass(frozen=True)
+class BlockOperand:
+    """One pipelined array of a Pallas launch, as the plan sees it.
+
+    ``block_shape`` is the per-grid-step block; ``varies`` marks, per
+    grid axis, whether the operand's index map depends on it (all-False
+    = a broadcast block); ``memory`` is ``'vmem'`` for pipelined tiles
+    and ``'smem'`` for scalar-prefetch operands (DMAd once per launch,
+    resident in scalar memory); ``tiled_dims`` are the block-shape
+    positions holding a CHOSEN tile size (``block_rows``, ``block_b``)
+    rather than a problem-determined extent — the dims the
+    dtype-packing lint rule applies to. ``index_map`` is the actual
+    callable the launch hands to ``pl.BlockSpec`` (ignored by the
+    arithmetic; ``None`` for scratch entries).
+    """
+
+    name: str
+    block_shape: Tuple[int, ...]
+    dtype: str
+    varies: Tuple[bool, ...]
+    memory: str = "vmem"
+    tiled_dims: Tuple[int, ...] = ()
+    index_map: Optional[Callable] = field(default=None, compare=False)
+
+    def block_bytes(self) -> int:
+        # static block shapes by construction — host shape arithmetic
+        return int(  # lint: disable=host-sync
+            math.prod(self.block_shape) * np.dtype(self.dtype).itemsize
+        )
+
+
+@dataclass(frozen=True)
+class KernelPlan:
+    """A Pallas launch, statically: grid + every operand's block plan.
+
+    ``scratch`` entries are the kernel-local live set (gathered row
+    copies, trim-selection registers, gradient/accumulator arrays) —
+    they never DMA but they occupy VMEM alongside the pipelined blocks,
+    so the residency model counts them. ``refetch`` is the traffic
+    discipline the kernel's committed byte model uses (module
+    docstring).
+    """
+
+    name: str
+    grid: Tuple[int, ...]
+    inputs: Tuple[BlockOperand, ...]
+    outputs: Tuple[BlockOperand, ...]
+    scratch: Tuple[BlockOperand, ...] = ()
+    refetch: str = "always"
+
+    def grid_steps(self) -> int:
+        # static launch grid by construction — host shape arithmetic
+        return int(math.prod(self.grid))  # lint: disable=host-sync
+
+
+def operand_fetches(
+    grid: Tuple[int, ...], varies: Tuple[bool, ...], refetch: str
+) -> int:
+    """How many times one pipelined operand's block is DMAd over the
+    whole grid. ``'always'``: once per grid step (Mosaic's worst case —
+    broadcast blocks re-read every step). ``'on_change'``: once per
+    step at which the index-map output differs from the previous step,
+    under the lexicographic traversal (last grid axis fastest) — a
+    block varying only with outer axes is fetched once per outer
+    iteration, however many inner steps revisit it."""
+    # grids are static python tuples — host shape arithmetic throughout
+    steps = int(math.prod(grid))  # lint: disable=host-sync
+    if refetch == "always" or not grid:
+        return max(1, steps)
+    if not any(varies):
+        return 1
+    last_varying = max(i for i, v in enumerate(varies) if v)
+    trailing = int(math.prod(grid[last_varying + 1 :]))  # lint: disable=host-sync
+    return max(1, steps // trailing)
+
+
+def plan_dma_bytes(plan: KernelPlan) -> float:
+    """The launch's total HBM traffic in bytes, from the plan's grid
+    arithmetic alone: every VMEM operand pays ``block_bytes x fetches``
+    under the plan's refetch discipline (outputs are written on the
+    same schedule their index maps revolve on); every SMEM
+    scalar-prefetch operand pays ONE DMA per launch."""
+    total = 0.0
+    for op in plan.inputs + plan.outputs:
+        if op.memory == "smem":
+            total += float(op.block_bytes())
+            continue
+        total += float(op.block_bytes()) * operand_fetches(
+            plan.grid, op.varies, plan.refetch
+        )
+    return total
+
+
+# --------------------------------------------------------------------------
+# The committed closed-form models (the ops wrappers delegate here)
+# --------------------------------------------------------------------------
+
+
+def consensus_model_bytes(
+    n_agents: int,
+    n_in: int,
+    n_trunk: int,
+    *,
+    active: bool = False,
+    has_stale: bool = False,
+    block_rows: int = 8,
+) -> float:
+    """The fused dense-consensus kernel's HBM traffic: every input tile
+    DMAd once per grid step, the output written once, broadcast fault
+    planes (masks + sign planes) counted once PER GRID STEP — the
+    conservative ``refetch='always'`` reading. Bitwise the historical
+    ``pallas_consensus.fused_consensus_dma_bytes``."""
+    tile = block_rows * LANES
+    padded = pad_to_tile(n_trunk, tile)
+    n_tiles = padded // tile
+    bytes_total = n_agents * padded * 4.0  # messages read
+    bytes_total += n_agents * padded * 4.0  # aggregate written
+    if active:
+        if has_stale:
+            bytes_total += n_agents * padded * 4.0  # stale-replay read
+        masks_bytes = (2 * 4 * n_agents * n_in + 2 * n_agents * n_in) * 4.0
+        bytes_total += masks_bytes * n_tiles  # re-DMAd per tile
+    return bytes_total
+
+
+def sparse_consensus_model_bytes(
+    n_agents: int,
+    degree: int,
+    n_trunk: int,
+    *,
+    active: bool = False,
+    has_stale: bool = False,
+    block_rows: int = 8,
+) -> float:
+    """The SPARSE (traced-graph) consensus launch: the dense kernel's
+    tile DMAs plus ONE ``(N, degree)`` int32 scalar-prefetch DMA of the
+    schedule block. Bitwise the historical
+    ``pallas_consensus.sparse_fused_dma_bytes``."""
+    return (
+        consensus_model_bytes(
+            n_agents,
+            degree,
+            n_trunk,
+            active=active,
+            has_stale=has_stale,
+            block_rows=block_rows,
+        )
+        + n_agents * degree * 4.0
+    )
+
+
+def serve_model_bytes(
+    n_agents: int,
+    obs_dim: int,
+    hidden: Tuple[int, ...],
+    n_actions: int,
+    batch: int,
+    *,
+    mode: str = "sample",
+    n_members: int = 0,
+    block_b: int = 128,
+) -> float:
+    """The fused serve/fleet kernel's HBM traffic: observation tiles
+    once per request row, the broadcast actor block + key words once
+    per grid step, action/probability tiles written once. Bitwise the
+    historical ``pallas_serve.fused_serve_dma_bytes``."""
+    dims = [obs_dim, *hidden, n_actions]
+    bb = tile_rows(batch, block_b)
+    n_tiles = batch // bb
+    stack = max(1, n_members) * n_agents
+    param_bytes = (
+        sum(
+            (d_in * d_out + d_out) * 4.0
+            for d_in, d_out in zip(dims[:-1], dims[1:])
+        )
+        * stack
+    )
+    bytes_total = batch * n_agents * dims[0] * 4.0  # observations read once
+    bytes_total += param_bytes * n_tiles  # block re-DMAd per tile
+    bytes_total += batch * n_agents * 4.0  # actions written
+    bytes_total += batch * n_agents * dims[-1] * 4.0  # probs written
+    if n_members:
+        bytes_total += batch * 4.0  # route read
+    if mode == "sample":
+        bytes_total += 8.0 * n_tiles  # key words per tile
+    return bytes_total

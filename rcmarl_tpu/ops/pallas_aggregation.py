@@ -65,6 +65,7 @@ from rcmarl_tpu.ops.aggregation import (
     _sorting_network,
     ravel_neighbor_tree,
 )
+from rcmarl_tpu.ops.dma_model import BlockOperand, KernelPlan, pad_to_tile
 
 _LANES = 128
 
@@ -101,6 +102,72 @@ def _select_bounds(rows, H: int):
 
 
 _BOUNDS = {"select": _select_bounds, "sort": _sort_bounds}
+
+
+def kernel_plan(
+    n_in: int,
+    flat_cols: int,
+    H: int,
+    *,
+    variant: str = "select",
+    block_rows: int | None = None,
+    sanitize: bool = False,
+) -> KernelPlan:
+    """The leaf-aggregation launch's static BlockSpec plan — the ONE
+    derivation both :func:`fused_resilient_aggregate` (which builds its
+    ``pl.BlockSpec`` list from these operands) and ``lint --kernels``
+    consume. ``flat_cols`` is the raveled trailing-axis width the tile
+    grid covers. ``scratch`` is the variant's extra in-tile live set
+    beyond the input block: the selection kernel's ``2(H+1)`` running
+    registers (or the sorting network's full n_in-row sorted copy) plus
+    the accumulator, with the ±inf sentinel sinks and the finite-count
+    row riding along under sanitize. This kernel carries no committed
+    DMA model — the lint arm prices residency and tiling only.
+    """
+    if block_rows is None:
+        block_rows = _DEFAULT_BLOCK_ROWS[variant]
+    tile = block_rows * _LANES
+    rows_total = pad_to_tile(flat_cols, tile) // _LANES
+    grid = (rows_total // block_rows,)
+    inputs = (
+        BlockOperand(
+            "values",
+            (n_in, block_rows, _LANES),
+            "float32",
+            (True,),
+            tiled_dims=(1, 2),
+            index_map=lambda i: (0, i, 0),
+        ),
+    )
+    outputs = (
+        BlockOperand(
+            "aggregate",
+            (block_rows, _LANES),
+            "float32",
+            (True,),
+            tiled_dims=(0, 1),
+            index_map=lambda i: (i, 0),
+        ),
+    )
+    live_rows = (n_in if variant == "sort" else 2 * (H + 1)) + 1
+    if sanitize:
+        live_rows += 2 * n_in + 1
+    scratch = (
+        BlockOperand(
+            "bounds_live_set",
+            (live_rows, block_rows, _LANES),
+            "float32",
+            (False,),
+        ),
+    )
+    return KernelPlan(
+        name=f"aggregation_{variant}",
+        grid=grid,
+        inputs=inputs,
+        outputs=outputs,
+        scratch=scratch,
+        refetch="always",
+    )
 
 
 def _agg_kernel(vals_ref, out_ref, *, n_in: int, H: int, bounds):
@@ -207,7 +274,11 @@ def fused_resilient_aggregate(
         flat = jnp.pad(flat, ((0, 0), (0, padded - m)))
     rows_total = padded // _LANES
     v3 = flat.reshape(n_in, rows_total, _LANES)
-    grid = (rows_total // block_rows,)
+    # the pl.BlockSpec list is BUILT from the introspectable plan — one
+    # derivation for launch and lint alike
+    launch_plan = kernel_plan(
+        n_in, m, H, variant=variant, block_rows=block_rows, sanitize=sanitize
+    )
     if sanitize:
         kernel = functools.partial(
             _sanitized_agg_kernel, n_in=n_in, H=H, variant=variant
@@ -216,14 +287,13 @@ def fused_resilient_aggregate(
         kernel = functools.partial(
             _agg_kernel, n_in=n_in, H=H, bounds=_BOUNDS[variant]
         )
+    in_op, out_op = launch_plan.inputs[0], launch_plan.outputs[0]
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((rows_total, _LANES), jnp.float32),
-        in_specs=[
-            pl.BlockSpec((n_in, block_rows, _LANES), lambda i: (0, i, 0))
-        ],
-        out_specs=pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
-        grid=grid,
+        in_specs=[pl.BlockSpec(in_op.block_shape, in_op.index_map)],
+        out_specs=pl.BlockSpec(out_op.block_shape, out_op.index_map),
+        grid=launch_plan.grid,
         interpret=interpret,
     )(v3)
     return out.reshape(-1)[:m].reshape(out_shape).astype(values.dtype)

@@ -87,6 +87,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 from rcmarl_tpu.faults import FaultPlan, _link_masks
 from rcmarl_tpu.ops.aggregation import _running_large, _running_small
+from rcmarl_tpu.ops.dma_model import (
+    BlockOperand,
+    KernelPlan,
+    consensus_model_bytes,
+    pad_to_tile,
+    sparse_consensus_model_bytes,
+)
 
 _LANES = 128
 
@@ -395,6 +402,136 @@ def _pad_cols(x, tile):
     return x, padded
 
 
+def kernel_plan(
+    n_agents: int,
+    n_in: int,
+    n_trunk: int,
+    *,
+    active: bool = False,
+    has_stale: bool = False,
+    traced_h: bool = False,
+    sparse: bool = False,
+    trim_h: int = 1,
+    sanitize: bool = False,
+    block_rows: int = _DEFAULT_BLOCK_ROWS,
+) -> KernelPlan:
+    """The launch's static BlockSpec plan — the ONE derivation both
+    :func:`fused_pair_consensus` (which builds its ``pl.BlockSpec`` list
+    from these operands) and ``lint --kernels`` (which prices residency
+    and re-derives the committed DMA model from them) consume.
+
+    Operands ride in launch order: ``[schedule_idx (sparse only)]``,
+    ``msgs``, ``[stale]``, ``[fault_masks, inf_sign]`` (active plans),
+    ``[trim_h]`` (traced H). ``scratch`` is the kernel's in-register
+    live set per grid step: the ``n_in`` gathered rows (×3 under
+    sanitize — the ±inf sentinel sink copies), the trim chain's
+    register pairs (``trim_h + 1`` per side static, the full legal
+    ``k_max`` range traced), and the accumulator row.
+    """
+    tile = block_rows * _LANES
+    rows_total = pad_to_tile(n_trunk, tile) // _LANES
+    grid = (rows_total // block_rows,)
+
+    def _tile_map(i, *_):
+        return (0, i, 0)
+
+    tile_shape = (n_agents, block_rows, _LANES)
+    inputs = []
+    if sparse:
+        inputs.append(
+            BlockOperand(
+                "schedule_idx",
+                (n_agents, n_in),
+                "int32",
+                (False,),
+                memory="smem",
+            )
+        )
+    inputs.append(
+        BlockOperand(
+            "msgs",
+            tile_shape,
+            "float32",
+            (True,),
+            tiled_dims=(1, 2),
+            index_map=_tile_map,
+        )
+    )
+    if has_stale:
+        inputs.append(
+            BlockOperand(
+                "stale",
+                tile_shape,
+                "float32",
+                (True,),
+                tiled_dims=(1, 2),
+                index_map=_tile_map,
+            )
+        )
+    if active:
+        inputs.append(
+            BlockOperand(
+                "fault_masks",
+                (2, 4, n_agents, n_in),
+                "float32",
+                (False,),
+                index_map=lambda i, *_: (0, 0, 0, 0),
+            )
+        )
+        inputs.append(
+            BlockOperand(
+                "inf_sign",
+                (2, n_agents, n_in),
+                "float32",
+                (False,),
+                index_map=lambda i, *_: (0, 0, 0),
+            )
+        )
+    if traced_h:
+        inputs.append(
+            BlockOperand(
+                "trim_h",
+                (1, 1),
+                "int32",
+                (False,),
+                index_map=lambda i, *_: (0, 0),
+            )
+        )
+    outputs = (
+        BlockOperand(
+            "aggregate",
+            tile_shape,
+            "float32",
+            (True,),
+            tiled_dims=(1, 2),
+            index_map=_tile_map,
+        ),
+    )
+    # trim_h is a host int on this branch (callers pass 1 for traced H)
+    k_regs = (
+        ((n_in - 1) // 2 + 1)
+        if traced_h
+        else (int(trim_h) + 1)  # lint: disable=host-sync
+    )
+    live_rows = n_in * (3 if sanitize else 1) + 2 * k_regs + 1
+    scratch = (
+        BlockOperand(
+            "epilogue_live_set",
+            (live_rows, block_rows, _LANES),
+            "float32",
+            (False,),
+        ),
+    )
+    return KernelPlan(
+        name="sparse_consensus" if sparse else "fused_consensus",
+        grid=grid,
+        inputs=tuple(inputs),
+        outputs=outputs,
+        scratch=scratch,
+        refetch="always",
+    )
+
+
 def fused_pair_consensus(
     msgs: jnp.ndarray,
     H,
@@ -489,38 +626,46 @@ def fused_pair_consensus(
     if active and fields is None:
         raise ValueError("an active FaultPlan needs precomputed FaultFields")
 
+    launch_plan = kernel_plan(
+        N,
+        n_in,
+        P,
+        active=active,
+        has_stale=has_stale,
+        traced_h=traced_h,
+        sparse=sparse,
+        trim_h=1 if traced_h else int(H),
+        sanitize=sanitize,
+        block_rows=block_rows,
+    )
     tile = block_rows * _LANES
     flat, padded = _pad_cols(msgs.astype(jnp.float32), tile)
     rows_total = padded // _LANES
     v3 = flat.reshape(N, rows_total, _LANES)
-    grid = (rows_total // block_rows,)
+    grid = launch_plan.grid
 
-    # index maps take (*grid, *scalar_refs) under the scalar-prefetch
-    # grid spec — the trailing *_ keeps one set of specs for both paths
-    inputs = [v3]
+    # the pl.BlockSpec list is BUILT from the introspectable plan — one
+    # derivation for launch and lint alike. Index maps take (*grid,
+    # *scalar_refs) under the scalar-prefetch grid spec (the trailing
+    # *_ keeps one set of maps for both paths); the plan's smem entry
+    # is the scalar-prefetch operand, passed positionally ahead of the
+    # tiles rather than through in_specs.
     in_specs = [
-        pl.BlockSpec((N, block_rows, _LANES), lambda i, *_: (0, i, 0))
+        pl.BlockSpec(op.block_shape, op.index_map)
+        for op in launch_plan.inputs
+        if op.memory == "vmem"
     ]
+    inputs = [v3]
     if has_stale:
-        s3 = _pad_cols(stale.astype(jnp.float32), tile)[0].reshape(
-            N, rows_total, _LANES
-        )
-        inputs.append(s3)
-        in_specs.append(
-            pl.BlockSpec((N, block_rows, _LANES), lambda i, *_: (0, i, 0))
+        inputs.append(
+            _pad_cols(stale.astype(jnp.float32), tile)[0].reshape(
+                N, rows_total, _LANES
+            )
         )
     if active:
-        inputs.append(fields.masks)
-        in_specs.append(
-            pl.BlockSpec(fields.masks.shape, lambda i, *_: (0, 0, 0, 0))
-        )
-        inputs.append(fields.inf_sign)
-        in_specs.append(
-            pl.BlockSpec(fields.inf_sign.shape, lambda i, *_: (0, 0, 0))
-        )
+        inputs.extend([fields.masks, fields.inf_sign])
     if traced_h:
         inputs.append(jnp.asarray(H, jnp.int32).reshape(1, 1))
-        in_specs.append(pl.BlockSpec((1, 1), lambda i, *_: (0, 0)))
 
     valid_rows = (
         None
@@ -542,7 +687,8 @@ def fused_pair_consensus(
         has_stale=has_stale,
     )
     out_shape = jax.ShapeDtypeStruct((N, rows_total, _LANES), jnp.float32)
-    out_spec = pl.BlockSpec((N, block_rows, _LANES), lambda i, *_: (0, i, 0))
+    out_op = launch_plan.outputs[0]
+    out_spec = pl.BlockSpec(out_op.block_shape, out_op.index_map)
     if sparse:
         # the schedule block rides as the scalar-prefetch operand: DMAd
         # to SMEM once per launch, ahead of the first tile's data DMAs
@@ -587,18 +733,19 @@ def fused_consensus_dma_bytes(
     once — deterministic arithmetic, not an estimate (the honesty tag
     on the ledger row is ``bytes_model: 'pallas-blockspec-dma'``).
     Broadcast inputs (masks, sign planes, the traced-H scalar) are
-    counted once PER GRID STEP — the conservative reading."""
-    tile = block_rows * _LANES
-    padded = ((n_trunk + tile - 1) // tile) * tile
-    n_tiles = padded // tile
-    bytes_total = n_agents * padded * 4.0  # messages read
-    bytes_total += n_agents * padded * 4.0  # aggregate written
-    if plan is not None and plan.active:
-        if float(plan.stale_p) > 0.0:
-            bytes_total += n_agents * padded * 4.0  # stale-replay read
-        masks_bytes = (2 * 4 * n_agents * n_in + 2 * n_agents * n_in) * 4.0
-        bytes_total += masks_bytes * n_tiles  # re-DMAd per tile
-    return bytes_total
+    counted once PER GRID STEP — the conservative reading. The closed
+    form lives in :func:`rcmarl_tpu.ops.dma_model.consensus_model_bytes`
+    (the consolidated grid-arithmetic core); ``lint --kernels``
+    re-derives it from :func:`kernel_plan` and gates the drift."""
+    active = plan is not None and plan.active
+    return consensus_model_bytes(
+        n_agents,
+        n_in,
+        n_trunk,
+        active=active,
+        has_stale=active and float(plan.stale_p) > 0.0,
+        block_rows=block_rows,
+    )
 
 
 def sparse_fused_dma_bytes(
@@ -614,12 +761,16 @@ def sparse_fused_dma_bytes(
     ahead of the grid, not re-read per tile. Same deterministic
     BlockSpec arithmetic, same ``bytes_model: 'pallas-blockspec-dma'``
     honesty tag; the ``(N, deg, P)`` gathered block the XLA sparse
-    chain materializes never appears in either term."""
-    return (
-        fused_consensus_dma_bytes(
-            n_agents, degree, n_trunk, plan, block_rows
-        )
-        + n_agents * degree * 4.0
+    chain materializes never appears in either term. Closed form:
+    :func:`rcmarl_tpu.ops.dma_model.sparse_consensus_model_bytes`."""
+    active = plan is not None and plan.active
+    return sparse_consensus_model_bytes(
+        n_agents,
+        degree,
+        n_trunk,
+        active=active,
+        has_stale=active and float(plan.stale_p) > 0.0,
+        block_rows=block_rows,
     )
 
 

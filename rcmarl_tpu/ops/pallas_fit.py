@@ -44,6 +44,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+import numpy as np
+
+from rcmarl_tpu.ops.dma_model import BlockOperand, KernelPlan
 from rcmarl_tpu.ops.fit import (
     FitSchedule,
     identity_plan,
@@ -149,6 +152,110 @@ def _fit_kernel(
     loss_ref[...] = first_loss.reshape(1, 1)
 
 
+def kernel_plan(
+    params_rows, x_rows, targets_rows, schedule: FitSchedule
+) -> KernelPlan:
+    """The fit scan's static BlockSpec plan — the ONE derivation both
+    :func:`pallas_fit_scan` (which builds its ``pl.BlockSpec`` lists
+    from these operands) and ``lint --kernels`` consume. Accepts real
+    arrays or ``jax.ShapeDtypeStruct`` leaves (only shapes/dtypes are
+    read), so the lint arm prices bench cells via ``jax.eval_shape``
+    without allocating a batch.
+
+    Grid ``(R, N)`` — one cell per (flavor-row, agent); each cell's
+    parameter leaves, target column, and plan rows vary with both axes,
+    while the fit-data block revisits every agent of a row
+    (``refetch='on_change'``: the model's traffic is fetch-on-index-
+    change, the revisit-aware reading :func:`fit_scan_hbm_bytes`
+    commits to). ``scratch`` is the in-cell live set: one gradient +
+    one updated-parameter copy of the cell's leaves, plus the two
+    ``(1, n_batches)`` epoch-0 loss/count rows.
+    """
+    leaves = jax.tree.leaves(params_rows)
+    R, N = leaves[0].shape[:2]
+    cap = x_rows.shape[1]
+    n_batches = math.ceil(cap / schedule.batch_size)
+    plan_shape = (schedule.epochs, n_batches, schedule.batch_size)
+
+    inputs = []
+    for i, leaf in enumerate(leaves):
+        nd = leaf.ndim - 2
+        inputs.append(
+            BlockOperand(
+                f"param_leaf_{i}",
+                (1, 1) + tuple(leaf.shape[2:]),
+                str(np.dtype(leaf.dtype)),
+                (True, True),
+                index_map=lambda r, n, nd=nd: (r, n) + (0,) * nd,
+            )
+        )
+    inputs.append(
+        BlockOperand(
+            "x_rows",
+            (1,) + tuple(x_rows.shape[1:]),
+            str(np.dtype(x_rows.dtype)),
+            (True, False),
+            index_map=lambda r, n: (r, 0, 0),
+        )
+    )
+    inputs.append(
+        BlockOperand(
+            "targets_rows",
+            (1, 1) + tuple(targets_rows.shape[2:]),
+            str(np.dtype(targets_rows.dtype)),
+            (True, True),
+            index_map=lambda r, n: (r, n, 0, 0),
+        )
+    )
+    for name, dt in (("plan_idx", "int32"), ("plan_bvalid", "float32")):
+        inputs.append(
+            BlockOperand(
+                name,
+                (1, 1) + plan_shape,
+                dt,
+                (True, True),
+                index_map=lambda r, n: (r, n, 0, 0, 0),
+            )
+        )
+    outputs = [
+        BlockOperand(
+            f"fitted_leaf_{i}",
+            op.block_shape,
+            op.dtype,
+            (True, True),
+            index_map=op.index_map,
+        )
+        for i, op in enumerate(inputs[: len(leaves)])
+    ]
+    outputs.append(
+        BlockOperand(
+            "first_epoch_loss",
+            (1, 1),
+            "float32",
+            (True, True),
+            index_map=lambda r, n: (r, n),
+        )
+    )
+    cell_bytes = sum(
+        int(math.prod(l.shape[2:])) * np.dtype(l.dtype).itemsize
+        for l in leaves
+    )
+    scratch = (
+        BlockOperand(
+            "grad_update_live_set", (2 * cell_bytes,), "uint8", (False, False)
+        ),
+        BlockOperand("loss_rows", (2, n_batches), "float32", (False, False)),
+    )
+    return KernelPlan(
+        name="fit_scan",
+        grid=(R, N),
+        inputs=tuple(inputs),
+        outputs=tuple(outputs),
+        scratch=scratch,
+        refetch="on_change",
+    )
+
+
 def pallas_fit_scan(
     keys,
     params_rows,
@@ -178,30 +285,17 @@ def pallas_fit_scan(
     leaves, treedef = jax.tree.flatten(params_rows)
     n_leaves = len(leaves)
 
-    def leaf_spec(leaf):
-        block = (1, 1) + leaf.shape[2:]
-        nd = len(leaf.shape) - 2
-        return pl.BlockSpec(
-            block, lambda r, n, nd=nd: (r, n) + (0,) * nd
-        )
-
-    in_specs = [leaf_spec(l) for l in leaves]
-    in_specs.append(
-        pl.BlockSpec((1,) + x_rows.shape[1:], lambda r, n: (r, 0, 0))
-    )
-    in_specs.append(
-        pl.BlockSpec(
-            (1, 1) + targets_rows.shape[2:], lambda r, n: (r, n, 0, 0)
-        )
-    )
-    for arr in (idx, bvalid):
-        in_specs.append(
-            pl.BlockSpec(
-                (1, 1) + arr.shape[2:], lambda r, n: (r, n, 0, 0, 0)
-            )
-        )
-    out_specs = [leaf_spec(l) for l in leaves]
-    out_specs.append(pl.BlockSpec((1, 1), lambda r, n: (r, n)))
+    # the pl.BlockSpec lists are BUILT from the introspectable plan —
+    # one derivation for launch and lint alike
+    launch_plan = kernel_plan(params_rows, x_rows, targets_rows, schedule)
+    in_specs = [
+        pl.BlockSpec(op.block_shape, op.index_map)
+        for op in launch_plan.inputs
+    ]
+    out_specs = [
+        pl.BlockSpec(op.block_shape, op.index_map)
+        for op in launch_plan.outputs
+    ]
     out_shape = [
         jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves
     ] + [jax.ShapeDtypeStruct((R, N), jnp.float32)]
@@ -222,7 +316,7 @@ def pallas_fit_scan(
         out_shape=tuple(out_shape),
         in_specs=in_specs,
         out_specs=tuple(out_specs),
-        grid=(R, N),
+        grid=launch_plan.grid,
         interpret=interpret,
     )(*leaves, x_rows, targets_rows, idx, bvalid)
     fitted = jax.tree.unflatten(treedef, list(outs[:-1]))

@@ -64,6 +64,12 @@ from jax.experimental import pallas as pl
 
 from rcmarl_tpu.config import Config
 from rcmarl_tpu.models.mlp import MLPParams, actor_probs, pad_features
+from rcmarl_tpu.ops.dma_model import (
+    BlockOperand,
+    KernelPlan,
+    serve_model_bytes,
+    tile_rows,
+)
 
 #: The serve implementation arms. 'auto' is the measured policy
 #: (:func:`resolve_serve_impl`); 'pallas_interpret' is the CPU test arm
@@ -266,11 +272,133 @@ def _serve_kernel(
 
 def _tile_rows(batch: int, block_b: int) -> int:
     """The largest tile height <= ``block_b`` dividing ``batch`` (an
-    exact grid — no padded request rows, exact DMA arithmetic)."""
-    bb = max(1, min(block_b, batch))
-    while batch % bb:
-        bb -= 1
-    return bb
+    exact grid — no padded request rows, exact DMA arithmetic). The
+    arithmetic lives in :func:`rcmarl_tpu.ops.dma_model.tile_rows`, the
+    consolidated grid-arithmetic core."""
+    return tile_rows(batch, block_b)
+
+
+def kernel_plan(
+    block: MLPParams,
+    batch: int,
+    n_agents: int,
+    *,
+    mode: str = "sample",
+    fleet: bool = False,
+    block_b: int = _DEFAULT_BLOCK_B,
+) -> KernelPlan:
+    """The serve launch's static BlockSpec plan — the ONE derivation
+    both :func:`_fused_serve` (which builds its ``pl.BlockSpec`` lists
+    from these operands) and ``lint --kernels`` consume. ``block``
+    takes real arrays or ``jax.ShapeDtypeStruct`` leaves (only
+    shapes/dtypes are read), so the lint arm prices serve cells via
+    ``jax.eval_shape`` of the stacked init without allocating a fleet.
+
+    Operands in launch order: the broadcast actor/fleet leaves (full
+    shape, re-DMAd every grid step — ``refetch='always'``, the
+    conservative reading the committed model commits to), the
+    ``(bb, N, W)`` observation tile, ``[route]`` (fleet), ``[key
+    words]`` (sample). ``scratch`` is the tile's live activation set
+    (two ping-pong layers at the widest dim) plus, on the fleet path,
+    the all-members probability block the route gathers from.
+    """
+    leaves = jax.tree.leaves(block)
+    width = leaves[0].shape[-2]
+    n_actions = block[-1][1].shape[-1]
+    bb = tile_rows(batch, block_b)
+    grid = (batch // bb,)
+
+    inputs = []
+    for i, l in enumerate(leaves):
+        inputs.append(
+            BlockOperand(
+                f"actor_leaf_{i}",
+                tuple(l.shape),
+                str(np.dtype(l.dtype)),
+                (False,),
+                index_map=functools.partial(
+                    lambda nd, i: (0,) * nd, l.ndim
+                ),
+            )
+        )
+    inputs.append(
+        BlockOperand(
+            "obs_tile",
+            (bb, n_agents, width),
+            "float32",
+            (True,),
+            tiled_dims=(0,),
+            index_map=lambda i: (i, 0, 0),
+        )
+    )
+    if fleet:
+        inputs.append(
+            BlockOperand(
+                "route",
+                (bb,),
+                "int32",
+                (True,),
+                tiled_dims=(0,),
+                index_map=lambda i: (i,),
+            )
+        )
+    if mode == "sample":
+        inputs.append(
+            BlockOperand(
+                "key_words",
+                (1, 2),
+                "uint32",
+                (False,),
+                index_map=lambda i: (0, 0),
+            )
+        )
+    outputs = (
+        BlockOperand(
+            "actions",
+            (bb, n_agents),
+            "int32",
+            (True,),
+            tiled_dims=(0,),
+            index_map=lambda i: (i, 0),
+        ),
+        BlockOperand(
+            "probs",
+            (bb, n_agents, n_actions),
+            "float32",
+            (True,),
+            tiled_dims=(0,),
+            index_map=lambda i: (i, 0, 0),
+        ),
+    )
+    max_width = max(
+        [width, n_actions] + [int(l.shape[-1]) for l in leaves]
+    )
+    scratch = [
+        BlockOperand(
+            "activations_live_set",
+            (2, bb, n_agents, max_width),
+            "float32",
+            (False,),
+        )
+    ]
+    if fleet:
+        n_members = leaves[0].shape[0]
+        scratch.append(
+            BlockOperand(
+                "fleet_probs_all",
+                (n_members, bb, n_agents, n_actions),
+                "float32",
+                (False,),
+            )
+        )
+    return KernelPlan(
+        name="fused_fleet" if fleet else "fused_serve",
+        grid=grid,
+        inputs=tuple(inputs),
+        outputs=outputs,
+        scratch=tuple(scratch),
+        refetch="always",
+    )
 
 
 def _key_words(key: jax.Array) -> jnp.ndarray:
@@ -306,19 +434,21 @@ def _fused_serve(
     grid = (B // bb,)
 
     leaves, treedef = jax.tree.flatten(block)
-    inputs = list(leaves)
+    # the pl.BlockSpec lists are BUILT from the introspectable plan —
+    # one derivation for launch and lint alike
+    launch_plan = kernel_plan(
+        block, B, N, mode=mode, fleet=fleet, block_b=block_b
+    )
     in_specs = [
-        pl.BlockSpec(l.shape, functools.partial(lambda nd, i: (0,) * nd, l.ndim))
-        for l in leaves
+        pl.BlockSpec(op.block_shape, op.index_map)
+        for op in launch_plan.inputs
     ]
+    inputs = list(leaves)
     inputs.append(x)
-    in_specs.append(pl.BlockSpec((bb, N, x.shape[-1]), lambda i: (i, 0, 0)))
     if fleet:
         inputs.append(route.astype(jnp.int32))
-        in_specs.append(pl.BlockSpec((bb,), lambda i: (i,)))
     if mode == "sample":
         inputs.append(_key_words(key))
-        in_specs.append(pl.BlockSpec((1, 2), lambda i: (0, 0)))
 
     kernel = functools.partial(
         _serve_kernel,
@@ -337,11 +467,11 @@ def _fused_serve(
             jax.ShapeDtypeStruct((B, N, n_actions), jnp.float32),
         ),
         in_specs=in_specs,
-        out_specs=(
-            pl.BlockSpec((bb, N), lambda i: (i, 0)),
-            pl.BlockSpec((bb, N, n_actions), lambda i: (i, 0, 0)),
+        out_specs=tuple(
+            pl.BlockSpec(op.block_shape, op.index_map)
+            for op in launch_plan.outputs
         ),
-        grid=grid,
+        grid=launch_plan.grid,
         interpret=interpret,
     )(*inputs)
     return actions, probs
@@ -425,22 +555,17 @@ def fused_serve_dma_bytes(
     probability re-read — is exactly the fused win the
     ``serve_path[pallas_fused]`` ledger row claims. Deterministic
     arithmetic, not an estimate (``bytes_model:
-    'pallas-blockspec-dma'``)."""
-    N = cfg.n_agents
-    dims = [cfg.obs_dim, *cfg.hidden, cfg.n_actions]
-    bb = _tile_rows(batch, block_b)
-    n_tiles = batch // bb
-    stack = max(1, n_members) * N
-    param_bytes = sum(
-        (d_in * d_out + d_out) * 4.0
-        for d_in, d_out in zip(dims[:-1], dims[1:])
-    ) * stack
-    bytes_total = batch * N * dims[0] * 4.0  # observations read once
-    bytes_total += param_bytes * n_tiles  # block re-DMAd per tile
-    bytes_total += batch * N * 4.0  # actions written
-    bytes_total += batch * N * dims[-1] * 4.0  # probs written
-    if n_members:
-        bytes_total += batch * 4.0  # route read
-    if mode == "sample":
-        bytes_total += 8.0 * n_tiles  # key words per tile
-    return bytes_total
+    'pallas-blockspec-dma'``). The closed form lives in
+    :func:`rcmarl_tpu.ops.dma_model.serve_model_bytes` (the
+    consolidated grid-arithmetic core); ``lint --kernels`` re-derives
+    it from :func:`kernel_plan` and gates the drift."""
+    return serve_model_bytes(
+        cfg.n_agents,
+        cfg.obs_dim,
+        tuple(cfg.hidden),
+        cfg.n_actions,
+        batch,
+        mode=mode,
+        n_members=n_members,
+        block_b=block_b,
+    )

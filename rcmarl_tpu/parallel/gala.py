@@ -60,6 +60,15 @@ takes effect at the next segment precisely as it would on resume (the
 solo pipeline applies in-run folds only at resume too; within a
 segment the dispatch chain stays unperturbed, the solo contract).
 
+**Lint posture.** The module is in the hot-path set so the traced-value
+rules bind on its jitted entries (:data:`gala_mix_block`, the dispatched
+solo blocks). The ORCHESTRATION loop around them is host code whose
+device->host pulls are the design: segment-boundary guard decisions
+(finiteness, quarantine, winner selection) and the ``df.attrs`` ledgers
+must read device diagnostics on the host between jitted segments, and
+``PRNGKey(seed)`` mints per-replica roots outside any trace. Those
+lines carry per-line pragma waivers.
+
 **Degenerate arms delegate.** ``pipeline_depth == 0`` IS the
 synchronous gossip trainer (:func:`~rcmarl_tpu.parallel.gossip.
 train_gossip` — and with ``gossip_every == 0`` therefore bitwise the
@@ -147,7 +156,7 @@ def gala_fingerprint(cfg: Config) -> str:
 
     params = tuple(
         jax.eval_shape(
-            lambda k: init_train_state(cfg, k).params, jax.random.PRNGKey(0)
+            lambda k: init_train_state(cfg, k).params, jax.random.PRNGKey(0)  # lint: disable=prng-int-seed
         )
         for _ in range(cfg.replicas)
     )
@@ -326,7 +335,7 @@ def train_gala(
             "nonfinite": 0, "deficit": 0, "replicas": 1,
             "gossip_every": cfg.gossip_every, "graph": cfg.gossip_graph,
             "mix": cfg.gossip_mix, "H": cfg.gossip_H, "byzantine": [],
-            "replica_healthy": [True], "gossip_round": int(start_round),
+            "replica_healthy": [True], "gossip_round": int(start_round),  # lint: disable=host-sync
             "excluded_mask": [0], "readmit_after": readmit_after,
             "quarantined": [0],
         }
@@ -355,7 +364,7 @@ def train_gala(
 
     if states is None:
         state = [
-            init_train_state(cfg, jax.random.PRNGKey(s))
+            init_train_state(cfg, jax.random.PRNGKey(s))  # lint: disable=prng-int-seed
             for s in replica_seeds(cfg)
         ]
     else:
@@ -367,12 +376,12 @@ def train_gala(
     byz = set(plan.byzantine_replicas) if plan is not None else set()
     stale_replay = plan is not None and plan.active and float(plan.stale_p) > 0
     carried = (
-        np.zeros(R, bool) if excluded is None else np.asarray(excluded, bool)
+        np.zeros(R, bool) if excluded is None else np.asarray(excluded, bool)  # lint: disable=host-sync
     )
     excluded_mask = carried if readmit_after == 0 else np.zeros(R, bool)
     quarantine = carried.copy() if readmit_after > 0 else np.zeros(R, bool)
     streak = np.zeros(R, np.int64)
-    round_idx = int(start_round)
+    round_idx = int(start_round)  # lint: disable=host-sync
 
     # ---- per-replica pipeline plumbing (the solo trainer's, times R)
     publisher = [
@@ -539,8 +548,8 @@ def train_gala(
                     accepted = False
                     break
             if diag is not None:
-                stats["nonfinite"] += int(diag.nonfinite)
-                stats["deficit"] += int(diag.deficit)
+                stats["nonfinite"] += int(diag.nonfinite)  # lint: disable=host-sync
+                stats["deficit"] += int(diag.deficit)  # lint: disable=host-sync
             seg_metrics.append(m)
             all_metrics[r].append(m)
             if accepted:
@@ -558,7 +567,7 @@ def train_gala(
         healthy = np.ones(R, bool)
         if guard:
             for r in range(R):
-                finite = bool(
+                finite = bool(  # lint: disable=host-sync
                     tree_all_finite(
                         (state[r].params, tuple(seg_metrics[r]))
                     )
@@ -591,7 +600,7 @@ def train_gala(
                 streak = np.where(quarantine & healthy, streak + 1, streak)
                 readmit = quarantine & healthy & (streak >= readmit_after)
                 if readmit.any():
-                    stats_g["readmitted"] += int(readmit.sum())
+                    stats_g["readmitted"] += int(readmit.sum())  # lint: disable=host-sync
                     quarantine &= ~readmit
                     streak[readmit] = 0
                 quarantine |= ~healthy
@@ -612,9 +621,9 @@ def train_gala(
                 jnp.asarray(mix_exclude),
             )
             stats_g["rounds"] += 1
-            stats_g["excluded"] += int(mix_exclude.sum())
-            stats_g["nonfinite"] += int(diag.nonfinite)
-            stats_g["deficit"] += int(diag.deficit)
+            stats_g["excluded"] += int(mix_exclude.sum())  # lint: disable=host-sync
+            stats_g["nonfinite"] += int(diag.nonfinite)  # lint: disable=host-sync
+            stats_g["deficit"] += int(diag.deficit)  # lint: disable=host-sync
             excluded_mask = np.zeros(R, bool)
             round_idx += 1
             for r in range(R):
@@ -628,7 +637,7 @@ def train_gala(
                     # only a finite post-mix tree may become the new
                     # rollback target (the mean arm's poisoned mix must
                     # not become the "good" state)
-                    if bool(params_finite(state[r].params)):
+                    if bool(params_finite(state[r].params)):  # lint: disable=host-sync
                         last_good[r] = state[r]
             if stale_replay:
                 prev_payload = [
@@ -640,7 +649,7 @@ def train_gala(
         seg_means = np.full(R, np.nan)
         for r in range(R):
             tt = np.concatenate(
-                [np.asarray(m.true_team_returns) for m in seg_metrics[r]]
+                [np.asarray(m.true_team_returns) for m in seg_metrics[r]]  # lint: disable=host-sync
             )
             if np.isfinite(tt).any():
                 seg_means[r] = np.nanmean(tt)
@@ -663,7 +672,7 @@ def train_gala(
                 _warnings.filterwarnings(
                     "ignore", message="Mean of empty slice"
                 )
-                seg_return = float(np.nanmean(seg_means[np.array(keep)]))
+                seg_return = float(np.nanmean(seg_means[np.array(keep)]))  # lint: disable=host-sync
             print(
                 f"| blocks {blocks_done}/{n_blocks} | round {round_idx} "
                 f"| team return {seg_return:.3f}"
@@ -677,7 +686,7 @@ def train_gala(
                     "replicas": R,
                     "gossip_round": round_idx,
                     "excluded": [
-                        int(x) for x in (excluded_mask | quarantine)
+                        int(x) for x in (excluded_mask | quarantine)  # lint: disable=host-sync
                     ],
                     "segment_blocks": seg_len,
                     "pipeline_depth": depth,
@@ -690,7 +699,7 @@ def train_gala(
 
     metrics = [
         jax.tree.map(
-            lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
+            lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),  # lint: disable=host-sync
             *all_metrics[r],
         )
         for r in range(R)
@@ -732,7 +741,7 @@ def train_gala(
             "replica_skipped": [s["skipped"] for s in rep_stats],
         }
     healthy_final = [
-        bool(params_finite(state[r].params)) for r in range(R)
+        bool(params_finite(state[r].params)) for r in range(R)  # lint: disable=host-sync
     ]
     df.attrs["gossip"] = {
         **stats_g,
@@ -744,9 +753,9 @@ def train_gala(
         "byzantine": sorted(byz),
         "replica_healthy": healthy_final,
         "gossip_round": round_idx,
-        "excluded_mask": [int(x) for x in (excluded_mask | quarantine)],
+        "excluded_mask": [int(x) for x in (excluded_mask | quarantine)],  # lint: disable=host-sync
         "readmit_after": readmit_after,
-        "quarantined": [int(x) for x in quarantine],
+        "quarantined": [int(x) for x in quarantine],  # lint: disable=host-sync
     }
     df.attrs["canary"] = {
         "band": cfg.canary_band,
@@ -760,7 +769,7 @@ def train_gala(
         "deploys": deploy.counters["publishes"],
         "deploy_rejects": deploy.counters["rejects"],
         "canary_rejects": deploy.counters["canary_rejects"],
-        "deploy_healthy": bool(params_finite(deploy.acting)),
+        "deploy_healthy": bool(params_finite(deploy.acting)),  # lint: disable=host-sync
     }
     df.attrs["gala"] = {"replicas": R, "depth": depth}
     return _stack_states(state), df

@@ -326,6 +326,148 @@ def fleet_entry_inputs(cfg):
     return _FLEET_INPUT_CACHE[cfg]
 
 
+_PAIR_TRUNK_CACHE: dict = {}
+
+
+def pair_trunk_struct(cfg) -> Tuple[int, int, int]:
+    """``(n_trunk, tree_split, p_pair)``: the combined critic+TR pair
+    block's static column geometry for ``cfg`` — the shapes the fused
+    consensus ``kernel_plan()`` is priced at. Derived through
+    ``jax.eval_shape`` of the parameter init (abstract avals only:
+    nothing allocates, so ``lint --kernels`` can price bench- and
+    session-scale cells on any host), memoized per config."""
+    if cfg not in _PAIR_TRUNK_CACHE:
+        from rcmarl_tpu.training.trainer import init_train_state
+        from rcmarl_tpu.training.update import (
+            _pair_segments,
+            _pair_trunk_split,
+        )
+
+        params = jax.eval_shape(
+            lambda k: init_train_state(cfg, k), jax.random.PRNGKey(0)
+        ).params
+        segs = _pair_segments(params.critic, params.tr)
+        n_trunk, split = _pair_trunk_split(segs)
+        p_pair = sum(size for *_, size in segs)
+        _PAIR_TRUNK_CACHE[cfg] = (int(n_trunk), int(split), int(p_pair))
+    return _PAIR_TRUNK_CACHE[cfg]
+
+
+_FIT_STRUCT_CACHE: dict = {}
+
+
+def fit_row_structs(cfg):
+    """``(keys_rows, params_rows, x_rows, targets_rows, schedule)``
+    with ``ShapeDtypeStruct`` leaves: the adversary fused-fit row block
+    exactly as :func:`rcmarl_tpu.agents.updates.adv_fused_row_block`
+    assembles it, derived through ONE ``jax.eval_shape`` of the whole
+    build chain (init -> rollout -> batch -> pair inputs -> row block).
+    Abstract avals only — no rollout executes, so ``lint --kernels``
+    prices the fit-scan ``kernel_plan()`` at bench scale without
+    paying a bench run. Memoized per config; raises on configs with no
+    adversary flavors (there is no fused row block to price — the
+    caller records a note, not a pass)."""
+    if cfg not in _FIT_STRUCT_CACHE:
+        from rcmarl_tpu.agents.updates import (
+            adv_fit_schedule,
+            adv_fused_row_block,
+            netstack_pair_inputs,
+        )
+        from rcmarl_tpu.training.buffer import update_batch
+        from rcmarl_tpu.training.rollout import rollout_block
+        from rcmarl_tpu.training.trainer import init_train_state, make_env
+
+        env = make_env(cfg)
+
+        def build(key):
+            state = init_train_state(cfg, key)
+            fresh, _ = rollout_block(
+                cfg, env, state.params, state.desired, key, state.initial
+            )
+            batch = update_batch(state.buffer, fresh)
+            p = state.params
+            x2 = netstack_pair_inputs(cfg, batch.s, batch.sa)
+            r_agents = jnp.moveaxis(batch.r, 1, 0)
+            r_coop = team_average_reward(cfg, batch.r)
+            keys_rows, params_rows, x_rows, targets_rows, _ = (
+                adv_fused_row_block(
+                    cfg, p.critic, p.tr, p.critic_local, x2, batch.ns,
+                    r_agents, r_coop, jax.random.split(key, 5),
+                )
+            )
+            return keys_rows, params_rows, x_rows, targets_rows
+
+        structs = jax.eval_shape(build, jax.random.PRNGKey(0))
+        _FIT_STRUCT_CACHE[cfg] = structs + (adv_fit_schedule(cfg),)
+    return _FIT_STRUCT_CACHE[cfg]
+
+
+_COOP_FIT_STRUCT_CACHE: dict = {}
+
+
+def coop_fit_row_structs(cfg):
+    """``(keys_rows, params_rows, x_rows, targets_rows, schedule)`` for
+    the FULL-BATCH cooperative fit launch (critic + TR as one stacked
+    pair, zero keys, identity plan) — the twin of :func:`fit_row_structs`
+    for configs with no adversary flavors, where the fused-fit kernel
+    still runs via ``coop_fused_fit``. Same ``jax.eval_shape`` chain,
+    same memoization; works on EVERY config (the cooperative group
+    always exists)."""
+    if cfg not in _COOP_FIT_STRUCT_CACHE:
+        from rcmarl_tpu.agents.updates import (
+            coop_fit_schedule,
+            netstack_pair_inputs,
+            netstack_stack,
+            pair_bootstrap_targets,
+        )
+        from rcmarl_tpu.training.buffer import update_batch
+        from rcmarl_tpu.training.rollout import rollout_block
+        from rcmarl_tpu.training.trainer import init_train_state, make_env
+
+        env = make_env(cfg)
+
+        def build(key):
+            state = init_train_state(cfg, key)
+            fresh, _ = rollout_block(
+                cfg, env, state.params, state.desired, key, state.initial
+            )
+            batch = update_batch(state.buffer, fresh)
+            p = state.params
+            x2 = netstack_pair_inputs(cfg, batch.s, batch.sa)
+            r_agents = jnp.moveaxis(batch.r, 1, 0)
+            targets2 = pair_bootstrap_targets(
+                cfg, p.critic, batch.ns, r_agents
+            )
+            keys = jnp.zeros((2, cfg.n_agents, 2), jnp.uint32)
+            return keys, netstack_stack(p.critic, p.tr), x2, targets2
+
+        structs = jax.eval_shape(build, jax.random.PRNGKey(0))
+        _COOP_FIT_STRUCT_CACHE[cfg] = structs + (
+            coop_fit_schedule(cfg, int(structs[2].shape[1])),
+        )
+    return _COOP_FIT_STRUCT_CACHE[cfg]
+
+
+_SERVE_STRUCT_CACHE: dict = {}
+
+
+def serve_block_struct(cfg):
+    """The stacked actor block's ``ShapeDtypeStruct`` pytree — the
+    exact leaves :func:`rcmarl_tpu.serve.engine.stack_actor_rows` hands
+    the serve launch, via ``jax.eval_shape`` of the init chain (nothing
+    allocates), memoized per config. What the serve ``kernel_plan()``
+    is priced over."""
+    if cfg not in _SERVE_STRUCT_CACHE:
+        from rcmarl_tpu.serve.engine import stack_actor_rows
+        from rcmarl_tpu.training.trainer import init_train_state
+
+        _SERVE_STRUCT_CACHE[cfg] = jax.eval_shape(
+            lambda k: stack_actor_rows(init_train_state(cfg, k).params, cfg),
+            jax.random.PRNGKey(0),
+        )
+    return _SERVE_STRUCT_CACHE[cfg]
+
+
 def lowered_entry_points(
     cfg, with_diag: bool = False, names: Optional[Tuple[str, ...]] = None
 ) -> Dict[str, object]:

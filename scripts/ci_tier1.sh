@@ -599,7 +599,11 @@ echo "chaos smoke cell OK"
 # the per-device memory ladder at mesh {1,2,8} vs the ledger's
 # device_memory rows, and the nondeterministic-HLO census) and
 # --contract (every Config field CLI-reachable, JSON-round-tripping,
-# and documented). The donation + backend-purity audits run inside the
+# and documented) and --kernels (every Pallas plan's per-grid-step
+# VMEM/SMEM residency vs the strictest generation budget, chosen-tile
+# packing quanta, the committed *_dma_bytes models re-derived from
+# BlockSpec grid arithmetic, and the kernel_budget ledger rows — pure
+# shape arithmetic, no backend). The donation + backend-purity audits run inside the
 # pytest suite above (tests/test_lint.py); the repeat here proves the
 # contracts through the real CLI entry, not just the test harness —
 # and carries the sharded compiles the tier-1 pytest budget cannot
@@ -607,6 +611,6 @@ echo "chaos smoke cell OK"
 # CLI writes AUDIT.jsonl.new next to the baseline — ci.yml uploads it
 # as an artifact so the ledger diff is one click away.
 timeout -k 10 900 env JAX_PLATFORMS=cpu python -m rcmarl_tpu lint \
-    --retrace --cost --collectives --sharding --contract \
+    --retrace --cost --collectives --sharding --contract --kernels \
     --baseline AUDIT.jsonl
 echo "graftlint cell OK"

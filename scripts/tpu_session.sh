@@ -43,13 +43,41 @@ if ! timeout 240 python -c "import jax; d = jax.devices(); print(d); assert d[0]
     exit 2
 fi
 
+# Kernel-feasibility preamble: price every queued Pallas shape's
+# per-grid-step VMEM/SMEM residency from its BlockSpec plan (pure host
+# arithmetic — no backend touched) BEFORE burning tunnel time on step 1.
+# One line per (step, kernel, shape); run_step below vetoes any step
+# holding an `infeasible` verdict for the selected generation (override
+# with TPU_GEN=v5e/v5p for the bigger-VMEM parts; default v4 is the
+# strictest committed budget). A broken preflight must not veto the
+# session: derivation failure leaves every step unverified, not aborted.
+echo "== kernel_feasibility preflight (TPU_GEN=${TPU_GEN:-v4}) =="
+FEAS_FILE=$(mktemp)
+if ! timeout 900 python -m rcmarl_tpu lint --feasibility \
+        ${TPU_GEN:+--tpu_gen "$TPU_GEN"} | tee "$FEAS_FILE"; then
+    echo "preflight derivation FAILED - every step runs unverified"
+    : > "$FEAS_FILE"
+fi
+
 declare -A status
 step_order=()
 
 run_step() {
     local name="$1"; shift
+    # the leading "<tag>." of every step name is its preflight key
+    local tag="${name%%.*}"
     echo "== ${name} =="
     step_order+=("$name")
+    local infeasible
+    infeasible=$(grep -E "^step:${tag} .*verdict=infeasible" "$FEAS_FILE" || true)
+    if [ -n "$infeasible" ]; then
+        echo "step ${tag} ABORTED: kernel_feasibility preflight priced a"
+        echo "queued Pallas shape over the ${TPU_GEN:-v4} on-chip budget:"
+        printf '%s\n' "$infeasible" | sed 's/^/    /'
+        echo "(rerun with TPU_GEN=v5e or v5p on a bigger-VMEM host)"
+        status["$name"]="ABORTED (infeasible kernel shape)"
+        return
+    fi
     if "$@"; then
         status["$name"]=ok
     else

@@ -33,6 +33,17 @@ Three pins:
    (`nondeterminism`); and the Config⇄CLI⇄docs contract pass fires
    `contract-drift` at the exact config.py field line when a flag is
    removed, a field goes undocumented, or the JSON round-trip breaks.
+6. **Kernel budget arm** — pure plan arithmetic (no backend): the
+   committed DMA models re-derive EXACTLY from the BlockSpec grid
+   arithmetic (fit within its documented 4·R·N loss-output residual);
+   residency is exact on hand-computed tiny grids and monotone in
+   every shape axis (hypothesis); planted cells — an oversized block,
+   a 7-row f32 tile, a 1.5× drifted model — trip their rules at
+   exactly the planted entry through the REAL `kernel_rows` pipeline;
+   and the `kernel_budget` ledger rows round-trip byte-stably with the
+   full cost-arm compare semantics (growth/fingerprint/stale fire,
+   skipped is exempt, a feasible→infeasible flip fires the budget rule
+   itself).
 """
 
 from __future__ import annotations
@@ -741,6 +752,520 @@ class TestContract:
         )
 
 
+def _planted_plan(
+    name="planted",
+    grid=(3,),
+    block=(8, 128),
+    dtype="float32",
+    tiled_dims=(0, 1),
+    smem_shape=None,
+    scratch_shape=None,
+):
+    """A hand-built KernelPlan for the planted-regression cells: one
+    pipelined in/out pair, optional scalar-prefetch + scratch."""
+    from rcmarl_tpu.ops.dma_model import BlockOperand, KernelPlan
+
+    inputs = [
+        BlockOperand("x", block, dtype, (True,), tiled_dims=tiled_dims)
+    ]
+    if smem_shape is not None:
+        inputs.append(
+            BlockOperand(
+                "sched", smem_shape, "int32", (False,), memory="smem"
+            )
+        )
+    scratch = (
+        (BlockOperand("acc", scratch_shape, "float32", (False,)),)
+        if scratch_shape is not None
+        else ()
+    )
+    return KernelPlan(
+        name=name,
+        grid=grid,
+        inputs=tuple(inputs),
+        outputs=(BlockOperand("o", block, dtype, (True,)),),
+        scratch=scratch,
+    )
+
+
+def _planted_cell(entry, plan, model=None, must_fit=True, steps=()):
+    from rcmarl_tpu.lint.kernels import KernelCell
+
+    return KernelCell(entry, tuple(steps), must_fit, lambda: (plan, model))
+
+
+class TestKernelPlans:
+    """The committed ``*_dma_bytes`` models are DERIVED, not asserted:
+    each one re-derives from its kernel's ``kernel_plan()`` BlockSpec
+    grid arithmetic — exactly for consensus (dense + sparse) and
+    serve (solo + fleet), and within the documented 4·R·N loss-output
+    residual for the fit scan."""
+
+    def test_consensus_models_rederive_exactly(self):
+        from rcmarl_tpu.ops import pallas_consensus
+        from rcmarl_tpu.ops.dma_model import (
+            consensus_model_bytes,
+            plan_dma_bytes,
+            sparse_consensus_model_bytes,
+        )
+
+        for n, n_in, trunk, faulted in [
+            (5, 3, 100, False),
+            (16, 16, 840, True),
+            (64, 8, 3200, True),
+        ]:
+            plan = pallas_consensus.kernel_plan(
+                n, n_in, trunk,
+                active=faulted, has_stale=faulted, sanitize=faulted,
+            )
+            model = consensus_model_bytes(
+                n, n_in, trunk, active=faulted, has_stale=faulted
+            )
+            assert plan_dma_bytes(plan) == model, (n, n_in, trunk, faulted)
+        for n, deg, trunk in [(8, 3, 200), (256, 9, 5000)]:
+            plan = pallas_consensus.kernel_plan(
+                n, deg, trunk, sparse=True
+            )
+            model = sparse_consensus_model_bytes(n, deg, trunk)
+            assert plan_dma_bytes(plan) == model, (n, deg, trunk)
+
+    def test_serve_models_rederive_exactly(self):
+        from rcmarl_tpu.lint.kernels import kernel_cells
+        from rcmarl_tpu.ops.dma_model import plan_dma_bytes
+
+        cells = {
+            c.entry: c
+            for c in kernel_cells()
+            if c.entry.startswith(("fused_serve", "fused_fleet"))
+        }
+        assert len(cells) == 4  # tiny solo, tiny fleet, ref5 solo+fleet
+        for entry, cell in cells.items():
+            plan, model = cell.build()
+            assert plan_dma_bytes(plan) == model, entry
+
+    def test_fit_model_residual_is_the_loss_output(self):
+        """The fit model's only gap from the derivation is the
+        ``(R, N)`` first-epoch-loss output — 4·R·N bytes exactly, well
+        under the drift rule's absolute slack."""
+        from rcmarl_tpu.lint.kernels import KERNEL_DRIFT_ABS_SLACK
+        from rcmarl_tpu.ops import pallas_fit
+        from rcmarl_tpu.ops.dma_model import plan_dma_bytes
+        from rcmarl_tpu.utils.profiling import (
+            coop_fit_row_structs,
+            fit_row_structs,
+        )
+        from rcmarl_tpu.lint.configs import tiny_cfg, tiny_mixed_cfg
+
+        for structs in (
+            fit_row_structs(tiny_mixed_cfg()),
+            coop_fit_row_structs(tiny_cfg()),
+        ):
+            _, params_rows, x_rows, targets_rows, schedule = structs
+            plan = pallas_fit.kernel_plan(
+                params_rows, x_rows, targets_rows, schedule
+            )
+            model = pallas_fit.fit_scan_hbm_bytes(
+                params_rows, x_rows, targets_rows, schedule, resident=True
+            )
+            import jax
+
+            rows, n_agents = jax.tree.leaves(params_rows)[0].shape[:2]
+            gap = plan_dma_bytes(plan) - model
+            assert gap == 4.0 * rows * n_agents
+            assert gap < KERNEL_DRIFT_ABS_SLACK
+
+
+class TestKernelResidency:
+    """The residency arithmetic itself: exact on hand-computed tiny
+    grids, monotone in every shape axis (hypothesis twin)."""
+
+    def test_hand_computed_dense_consensus(self):
+        """n=2 agents, n_in=3, trunk=100, H=1, block_rows=8: one
+        1024-column tile → grid (1,), no double-buffer. Blocks are
+        (2, 8, 128) f32 = 8192 B each; scratch live set is
+        n_in + 2·(H+1) + 1 = 8 rows of (8, 128) f32 = 32768 B."""
+        from rcmarl_tpu.lint.kernels import (
+            plan_smem_bytes,
+            plan_vmem_bytes,
+        )
+        from rcmarl_tpu.ops import pallas_consensus
+        from rcmarl_tpu.ops.dma_model import plan_dma_bytes
+
+        plan = pallas_consensus.kernel_plan(2, 3, 100, trim_h=1)
+        assert plan.grid == (1,)
+        assert plan_vmem_bytes(plan) == 8192 + 8192 + 32768
+        assert plan_smem_bytes(plan) == 0
+        assert plan_dma_bytes(plan) == 8192 + 8192
+
+    def test_hand_computed_multi_tile_double_buffers(self):
+        """trunk=3000 pads to 3072 → grid (3,): the pipelined blocks
+        double (Mosaic overlaps tile i compute with tile i+1 DMA),
+        scratch stays single; traffic is per-step. The sparse twin adds
+        one (N, degree) int32 scalar-prefetch block, resident in SMEM
+        and DMAd once."""
+        from rcmarl_tpu.lint.kernels import (
+            plan_smem_bytes,
+            plan_vmem_bytes,
+        )
+        from rcmarl_tpu.ops import pallas_consensus
+        from rcmarl_tpu.ops.dma_model import plan_dma_bytes
+
+        plan = pallas_consensus.kernel_plan(2, 3, 3000, trim_h=1)
+        assert plan.grid == (3,)
+        assert plan_vmem_bytes(plan) == 2 * (8192 + 8192) + 32768
+        assert plan_dma_bytes(plan) == 3 * (8192 + 8192)
+        sparse = pallas_consensus.kernel_plan(
+            2, 3, 3000, sparse=True, trim_h=1
+        )
+        assert plan_smem_bytes(sparse) == 2 * 3 * 4
+        assert plan_dma_bytes(sparse) == 3 * (8192 + 8192) + 2 * 3 * 4
+
+    def test_residency_monotone_deterministic_sweep(self):
+        """The hypothesis property's always-on twin: a fixed lattice of
+        shapes, each axis bumped in turn — residency never shrinks."""
+        import itertools
+
+        from rcmarl_tpu.lint.kernels import plan_vmem_bytes
+        from rcmarl_tpu.ops import pallas_consensus
+
+        def vmem(n, n_in, trunk, h):
+            return plan_vmem_bytes(
+                pallas_consensus.kernel_plan(
+                    n, n_in, trunk,
+                    active=True, has_stale=True, sanitize=True, trim_h=h,
+                )
+            )
+
+        lattice = itertools.product(
+            (2, 16, 64), (3, 9), (100, 1024, 5000), (0, 1, 4)
+        )
+        for n, n_in, trunk, h in lattice:
+            if 2 * h + 1 > n_in:
+                continue
+            base = vmem(n, n_in, trunk, h)
+            assert vmem(n + 1, n_in, trunk, h) >= base
+            assert vmem(n, n_in + 1, trunk, h) >= base
+            assert vmem(n, n_in, trunk + 1, h) >= base
+            if 2 * (h + 1) + 1 <= n_in:
+                assert vmem(n, n_in, trunk, h + 1) >= base
+
+    def test_residency_monotone_in_every_axis(self):
+        """Growing any shape axis — agents, fan-in, trunk columns, the
+        trim parameter — never SHRINKS per-grid-step residency."""
+        hypothesis = pytest.importorskip("hypothesis")
+        from hypothesis import given, settings
+        from hypothesis import strategies as st
+
+        from rcmarl_tpu.lint.kernels import plan_vmem_bytes
+        from rcmarl_tpu.ops import pallas_consensus
+
+        def vmem(n, n_in, trunk, h):
+            return plan_vmem_bytes(
+                pallas_consensus.kernel_plan(
+                    n, n_in, trunk,
+                    active=True, has_stale=True, sanitize=True, trim_h=h,
+                )
+            )
+
+        @settings(max_examples=80, deadline=None)
+        @given(
+            n=st.integers(2, 64),
+            n_in=st.integers(3, 16),
+            trunk=st.integers(1, 8000),
+            h=st.integers(0, 4),
+            bump=st.sampled_from(["n", "n_in", "trunk", "h"]),
+        )
+        def check(n, n_in, trunk, h, bump):
+            hypothesis.assume(2 * h + 1 <= n_in)
+            base = vmem(n, n_in, trunk, h)
+            grown = dict(n=n, n_in=n_in, trunk=trunk, h=h)
+            grown[bump] += 1
+            if bump == "h":
+                hypothesis.assume(2 * grown["h"] + 1 <= n_in)
+            assert (
+                vmem(grown["n"], grown["n_in"], grown["trunk"], grown["h"])
+                >= base
+            )
+
+        check()
+
+
+class TestKernelBudgetAudit:
+    """Planted kernel regressions through the REAL ``kernel_rows``
+    pipeline (the ``cells`` override), plus the full ledger-compare
+    semantics on ``kernel_budget`` rows."""
+
+    @pytest.fixture(scope="class")
+    def base_rows(self):
+        from rcmarl_tpu.lint.kernels import kernel_rows
+
+        cells = [
+            _planted_cell(
+                "planted[ok]",
+                _planted_plan(scratch_shape=(16, 128)),
+                model=None,
+                must_fit=True,
+            ),
+            _planted_cell(
+                "planted[session]",
+                _planted_plan(grid=(5,), smem_shape=(4, 2)),
+                model=None,
+                must_fit=False,
+                steps=("99",),
+            ),
+        ]
+        rows, findings, notes, skipped = kernel_rows(cells=cells)
+        assert findings == [] and notes == [] and skipped == set()
+        assert len(rows) == 6  # 2 cells x 3 generations
+        return rows
+
+    def test_rows_are_feasible_and_tagged(self, base_rows):
+        by_entry = {r["entry"]: r for r in base_rows}
+        assert all(r["verdict"] == "feasible" for r in base_rows)
+        assert by_entry["planted[session]@v4"]["steps"] == ["99"]
+        assert by_entry["planted[ok]@v4"]["must_fit"] is True
+        # one fingerprint per CELL, shared across its generation rows
+        assert len({r["fingerprint"] for r in base_rows}) == 2
+
+    def test_oversized_block_fires_vmem_budget(self):
+        """A (4200, 8, 128) f32 block double-buffers past the v4
+        16 MiB VMEM budget on a must-fit cell — `kernel-vmem-budget`
+        at exactly the planted entry, and an honest `infeasible`
+        verdict in the v4 row."""
+        from rcmarl_tpu.lint.kernels import kernel_rows
+
+        cell = _planted_cell(
+            "planted[oversized]",
+            _planted_plan(block=(4200, 8, 128), tiled_dims=(1, 2)),
+        )
+        rows, findings, notes, _ = kernel_rows(cells=[cell])
+        assert {f.rule for f in findings} == {"kernel-vmem-budget"}
+        assert len(findings) == 1
+        assert "planted[oversized]" in findings[0].message
+        by_entry = {r["entry"]: r for r in rows}
+        assert by_entry["planted[oversized]@v4"]["verdict"] == "infeasible"
+        assert by_entry["planted[oversized]@v5e"]["verdict"] == "feasible"
+
+    def test_oversized_smem_fires_smem_budget(self):
+        from rcmarl_tpu.lint.kernels import kernel_rows
+
+        cell = _planted_cell(
+            "planted[smem]",
+            _planted_plan(smem_shape=(600, 600)),  # 1.37 MiB > 1 MiB
+        )
+        _, findings, _, _ = kernel_rows(cells=[cell])
+        assert {f.rule for f in findings} == {"kernel-smem-budget"}
+        assert "planted[smem]" in findings[0].message
+
+    def test_session_cell_infeasibility_is_a_note_not_a_finding(self):
+        """The verdict-vs-finding split: a SESSION shape over budget is
+        an honest ledger verdict + a note naming its step tags (the
+        preflight's abort signal) — not a lint failure."""
+        from rcmarl_tpu.lint.kernels import kernel_rows
+
+        cell = _planted_cell(
+            "planted[bigsession]",
+            _planted_plan(block=(4200, 8, 128), tiled_dims=(1, 2)),
+            must_fit=False,
+            steps=("14",),
+        )
+        rows, findings, notes, _ = kernel_rows(cells=[cell])
+        assert findings == []
+        assert len(notes) == 1 and "14" in notes[0]
+        assert {r["entry"]: r["verdict"] for r in rows}[
+            "planted[bigsession]@v4"
+        ] == "infeasible"
+
+    def test_seven_row_tile_fires_misaligned(self):
+        """A chosen 7-row f32 tile violates the (8, 128) packing
+        quantum at the sublane position; a problem-determined 7-wide
+        dim (not in tiled_dims) must NOT fire."""
+        from rcmarl_tpu.lint.kernels import kernel_rows
+
+        bad = _planted_cell(
+            "planted[badtile]", _planted_plan(block=(7, 128))
+        )
+        _, findings, _, _ = kernel_rows(cells=[bad])
+        assert {f.rule for f in findings} == {"kernel-tile-misaligned"}
+        assert "sublane" in findings[0].message
+        ok = _planted_cell(
+            "planted[problemdim]",
+            _planted_plan(block=(7, 128), tiled_dims=(1,)),
+        )
+        _, findings, _, _ = kernel_rows(cells=[ok])
+        assert findings == []
+
+    def test_bf16_tile_quantum_is_sixteen(self):
+        from rcmarl_tpu.lint.kernels import kernel_rows
+
+        cell = _planted_cell(
+            "planted[bf16]", _planted_plan(block=(8, 128), dtype="bfloat16")
+        )
+        _, findings, _, _ = kernel_rows(cells=[cell])
+        assert {f.rule for f in findings} == {"kernel-tile-misaligned"}
+        ok = _planted_cell(
+            "planted[bf16ok]",
+            _planted_plan(block=(16, 128), dtype="bfloat16"),
+        )
+        _, findings, _, _ = kernel_rows(cells=[ok])
+        assert findings == []
+
+    def test_drifted_model_fires_drift(self):
+        """Scale the committed model 1.5× off the derivation:
+        `kernel-dma-model-drift` at exactly the planted entry, both
+        directions."""
+        from rcmarl_tpu.ops.dma_model import plan_dma_bytes
+        from rcmarl_tpu.lint.kernels import kernel_rows
+
+        plan = _planted_plan(grid=(64,))
+        derived = plan_dma_bytes(plan)
+        assert derived * 0.5 > 4096  # clear of the absolute slack
+        for factor in (1.5, 0.5):
+            cell = _planted_cell(
+                "planted[drift]", plan, model=derived * factor
+            )
+            _, findings, _, _ = kernel_rows(cells=[cell])
+            assert {f.rule for f in findings} == {
+                "kernel-dma-model-drift"
+            }, factor
+            assert "planted[drift]" in findings[0].message
+        exact = _planted_cell("planted[exact]", plan, model=derived)
+        _, findings, _, _ = kernel_rows(cells=[exact])
+        assert findings == []
+
+    def test_underivable_cell_is_note_plus_skip_never_pass(self):
+        from rcmarl_tpu.lint.kernels import KernelCell, kernel_rows
+
+        def boom():
+            raise ValueError("no such shape")
+
+        cell = KernelCell("planted[broken]", (), True, boom)
+        rows, findings, notes, skipped = kernel_rows(cells=[cell])
+        assert rows == [] and findings == []
+        assert len(notes) == 1 and "planted[broken]" in notes[0]
+        assert skipped == {
+            "planted[broken]@v4",
+            "planted[broken]@v5e",
+            "planted[broken]@v5p",
+        }
+
+    def test_ledger_roundtrip_is_byte_stable(self, base_rows, tmp_path):
+        from rcmarl_tpu.lint.cost import (
+            canonical_rows,
+            read_ledger,
+            write_ledger,
+        )
+
+        path = tmp_path / "AUDIT.jsonl"
+        write_ledger(path, base_rows)
+        back = read_ledger(path)
+        assert back == canonical_rows(base_rows)
+        first = path.read_bytes()
+        write_ledger(path, list(reversed(back)))
+        assert path.read_bytes() == first
+
+    def test_self_comparison_is_clean(self, base_rows):
+        from rcmarl_tpu.lint.kernels import compare_kernels
+
+        findings, notes = compare_kernels(base_rows, base_rows)
+        assert findings == [] and notes == []
+
+    def test_metric_growth_trips_exactly_the_entry(self, base_rows):
+        import copy
+
+        from rcmarl_tpu.lint.kernels import compare_kernels
+
+        fresh = copy.deepcopy(base_rows)
+        for r in fresh:
+            if r["entry"] == "planted[ok]@v4":
+                r["metrics"]["vmem_bytes"] *= 1.10
+        findings, _ = compare_kernels(base_rows, fresh)
+        assert {f.rule for f in findings} == {"kernel-budget-regression"}
+        assert len(findings) == 1
+        assert "planted[ok]@v4" in findings[0].message
+        assert "vmem_bytes" in findings[0].message
+        # ...and a SHRINK is a note, not a finding
+        fresh = copy.deepcopy(base_rows)
+        fresh[0]["metrics"]["dma_derived_bytes"] *= 0.5
+        findings, notes = compare_kernels(base_rows, fresh)
+        assert findings == [] and len(notes) == 1
+
+    def test_fingerprint_change_reports_regression(self, base_rows):
+        import copy
+
+        from rcmarl_tpu.lint.kernels import compare_kernels
+
+        fresh = copy.deepcopy(base_rows)
+        fresh[0]["fingerprint"] = "somethingelse"
+        findings, _ = compare_kernels(base_rows, fresh)
+        assert {f.rule for f in findings} == {"kernel-budget-regression"}
+        assert "fingerprint" in findings[0].message
+
+    def test_missing_stale_and_skipped_rows(self, base_rows):
+        from rcmarl_tpu.lint.kernels import compare_kernels
+
+        findings, _ = compare_kernels([], base_rows)  # unbaselined
+        assert {f.rule for f in findings} == {"kernel-budget-regression"}
+        assert len(findings) == len(base_rows)
+        findings, _ = compare_kernels(base_rows, [])  # stale
+        assert {f.rule for f in findings} == {"kernel-budget-regression"}
+        # ...but rows this host could not DERIVE are exempt, not stale
+        findings, _ = compare_kernels(
+            base_rows, [], skipped={r["entry"] for r in base_rows}
+        )
+        assert findings == []
+
+    def test_feasibility_flip_fires_the_budget_rule(self, base_rows):
+        """A committed `feasible` verdict regressing to `infeasible`
+        is the regression the budget table exists to catch — it fires
+        kernel-vmem-budget itself, not the generic regression rule;
+        the improving flip is a note."""
+        import copy
+
+        from rcmarl_tpu.lint.kernels import TPU_GENERATIONS, compare_kernels
+
+        fresh = copy.deepcopy(base_rows)
+        for r in fresh:
+            if r["entry"] == "planted[ok]@v4":
+                r["verdict"] = "infeasible"
+                r["metrics"]["vmem_bytes"] = (
+                    TPU_GENERATIONS["v4"]["vmem"] + 1.0
+                )
+        findings, _ = compare_kernels(base_rows, fresh)
+        assert {f.rule for f in findings} == {"kernel-vmem-budget"}
+        assert "regressed" in findings[0].message
+        baseline = copy.deepcopy(fresh)
+        findings, notes = compare_kernels(baseline, base_rows)
+        assert findings == []
+        assert any("improved" in n for n in notes)
+
+    def test_feasibility_lines_cover_every_queued_step(self):
+        """The session-preflight feed: every line is machine-parseable,
+        the queued sparse mega-cells report honestly infeasible at v4
+        and feasible at v5e — pure arithmetic, identical on any
+        host."""
+        import re as _re
+
+        from rcmarl_tpu.lint.kernels import feasibility_lines
+
+        lines = feasibility_lines()
+        fmt = _re.compile(
+            r"^step:\S+ kernel=\w+ shape=\S+ gen=v4 "
+            r"verdict=(feasible|infeasible|unverified) "
+            r"vmem_mib=(\d+\.\d\d|nan)$"
+        )
+        assert lines and all(fmt.match(ln) for ln in lines), lines
+        steps = {ln.split()[0].removeprefix("step:") for ln in lines}
+        assert {"1", "2", "9", "9b", "10b", "12", "14", "15b"} <= steps
+        n1024 = [ln for ln in lines if "shape=n1024_sparse" in ln]
+        assert n1024 and all("verdict=infeasible" in ln for ln in n1024)
+        assert any(
+            "verdict=feasible" in ln
+            for ln in feasibility_lines("v5e")
+            if "shape=n1024_sparse" in ln
+        )
+
+
 @pytest.mark.slow
 class TestCommittedLedger:
     """The acceptance bar: the full cost + collective + sharding audits
@@ -775,6 +1300,19 @@ class TestCommittedLedger:
 
         findings, _notes = audit_determinism()
         assert findings == [], "\n".join(str(f) for f in findings)
+
+    def test_kernel_budget_gate_is_clean(self):
+        """The full (kernel x shape) matrix — every Pallas entry at
+        every tiny lint shape, bench cell, and tpu_session.sh queued
+        shape — derives, re-derives its committed DMA model, and
+        matches the committed kernel_budget rows at every
+        generation."""
+        from rcmarl_tpu.lint.kernels import audit_kernels, kernel_cells
+
+        findings, notes, rows = audit_kernels(self.BASELINE)
+        assert findings == [], "\n".join(str(f) for f in findings)
+        # every cell derived (no skips hid behind notes) at all 3 gens
+        assert len(rows) == 3 * len(kernel_cells())
 
 
 class TestBackendAudit:
